@@ -1,0 +1,25 @@
+"""oversim_tpu — a TPU-native overlay-network simulation framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of OverSim (the OMNeT++
+P2P overlay simulator, reference at /root/reference): structured KBR/DHT
+overlays, unstructured search, churn models, an analytic underlay network
+model and oracle-validated test workloads — with all N simulated nodes'
+state held as structure-of-arrays device tensors and every simulation tick
+a vmapped message-passing gather/scatter step.
+
+Design (see SURVEY.md §7):
+  - state: pytree of [N, ...] arrays, shardable over a jax Mesh on the node axis
+  - time: int64 nanoseconds (reference uses simtime-scale=-9, default.ini:26-28)
+  - events: a global bounded message pool + per-node periodic timers;
+    each tick advances simulated time to the next event horizon
+  - randomness: counter-based jax.random with per-node fold_in
+"""
+
+import jax
+
+# Simulated time is int64 nanoseconds; without x64 JAX silently
+# canonicalizes int64 -> int32 which overflows after 2.1 simulated seconds.
+# All other arrays declare explicit narrow dtypes (i32/f32/u32/bool).
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

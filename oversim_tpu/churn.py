@@ -1,0 +1,149 @@
+"""Churn generators: node create/kill processes as scheduled slot events.
+
+TPU-native equivalent of the reference's ChurnGenerator family
+(src/common/{ChurnGenerator,NoChurn,LifetimeChurn,ParetoChurn,RandomChurn}):
+instead of scheduling per-node create/kill self-messages through the event
+kernel, every slot carries a next-create and next-kill time in an [N] i64
+array and the engine flips the alive mask for the slots whose event falls
+inside the tick window — churn never reshapes any array (SURVEY.md §7.2
+"dynamic population": preallocated slots with alive masks, mirroring
+LifetimeChurn's contextVector slot recycling, LifetimeChurn.cc:40-52).
+
+Population conventions match the reference:
+  * NoChurn (NoChurn.cc:20-52): creates one node every
+    ~truncnormal(initPhaseCreationInterval, dev) until the target count,
+    then signals init-finished; nodes never die.  Slots = target.
+  * LifetimeChurn (LifetimeChurn.cc): 2×target context slots; during init,
+    slot i (< target) is created at ~truncnormal(mean·i, dev) and killed at
+    initFinished + L() where L ~ lifetime distribution; the other target
+    slots go live at initFinished + L(); thereafter each kill schedules a
+    re-create after a dead-time draw from the same distribution, with a
+    fresh lifetime.  Distributions (LifetimeChurn.cc:distributionFunction):
+    weibull (scale mean/Γ(1+1/k)), pareto_shifted, truncnormal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+
+
+def _truncnormal(rng, mean, stddev, shape=()):
+    """OMNeT++ truncnormal: normal redrawn until non-negative; we fold the
+    redraw into |N| which matches the half-normal-plus-shift closely enough
+    for schedule jitter (exact for mean=0)."""
+    x = mean + stddev * jax.random.normal(rng, shape)
+    return jnp.abs(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnParams:
+    """Reference params: default.ini:498-506 + ChurnGenerator.ned."""
+
+    model: str = "none"               # "none" | "lifetime"
+    target_num: int = 10              # targetOverlayTerminalNum
+    init_interval: float = 1.0        # initPhaseCreationInterval (s)
+    init_deviation: float = 0.1
+    lifetime_mean: float = 10000.0    # lifetimeMean (s)
+    lifetime_dist: str = "weibull"    # lifetimeDistName
+    lifetime_par1: float = 1.0        # lifetimeDistPar1
+    graceful_leave_delay: float = 15.0
+
+    @property
+    def num_slots(self) -> int:
+        return self.target_num if self.model == "none" else 2 * self.target_num
+
+    @property
+    def init_finished_time(self) -> float:
+        """When the init phase ends and transition time starts counting."""
+        return self.init_interval * self.target_num
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ChurnState:
+    t_create: jnp.ndarray  # [N] i64 — pending create events (T_INF if none)
+    t_kill: jnp.ndarray    # [N] i64 — pending kill events
+
+
+def _draw_lifetime(rng, p: ChurnParams, shape):
+    """Session/dead-time draw (LifetimeChurn::distributionFunction)."""
+    if p.lifetime_dist == "weibull":
+        scale = p.lifetime_mean / math.gamma(1.0 + 1.0 / p.lifetime_par1)
+        return jax.random.weibull_min(rng, scale, p.lifetime_par1, shape)
+    if p.lifetime_dist == "pareto_shifted":
+        k = p.lifetime_par1
+        scale = p.lifetime_mean * (k - 1.0) / k
+        u = jax.random.uniform(rng, shape)
+        return scale * (jnp.power(u, -1.0 / k) - 1.0)
+    if p.lifetime_dist == "truncnormal":
+        return _truncnormal(rng, p.lifetime_mean, p.lifetime_mean / 3.0, shape)
+    raise ValueError(f"unknown lifetime distribution {p.lifetime_dist}")
+
+
+def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
+    n = p.num_slots
+    tgt = p.target_num
+    r1, r2, r3, r4 = jax.random.split(rng, 4)
+    if p.model == "none":
+        stagger = _truncnormal(r1, p.init_interval, p.init_deviation, (n,))
+        t_create = jnp.cumsum(stagger)
+        return ChurnState(
+            t_create=(t_create * NS).astype(I64),
+            t_kill=jnp.full((n,), T_INF, I64))
+    if p.model == "lifetime":
+        fin = p.init_finished_time
+        i = jnp.arange(tgt)
+        first_create = _truncnormal(r1, p.init_interval * i,
+                                    p.init_deviation, (tgt,))
+        first_kill = fin + _draw_lifetime(r2, p, (tgt,))
+        second_create = fin + _draw_lifetime(r3, p, (tgt,))
+        second_kill = second_create + _draw_lifetime(r4, p, (tgt,))
+        t_create = jnp.concatenate([first_create, second_create])
+        t_kill = jnp.concatenate([first_kill, second_kill])
+        # kill fires gracefulLeaveDelay before the end of the session
+        t_kill = jnp.maximum(t_kill - p.graceful_leave_delay, t_create)
+        return ChurnState(
+            t_create=(t_create * NS).astype(I64),
+            t_kill=(t_kill * NS).astype(I64))
+    raise ValueError(f"unknown churn model {p.model}")
+
+
+def next_event(state: ChurnState):
+    return jnp.minimum(jnp.min(state.t_create), jnp.min(state.t_kill))
+
+
+def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
+    """Fire create/kill events inside [t_start, t_end).
+
+    Returns (state', created [N] bool, killed [N] bool).  A kill immediately
+    schedules the slot's next incarnation (LifetimeChurn::deleteNode
+    re-creates after a dead-time draw with a fresh lifetime draw).
+    """
+    created = (state.t_create < t_end) & ~alive
+    killed = (state.t_kill < t_end) & alive & ~created
+
+    t_create = jnp.where(created, T_INF, state.t_create)
+    t_kill = state.t_kill
+
+    if p.model == "lifetime":
+        n = p.num_slots
+        r1, r2 = jax.random.split(rng)
+        dead_time = (_draw_lifetime(r1, p, (n,)) * NS).astype(I64)
+        lifetime = (_draw_lifetime(r2, p, (n,)) * NS).astype(I64)
+        graceful = jnp.int64(p.graceful_leave_delay * NS)
+        next_create = state.t_kill + dead_time
+        next_kill = jnp.maximum(next_create + lifetime - graceful, next_create)
+        t_create = jnp.where(killed, next_create, t_create)
+        t_kill = jnp.where(killed, next_kill, t_kill)
+    else:
+        t_kill = jnp.where(killed, T_INF, t_kill)
+
+    return ChurnState(t_create=t_create, t_kill=t_kill), created, killed

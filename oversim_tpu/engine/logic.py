@@ -1,0 +1,160 @@
+"""Per-node logic scaffolding: message views, outbox builder, tick context.
+
+A "logic" object plays the role of the whole per-node module stack of the
+reference (overlay + tier apps + RPC glue, reference SimpleOverlayHost.ned)
+— but as pure functions over structure-of-arrays state, written against a
+*single* node's slice and vmapped over all N nodes by the engine.
+
+Logic interface (duck-typed; see engine/sim.py):
+
+  key_spec              -> core.keys.KeySpec
+  stat_spec()           -> StatSpec
+  init(rng, n)          -> state pytree of [N, ...] arrays
+  reset(state, clear, join, t_now, rng) -> state
+      # churn transitions: ``clear`` [N] marks slots to wipe (created AND
+      # killed), ``join`` [N] the subset that goes live and must schedule
+      # its join; t_now is the window start (i64 scalar)
+  ready_mask(state)     -> [N] bool           # overlay READY (bootstrappable)
+  next_event(state)     -> [N] i64            # earliest local timer/timeout
+  step(ctx, state_n, inbox, rng, node_idx, *, outbox_slots, rmax)
+      -> (state_n, Outbox, events)            # per-node; vmapped over N
+
+``events`` is a dict stat-name -> (values, mask) pairs consumed by
+engine/stats.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NO_NODE = jnp.int32(-1)
+T_INF = jnp.int64(2**62)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Msg:
+    """View of one (or a batch of) pool message(s); see engine/pool.py."""
+
+    valid: jnp.ndarray
+    t_deliver: jnp.ndarray
+    src: jnp.ndarray
+    dst: jnp.ndarray
+    kind: jnp.ndarray
+    key: jnp.ndarray
+    nonce: jnp.ndarray
+    hops: jnp.ndarray
+    a: jnp.ndarray
+    b: jnp.ndarray
+    c: jnp.ndarray
+    d: jnp.ndarray
+    nodes: jnp.ndarray
+    size_b: jnp.ndarray
+
+    def slot(self, r: int) -> "Msg":
+        """Select inbox slot r (fields lose their leading R axis)."""
+        return jax.tree.map(lambda x: x[r], self)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Ctx:
+    """Broadcast tick context available to every node's handlers."""
+
+    t_start: jnp.ndarray      # i64 scalar — window start
+    t_end: jnp.ndarray        # i64 scalar — window end (exclusive)
+    keys: jnp.ndarray         # [N, KL] u32 — global node-key table (oracle)
+    alive: jnp.ndarray        # [N] bool
+    ready_cumsum: jnp.ndarray  # [N] i32 inclusive cumsum of ready mask
+    n_ready: jnp.ndarray      # i32 scalar
+    measuring: jnp.ndarray    # bool scalar — inside measurement phase
+
+    def sample_ready(self, rng):
+        """Draw a uniformly random READY node slot (-1 if none).
+
+        Oracle bootstrap draw, reference GlobalNodeList::getBootstrapNode
+        (GlobalNodeList.h:115) / getRandomNode — O(1) via the per-type
+        bootstrapped-peer vectors; here a searchsorted over the cumsum.
+        """
+        k = jax.random.randint(rng, (), 0, jnp.maximum(self.n_ready, 1),
+                               dtype=I32)
+        idx = jnp.searchsorted(self.ready_cumsum, k + 1, side="left").astype(I32)
+        return jnp.where(self.n_ready > 0, idx, NO_NODE)
+
+
+class Outbox:
+    """Append-only per-node message emitter used inside vmapped handlers.
+
+    Every ``send`` writes at the current cursor and advances it only when
+    ``en`` is true, so disabled sends cost nothing and are overwritten by
+    the next enabled one.  Slots beyond capacity are dropped (the engine
+    counts the overflow).  The reference equivalent is the unbounded
+    sendMessageToUDP path (BaseOverlay.cc:1147).
+    """
+
+    def __init__(self, m: int, key_lanes: int, rmax: int):
+        self.m = m
+        self.cursor = jnp.int32(0)
+        self.t_send = jnp.zeros((m,), I64)
+        self.dst = jnp.zeros((m,), I32)
+        self.kind = jnp.zeros((m,), I32)
+        self.key = jnp.zeros((m, key_lanes), U32)
+        self.nonce = jnp.zeros((m,), I32)
+        self.hops = jnp.zeros((m,), I32)
+        self.a = jnp.zeros((m,), I32)
+        self.b = jnp.zeros((m,), I32)
+        self.c = jnp.zeros((m,), I32)
+        self.d = jnp.zeros((m,), I32)
+        self.nodes = jnp.full((m, rmax), NO_NODE, I32)
+        self.size_b = jnp.zeros((m,), I32)
+
+    def send(self, en, t_send, dst, kind, *, key=None, nonce=0, hops=0,
+             a=0, b=0, c=0, d=0, nodes=None, size_b=40):
+        cur = jnp.where(en, self.cursor, jnp.int32(self.m))  # OOB -> dropped
+        self.t_send = self.t_send.at[cur].set(t_send, mode="drop")
+        self.dst = self.dst.at[cur].set(jnp.asarray(dst, I32), mode="drop")
+        self.kind = self.kind.at[cur].set(jnp.asarray(kind, I32), mode="drop")
+        if key is not None:
+            self.key = self.key.at[cur].set(key, mode="drop")
+        self.nonce = self.nonce.at[cur].set(jnp.asarray(nonce, I32), mode="drop")
+        self.hops = self.hops.at[cur].set(jnp.asarray(hops, I32), mode="drop")
+        self.a = self.a.at[cur].set(jnp.asarray(a, I32), mode="drop")
+        self.b = self.b.at[cur].set(jnp.asarray(b, I32), mode="drop")
+        self.c = self.c.at[cur].set(jnp.asarray(c, I32), mode="drop")
+        self.d = self.d.at[cur].set(jnp.asarray(d, I32), mode="drop")
+        if nodes is not None:
+            pad = self.nodes.shape[1] - nodes.shape[0]
+            if pad < 0:
+                raise ValueError("node-list payload exceeds RMAX")
+            if pad:
+                nodes = jnp.concatenate([nodes, jnp.full((pad,), NO_NODE, I32)])
+            self.nodes = self.nodes.at[cur].set(nodes, mode="drop")
+        self.size_b = self.size_b.at[cur].set(jnp.asarray(size_b, I32),
+                                              mode="drop")
+        self.cursor = self.cursor + en.astype(I32)
+
+    def finish(self):
+        """Returns (fields dict, valid [M], overflow count)."""
+        valid = jnp.arange(self.m, dtype=I32) < self.cursor
+        fields = dict(t_send=self.t_send, dst=self.dst, kind=self.kind,
+                      key=self.key, nonce=self.nonce, hops=self.hops,
+                      a=self.a, b=self.b, c=self.c, d=self.d,
+                      nodes=self.nodes, size_b=self.size_b)
+        return fields, valid, jnp.maximum(self.cursor - self.m, 0)
+
+
+def select_tree(pred, a, b):
+    """Predicated pytree merge: where(pred, a, b) with pred broadcast up to
+    each leaf's rank (the state-merge step after a conditional handler)."""
+    def sel(x, y):
+        p = pred
+        while p.ndim < x.ndim:
+            p = p[..., None]
+        return jnp.where(p, x, y)
+    return jax.tree.map(sel, a, b)

@@ -1,0 +1,159 @@
+"""Global bounded message pool — the TPU replacement for the future-event set.
+
+The reference delivers packets by inserting them into OMNeT++'s
+future-event set one at a time (`sendDirect`, SimpleUDP.cc:418).  Here all
+in-flight packets live in one structure-of-arrays pool of P slots; each
+simulation tick:
+
+  * the due messages (deliver time inside the tick window) are grouped by
+    destination into a fixed-width inbox index table via one lexicographic
+    sort (dst, t_deliver) — O(P log P) on the whole batch instead of a heap
+    pop per message;
+  * delivered slots are freed, and the tick's outbox is written into free
+    slots with a second sort-based allocation.
+
+Messages that overflow a node's R inbox slots in one window simply stay in
+the pool and deliver next tick (receive-queue backpressure).  Pool
+exhaustion is counted, never silent (SURVEY.md §7.2 "no silent truncation").
+
+A message carries: src/dst slot, kind, a key, a nonce, hop count, four i32
+payload scalars, and a node-list payload of RMAX slot indices (the
+FindNodeResponse closest-node set, CommonMessages.msg:246-262, travels as
+slot indices — node keys are recoverable from the global key table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MsgPool:
+    """All arrays [P, ...]."""
+
+    valid: jnp.ndarray      # [P] bool
+    t_deliver: jnp.ndarray  # [P] i64 ns
+    src: jnp.ndarray        # [P] i32
+    dst: jnp.ndarray        # [P] i32
+    kind: jnp.ndarray       # [P] i32
+    key: jnp.ndarray        # [P, KL] u32
+    nonce: jnp.ndarray      # [P] i32
+    hops: jnp.ndarray       # [P] i32
+    a: jnp.ndarray          # [P] i32
+    b: jnp.ndarray          # [P] i32
+    c: jnp.ndarray          # [P] i32
+    d: jnp.ndarray          # [P] i32
+    nodes: jnp.ndarray      # [P, RMAX] i32 (NO_NODE padded)
+    size_b: jnp.ndarray     # [P] i32 payload bytes (for delay model + stats)
+
+    @property
+    def capacity(self):
+        return self.valid.shape[0]
+
+
+FIELDS = ("t_deliver", "src", "dst", "kind", "key", "nonce", "hops",
+          "a", "b", "c", "d", "nodes", "size_b")
+
+
+def empty(p: int, key_lanes: int, rmax: int) -> MsgPool:
+    return MsgPool(
+        valid=jnp.zeros((p,), bool),
+        t_deliver=jnp.full((p,), T_INF, I64),
+        src=jnp.full((p,), NO_NODE, I32),
+        dst=jnp.full((p,), NO_NODE, I32),
+        kind=jnp.zeros((p,), I32),
+        key=jnp.zeros((p, key_lanes), U32),
+        nonce=jnp.zeros((p,), I32),
+        hops=jnp.zeros((p,), I32),
+        a=jnp.zeros((p,), I32), b=jnp.zeros((p,), I32),
+        c=jnp.zeros((p,), I32), d=jnp.zeros((p,), I32),
+        nodes=jnp.full((p, rmax), NO_NODE, I32),
+        size_b=jnp.zeros((p,), I32),
+    )
+
+
+def next_deliver_time(pool: MsgPool):
+    """Earliest pending deliver time (i64; T_INF when pool empty)."""
+    return jnp.min(jnp.where(pool.valid, pool.t_deliver, T_INF))
+
+
+def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive):
+    """Group due messages by destination into an index table.
+
+    Returns:
+      inbox: [N, R] i32 pool indices, -1 for empty slots, ordered by
+             deliver time within each row.
+      delivered: [P] bool — messages placed into the inbox this tick.
+      dropped_dead: [P] bool — messages due for a dead node (freed, counted;
+             reference drops these as "dest unavailable", SimpleUDP.cc:307).
+    """
+    p = pool.capacity
+    due = pool.valid & (pool.t_deliver < t_end)
+    to_dead = due & ~alive[jnp.clip(pool.dst, 0, n - 1)]
+    due = due & ~to_dead
+
+    dst_k = jnp.where(due, pool.dst, n).astype(I32)
+    t_k = jnp.where(due, pool.t_deliver, T_INF)
+    idx = jnp.arange(p, dtype=I32)
+    dst_s, _, idx_s = jax.lax.sort((dst_k, t_k, idx), dimension=0, num_keys=2)
+
+    # rank of each message within its destination group
+    first = jnp.searchsorted(dst_s, dst_s, side="left").astype(I32)
+    rank = jnp.arange(p, dtype=I32) - first
+    take = (dst_s < n) & (rank < r)
+
+    rows = jnp.where(take, dst_s, n)  # row n is out-of-bounds -> dropped
+    inbox = jnp.full((n, r), NO_NODE, I32).at[rows, jnp.minimum(rank, r - 1)].set(
+        idx_s, mode="drop")
+    delivered = jnp.zeros((p,), bool).at[idx_s].set(take)
+    return inbox, delivered, to_dead
+
+
+def free(pool: MsgPool, mask) -> MsgPool:
+    return dataclasses.replace(
+        pool,
+        valid=pool.valid & ~mask,
+        t_deliver=jnp.where(mask, T_INF, pool.t_deliver))
+
+
+def alloc(pool: MsgPool, out: dict, want):
+    """Write the tick's outbox into free pool slots.
+
+    ``out`` maps field name -> [Q, ...] flattened outbox arrays;
+    ``want`` is [Q] bool.  Returns (pool', overflow_count).
+    """
+    p = pool.capacity
+    q = want.shape[0]
+    n_want = jnp.sum(want.astype(I32))
+    n_free = jnp.sum((~pool.valid).astype(I32))
+
+    # j-th wanted message  <-  j-th free slot
+    _, wsrc = jax.lax.sort(
+        (jnp.where(want, 0, 1).astype(I32), jnp.arange(q, dtype=I32)), num_keys=1)
+    _, fslot = jax.lax.sort(
+        (jnp.where(pool.valid, 1, 0).astype(I32), jnp.arange(p, dtype=I32)),
+        num_keys=1)
+
+    k = min(p, q)
+    j = jnp.arange(k, dtype=I32)
+    ok = (j < n_want) & (j < n_free)
+    slots = jnp.where(ok, fslot[:k], p)  # p = out-of-bounds, dropped
+    srcs = wsrc[:k]
+
+    new = {}
+    for name in FIELDS:
+        cur = getattr(pool, name)
+        new[name] = cur.at[slots].set(out[name][srcs], mode="drop")
+    valid = pool.valid.at[slots].set(True, mode="drop")
+    overflow = jnp.maximum(n_want - n_free, 0)
+    return MsgPool(valid=valid, **new), overflow

@@ -1,0 +1,104 @@
+"""Global statistics collection with measurement-phase gating.
+
+TPU-native equivalent of the reference's ``GlobalStatistics`` singleton
+(src/common/GlobalStatistics.{h,cc}): named StdDev accumulators
+(``addStdDev`` :97), histograms (:103) and the measurement gating that only
+records after init + transition phases finish (``startMeasuring`` :113-118,
+RECORD_STATS macro GlobalStatistics.h:35-39).  Instead of per-call mutexed
+accumulators, per-node handler code emits (value, mask) event arrays and
+the engine folds them in with masked reductions each tick.
+
+Scalar accumulators keep (n, sum, sumsq, min, max) so finish() can report
+name.mean/.stddev/.min/.max exactly like GlobalStatistics::finish
+(GlobalStatistics.cc:107-145).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+F32 = jnp.float32
+F64 = jnp.float64  # accumulators: f32 would silently drop increments >2^24
+I64 = jnp.int64
+
+
+@dataclasses.dataclass(frozen=True)
+class StatSpec:
+    """Static declaration of a simulation's metric namespace."""
+
+    scalars: tuple = ()            # names of StdDev-style accumulators
+    hists: tuple = ()              # (name, num_bins) pairs
+    counters: tuple = ()           # monotonically increasing counts
+
+
+def init_stats(spec: StatSpec) -> dict:
+    s = {}
+    for name in spec.scalars:
+        s["s:" + name] = jnp.zeros((5,), F64).at[3].set(jnp.inf).at[4].set(-jnp.inf)
+    for name, bins in spec.hists:
+        s["h:" + name] = jnp.zeros((bins,), I64)
+    for name in spec.counters:
+        s["c:" + name] = jnp.zeros((), I64)
+    return s
+
+
+def record(stats: dict, events: dict, gate) -> dict:
+    """Fold one tick's events into the accumulators.
+
+    ``events`` maps "s:name" -> (values, mask), "h:name" -> (bin_idx, mask),
+    "c:name" -> count; ``gate`` is the measurement-phase flag (scalar bool).
+    """
+    out = dict(stats)
+    for key, ev in events.items():
+        if key.startswith("s:"):
+            vals, mask = ev
+            vals = vals.astype(F64)
+            m = (mask & gate).astype(F64)
+            acc = out[key]
+            n = jnp.sum(m)
+            out[key] = jnp.stack([
+                acc[0] + n,
+                acc[1] + jnp.sum(vals * m),
+                acc[2] + jnp.sum(vals * vals * m),
+                jnp.minimum(acc[3], jnp.min(jnp.where(m > 0, vals, jnp.inf))),
+                jnp.maximum(acc[4], jnp.max(jnp.where(m > 0, vals, -jnp.inf))),
+            ])
+        elif key.startswith("h:"):
+            idx, mask = ev
+            acc = out[key]
+            bins = acc.shape[0]
+            idx = jnp.clip(idx, 0, bins - 1).ravel()
+            add = (mask & gate).astype(I64).ravel()
+            out[key] = acc.at[idx].add(add)
+        elif key.startswith("c:"):
+            out[key] = out[key] + jnp.sum(jnp.asarray(ev, I64)) * gate.astype(I64)
+        else:
+            raise KeyError(f"unknown stat class: {key}")
+    return out
+
+
+def summarize(stats: dict) -> dict:
+    """Host-side: accumulators -> {name: {mean, stddev, min, max, count}} /
+    histograms -> list / counters -> int (GlobalStatistics::finish style)."""
+    out = {}
+    for key, val in stats.items():
+        import numpy as np
+        v = np.asarray(val)
+        name = key[2:]
+        if key.startswith("s:"):
+            n, s, s2 = float(v[0]), float(v[1]), float(v[2])
+            mean = s / n if n else math.nan
+            var = max(s2 / n - mean * mean, 0.0) if n else math.nan
+            out[name] = {
+                "count": int(n), "mean": mean, "stddev": math.sqrt(var) if n else math.nan,
+                "min": float(v[3]) if n else math.nan,
+                "max": float(v[4]) if n else math.nan,
+            }
+        elif key.startswith("h:"):
+            out[name] = v.tolist()
+        else:
+            out[name] = int(v)
+    return out

@@ -1,0 +1,186 @@
+"""Analytic underlay network model (vectorized SimpleUnderlay).
+
+TPU-native equivalent of the reference's SimpleUnderlay
+(src/underlay/simpleunderlay/): no packet-level simulation — every node has
+an N-dim coordinate and per-direction channel parameters, and the
+end-to-end delay of a packet is computed analytically:
+
+    delay = send-queue carry + tx bandwidth delay + tx access delay
+          + 0.001 * euclidean(coords_src, coords_dst)
+          + rx bandwidth delay + rx access delay
+          (+ positive half-normal jitter with sigma = jitter * delay)
+
+mirroring SimpleNodeEntry::calcDelay (SimpleNodeEntry.cc:155-195, the
+0.001 s/coord-unit constant at :186) and SimpleUDP::processMsgFromApp
+(SimpleUDP.cc:274-434: self-sends bypass the delay model, dest-unavailable
+and partition drops, jitter workaround loop).  Drops: send-queue overrun
+(calcDelay :169-180), bit errors from channel error rate, destination dead,
+node-type partition (GlobalNodeList::areNodeTypesConnected).
+
+All of it is computed for a whole ``[N, MOUT]`` outbox batch at once; the
+per-sender transmit-queue serialization (``tx.finished`` carry) becomes a
+cumulative sum along the outbox axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+I64 = jnp.int64
+F32 = jnp.float32
+NS = 1_000_000_000  # ns per second
+
+# Channel catalogue (reference: src/common/channels.ned:3-34).
+# columns: bandwidth bit/s, access delay s, bit error rate
+CHANNELS = {
+    "simple_ethernetline": (10e6, 0.0, 0.0),
+    "simple_ethernetline_lossy": (10e6, 0.0, 1e-5),
+    "simple_dsl": (1e6, 0.020, 0.0),
+    "simple_dsl_lossy": (1e6, 0.020, 1e-5),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class UnderlayParams:
+    """Static SimpleUnderlay configuration (simulations/default.ini:545-563)."""
+
+    dims: int = 2
+    field_size: float = 150.0          # default.ini:552
+    coord_delay_per_unit: float = 0.001  # s per coord unit, SimpleNodeEntry.cc:186
+    use_coordinate_based_delay: bool = True  # default.ini:547
+    constant_delay: float = 0.050      # fallback, default.ini:545
+    jitter: float = 0.1                # default.ini:549
+    send_queue_bytes: int = 1_000_000  # default.ini:553 "1MB"
+    channel_types: tuple = ("simple_ethernetline",)
+    header_bytes: int = 28             # UDP(8) + IP(20), SimpleUDP.cc:291
+
+    @property
+    def channel_table(self):
+        """[C, 3] float32 table of (bandwidth, access_delay, ber)."""
+        rows = [CHANNELS[c] for c in self.channel_types]
+        return jnp.asarray(rows, dtype=F32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class UnderlayState:
+    """Per-node underlay state, all arrays [N, ...]."""
+
+    coords: jnp.ndarray       # [N, D] f32
+    channel: jnp.ndarray      # [N] i32 index into channel_table
+    tx_finished: jnp.ndarray  # [N] i64 ns — when the send queue drains
+
+
+def init(rng: jax.Array, n: int, p: UnderlayParams) -> UnderlayState:
+    """Random coordinates in the field, random channel type per node
+    (reference: SimpleUnderlayConfigurator.cc:143-184 draws coords from the
+    pool and the channel type uniformly from churnGenerator channelTypes)."""
+    ck, xk = jax.random.split(rng)
+    coords = jax.random.uniform(
+        xk, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
+    channel = jax.random.randint(ck, (n,), 0, len(p.channel_types), dtype=jnp.int32)
+    return UnderlayState(coords=coords, channel=channel,
+                         tx_finished=jnp.zeros((n,), dtype=I64))
+
+
+def migrate(state: UnderlayState, mask, rng, p: UnderlayParams) -> UnderlayState:
+    """Redraw coordinates for masked nodes (node create / IP migration;
+    reference SimpleUnderlayConfigurator::migrateNode)."""
+    n = state.coords.shape[0]
+    new_coords = jax.random.uniform(
+        rng, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
+    coords = jnp.where(mask[:, None], new_coords, state.coords)
+    tx_finished = jnp.where(mask, jnp.int64(0), state.tx_finished)
+    return UnderlayState(coords=coords, channel=state.channel,
+                         tx_finished=tx_finished)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def send_batch(state: UnderlayState, p: UnderlayParams, rng,
+               src, dst, size_bytes, t_send, want, alive):
+    """Compute deliver times and drop decisions for an outbox batch.
+
+    Args:
+      src, dst: [N, M] i32 sender/receiver slots (src row i is node i).
+      size_bytes: [N, M] i32 payload bytes (headers added here).
+      t_send: [N, M] i64 ns logical send times.
+      want: [N, M] bool — slot actually carries a message.
+      alive: [N] bool.
+
+    Returns (t_deliver [N,M] i64, ok [N,M] bool, new_state, drop_stats dict).
+    Messages with ok=False are dropped (queue overrun / bit error / dest
+    dead); t_deliver for self-sends is t_send (SimpleUDP.cc:322 skips the
+    delay model when srcAddr == destAddr).
+    """
+    n, m = src.shape
+    tbl = p.channel_table
+    bits = (size_bytes + p.header_bytes) * 8
+
+    tx_bw = tbl[state.channel, 0][:, None]           # [N,1] sender bandwidth
+    tx_access = tbl[state.channel, 1][:, None]
+    tx_ber = tbl[state.channel, 2][:, None]
+    rx_bw = tbl[state.channel[dst], 0]               # [N,M] receiver side
+    rx_access = tbl[state.channel[dst], 1]
+    rx_ber = tbl[state.channel[dst], 2]
+
+    self_send = src == dst
+    queued = want & ~self_send
+
+    # --- sender transmit queue (SimpleNodeEntry.cc:163-181) ---
+    # Serialize this tick's messages through the sender's queue in outbox
+    # order: finish_j = max(tx_finished, t_send_j) + cumsum(bw_delay).
+    bw_delay_ns = jnp.where(queued, (bits.astype(F32) / tx_bw * NS), 0.0).astype(I64)
+    # start of service for each message: queue may already be busy
+    start0 = jnp.maximum(state.tx_finished[:, None], t_send)
+    # cumulative: each message waits for all previous *sent* messages this tick
+    cum = jnp.cumsum(bw_delay_ns, axis=1)
+    finish = start0 + cum  # monotone approx: uses first msg's start for all
+    # queue bound in bytes per the sender's own channel bandwidth
+    # (SimpleNodeEntry.cc:169-180: maxQueueTime = queueBytes*8/bandwidth)
+    max_queue_ns = (jnp.float32(p.send_queue_bytes * 8) / tx_bw * NS).astype(I64)
+    overrun = queued & (finish - t_send > max_queue_ns)
+    new_tx_finished = jnp.where(
+        jnp.any(queued & ~overrun, axis=1),
+        jnp.max(jnp.where(queued & ~overrun, finish, 0), axis=1),
+        state.tx_finished)
+
+    # --- propagation: coordinate distance (SimpleNodeEntry.cc:144-152) ---
+    d = state.coords[:, None, :] - state.coords[dst]          # [N, M, D]
+    dist = jnp.sqrt(jnp.sum(d * d, axis=-1))
+    coord_delay = p.coord_delay_per_unit * dist
+
+    rx_delay = bits.astype(F32) / rx_bw
+
+    if p.use_coordinate_based_delay:
+        total_ns = (finish - t_send) + (
+            (tx_access + coord_delay + rx_delay + rx_access) * NS).astype(I64)
+    else:
+        total_ns = jnp.full((n, m), jnp.int64(p.constant_delay * NS))
+
+    # --- jitter: positive half-normal, sigma = jitter * delay
+    # (SimpleUDP.cc:360-373 truncnormal(0, delay*jitter)) ---
+    if p.jitter > 0:
+        jit = jnp.abs(jax.random.normal(rng, (n, m), dtype=F32))
+        total_ns = total_ns + (jit * p.jitter * total_ns.astype(F32)).astype(I64)
+
+    # --- drops ---
+    bit_err_p = 1.0 - (1.0 - tx_ber) ** bits * (1.0 - rx_ber) ** bits
+    u = jax.random.uniform(jax.random.fold_in(rng, 1), (n, m), dtype=F32)
+    bit_error = queued & (u < bit_err_p)
+    dest_dead = want & ~alive[dst]
+
+    ok = want & ~overrun & ~bit_error & ~dest_dead
+    t_deliver = jnp.where(self_send, t_send, t_send + total_ns)
+
+    new_state = UnderlayState(coords=state.coords, channel=state.channel,
+                              tx_finished=new_tx_finished)
+    drops = {
+        "queue_lost": jnp.sum(overrun & want),
+        "bit_error_lost": jnp.sum(bit_error),
+        "dest_unavailable_lost": jnp.sum(dest_dead),
+    }
+    return t_deliver, ok, new_state, drops

@@ -1,0 +1,18 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Real TPU hardware in this environment is a single chip; multi-chip sharding
+is validated on XLA's host-platform virtual devices (same compiler path).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_enable_x64", True)

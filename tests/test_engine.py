@@ -1,0 +1,216 @@
+"""Engine smoke tests: pool mechanics + a full ping/pong simulation.
+
+The PingLogic below is the minimal per-node protocol: every node pings a
+random ready node once a second; the receiver echoes.  It exercises every
+engine subsystem — timers, horizon stepping, inbox grouping, outbox
+allocation, underlay delays, stats — without any overlay logic on top.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.core import keys as keys_mod
+from oversim_tpu.engine import pool as pool_mod
+from oversim_tpu.engine.logic import Msg, Outbox, T_INF
+from oversim_tpu.engine.sim import EngineParams, Simulation
+from oversim_tpu.underlay import simple as underlay_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+
+KIND_PING = 1
+KIND_PONG = 2
+
+
+# ---------------------------------------------------------------------------
+# pool unit tests
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_inbox_free_roundtrip():
+    p = pool_mod.empty(16, key_lanes=5, rmax=4)
+    q = 6
+    out = {
+        "t_deliver": jnp.asarray([5, 3, 9, 7, 1, 100], I64),
+        "src": jnp.asarray([0, 1, 2, 3, 4, 5], I32),
+        "dst": jnp.asarray([2, 2, 2, 1, 1, 0], I32),
+        "kind": jnp.full((q,), 7, I32),
+        "key": jnp.zeros((q, 5), jnp.uint32),
+        "nonce": jnp.arange(q, dtype=I32),
+        "hops": jnp.zeros((q,), I32),
+        "a": jnp.zeros((q,), I32), "b": jnp.zeros((q,), I32),
+        "c": jnp.zeros((q,), I32), "d": jnp.zeros((q,), I32),
+        "nodes": jnp.full((q, 4), -1, I32),
+        "size_b": jnp.zeros((q,), I32),
+    }
+    want = jnp.asarray([True, True, True, True, True, True])
+    p, overflow = pool_mod.alloc(p, out, want)
+    assert int(overflow) == 0
+    assert int(jnp.sum(p.valid)) == 6
+
+    # window [0, 10): all but the t=100 message are due
+    alive = jnp.ones((3,), bool)
+    inbox, delivered, to_dead = pool_mod.build_inbox(
+        p, n=3, r=2, t_end=jnp.int64(10), alive=alive)
+    assert int(jnp.sum(delivered)) == 4  # node2 gets 2 of its 3 (R=2), node1 two
+    assert int(jnp.sum(to_dead)) == 0
+    # node 2's two slots must be its earliest msgs (t=3 then t=5)
+    row2 = np.asarray(inbox[2])
+    ts = np.asarray(p.t_deliver)[row2]
+    assert list(ts) == [3, 5]
+    # node 0 has nothing due (its msg is t=100)
+    assert np.asarray(inbox[0] == -1).all()
+
+    p2 = pool_mod.free(p, delivered)
+    assert int(jnp.sum(p2.valid)) == 2
+    # next tick: node 2's deferred third message (t=9) arrives
+    inbox2, delivered2, _ = pool_mod.build_inbox(
+        p2, n=3, r=2, t_end=jnp.int64(10), alive=alive)
+    ts2 = np.asarray(p2.t_deliver)[np.asarray(inbox2[2])]
+    assert ts2[0] == 9
+
+
+def test_pool_overflow_counted():
+    p = pool_mod.empty(4, key_lanes=5, rmax=4)
+    q = 6
+    out = {k: (jnp.zeros((q, 5), jnp.uint32) if k == "key" else
+               jnp.full((q, 4), -1, I32) if k == "nodes" else
+               jnp.zeros((q,), I64 if k == "t_deliver" else I32))
+           for k in pool_mod.FIELDS}
+    p, overflow = pool_mod.alloc(p, out, jnp.ones((q,), bool))
+    assert int(overflow) == 2
+    assert int(jnp.sum(p.valid)) == 4
+
+
+# ---------------------------------------------------------------------------
+# ping/pong end-to-end
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PingState:
+    t_ping: jnp.ndarray   # [N] i64 next ping timer
+    t_sent: jnp.ndarray   # [N] i64 time of outstanding ping
+    ready: jnp.ndarray    # [N] bool
+
+
+class PingLogic:
+    key_spec = keys_mod.KeySpec(160)
+    interval_ns = 1 * NS
+
+    def stat_spec(self):
+        return stats_mod.StatSpec(
+            scalars=("ping.rtt",),
+            hists=(("ping.rttBins", 8),),
+            counters=("ping.sent", "pong.received"))
+
+    def init(self, rng, n):
+        return PingState(
+            t_ping=jnp.full((n,), T_INF, I64),
+            t_sent=jnp.zeros((n,), I64),
+            ready=jnp.zeros((n,), bool))
+
+    def reset(self, state, clear, join, t_now, rng):
+        jitter = jax.random.randint(
+            rng, clear.shape, 0, self.interval_ns, dtype=I64)
+        return PingState(
+            t_ping=jnp.where(join, t_now + jitter,
+                             jnp.where(clear, T_INF, state.t_ping)),
+            t_sent=jnp.where(clear, 0, state.t_sent),
+            ready=jnp.where(clear, join, state.ready))
+
+    def ready_mask(self, state):
+        return state.ready
+
+    def next_event(self, state):
+        return state.t_ping
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        out = Outbox(outbox_slots, self.key_spec.lanes, rmax)
+        rtt_vals = jnp.zeros((msgs.valid.shape[0],), jnp.float32)
+        rtt_mask = jnp.zeros((msgs.valid.shape[0],), bool)
+        pongs = jnp.int32(0)
+
+        # inbox
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            is_ping = m.valid & (m.kind == KIND_PING)
+            out.send(is_ping, m.t_deliver, m.src, KIND_PONG, nonce=m.nonce,
+                     size_b=40)
+            is_pong = m.valid & (m.kind == KIND_PONG)
+            rtt = (m.t_deliver - st.t_sent).astype(jnp.float32) / NS
+            rtt_vals = rtt_vals.at[r].set(rtt)
+            rtt_mask = rtt_mask.at[r].set(is_pong)
+            pongs += is_pong.astype(I32)
+
+        # ping timer
+        due = st.t_ping < ctx.t_end
+        dst = ctx.sample_ready(rng)
+        fire = due & (dst >= 0) & (dst != node_idx)
+        out.send(fire, st.t_ping, dst, KIND_PING, nonce=node_idx, size_b=40)
+        st = dataclasses.replace(
+            st,
+            t_ping=jnp.where(due, st.t_ping + self.interval_ns, st.t_ping),
+            t_sent=jnp.where(fire, st.t_ping, st.t_sent))
+
+        events = {
+            "s:ping.rtt": (rtt_vals, rtt_mask),
+            "h:ping.rttBins": ((rtt_vals * 20).astype(I32), rtt_mask),
+            "c:ping.sent": fire.astype(I32),
+            "c:pong.received": pongs,
+        }
+        return st, out, events
+
+
+def make_sim(n=16, window=0.010):
+    logic = PingLogic()
+    cp = churn_mod.ChurnParams(model="none", target_num=n, init_interval=0.1)
+    ep = EngineParams(window=window, inbox_slots=4, outbox_slots=8,
+                      pool_factor=8, rmax=4)
+    return Simulation(logic, cp, underlay_mod.UnderlayParams(), ep)
+
+
+def test_ping_pong_end_to_end():
+    sim = make_sim(n=16)
+    s = sim.init(seed=3)
+    s = sim.run_until(s, t_sim=10.0, chunk=64)
+    out = sim.summary(s)
+
+    assert out["_alive"] == 16
+    assert out["ping.sent"] > 50
+    # most pings must be answered (no churn, no loss on ethernet channel)
+    assert out["pong.received"] >= 0.9 * out["ping.sent"] - 16
+    rtt = out["ping.rtt"]
+    assert rtt["count"] == out["pong.received"]
+    # RTT plausibility: 2 * (coord delay within 150x150 field + tx delays)
+    # max coord distance ~212 units -> one-way <= ~0.25s + jitter
+    assert 0.0005 < rtt["mean"] < 0.5
+    assert out["_engine"]["pool_overflow"] == 0
+    assert out["_engine"]["outbox_overflow"] == 0
+    assert out["_engine"]["dest_unavailable_lost"] == 0
+
+
+def test_ping_rtt_matches_analytic_delay():
+    """With jitter off, RTT between two specific nodes must equal twice the
+    calcDelay formula (SimpleNodeEntry.cc:155-195)."""
+    logic = PingLogic()
+    cp = churn_mod.ChurnParams(model="none", target_num=2, init_interval=0.01)
+    up = underlay_mod.UnderlayParams(jitter=0.0)
+    ep = EngineParams(window=0.001, inbox_slots=2, outbox_slots=4, rmax=4)
+    sim = Simulation(logic, cp, up, ep)
+    s = sim.init(seed=5)
+    s = sim.run_until(s, t_sim=5.0, chunk=64)
+    out = sim.summary(s)
+
+    coords = np.asarray(s.underlay.coords)
+    dist = np.linalg.norm(coords[0] - coords[1])
+    bits = (40 + 28) * 8
+    one_way = bits / 10e6 + 0.001 * dist + bits / 10e6
+    rtt = out["ping.rtt"]
+    assert rtt["count"] > 0
+    np.testing.assert_allclose(rtt["mean"], 2 * one_way, rtol=0.02)

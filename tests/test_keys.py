@@ -1,0 +1,164 @@
+"""Key-core parity tests: packed-lane ops vs python bignum ground truth.
+
+Mirrors the semantics of the reference's OverlayKey (src/common/OverlayKey.cc):
+modular ring arithmetic, interval tests, prefix lengths, metrics.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oversim_tpu.core import keys as K
+
+SPECS = [K.KeySpec(160), K.KeySpec(512), K.KeySpec(100), K.KeySpec(32), K.KeySpec(17)]
+
+
+def rand_ints(spec, n, seed):
+    r = random.Random(seed)
+    edge = [0, 1, (1 << spec.bits) - 1, (1 << spec.bits) // 2]
+    vals = edge + [r.getrandbits(spec.bits) for _ in range(n - len(edge))]
+    return vals[:n]
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: f"bits{s.bits}")
+def test_roundtrip(spec):
+    for v in rand_ints(spec, 16, 1):
+        assert K.to_int(K.from_int(v, spec), spec) == v
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: f"bits{s.bits}")
+def test_add_sub_mod(spec):
+    m = 1 << spec.bits
+    avals = rand_ints(spec, 12, 2)
+    bvals = rand_ints(spec, 12, 3)
+    a = jnp.stack([K.from_int(v, spec) for v in avals])
+    b = jnp.stack([K.from_int(v, spec) for v in bvals])
+    s = K.add(a, b, spec)
+    d = K.sub(a, b, spec)
+    for i, (av, bv) in enumerate(zip(avals, bvals)):
+        assert K.to_int(s[i], spec) == (av + bv) % m
+        assert K.to_int(d[i], spec) == (av - bv) % m
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: f"bits{s.bits}")
+def test_compare(spec):
+    avals = rand_ints(spec, 12, 4)
+    bvals = rand_ints(spec, 12, 5)
+    bvals[0] = avals[0]  # force an equal pair
+    a = jnp.stack([K.from_int(v, spec) for v in avals])
+    b = jnp.stack([K.from_int(v, spec) for v in bvals])
+    np.testing.assert_array_equal(
+        np.asarray(K.lt(a, b)), np.array([x < y for x, y in zip(avals, bvals)]))
+    np.testing.assert_array_equal(
+        np.asarray(K.gt(a, b)), np.array([x > y for x, y in zip(avals, bvals)]))
+    np.testing.assert_array_equal(
+        np.asarray(K.eq(a, b)), np.array([x == y for x, y in zip(avals, bvals)]))
+
+
+@pytest.mark.parametrize("spec", [K.KeySpec(160), K.KeySpec(32)],
+                         ids=lambda s: f"bits{s.bits}")
+def test_is_between(spec):
+    m = 1 << spec.bits
+    r = random.Random(7)
+    cases = []
+    for _ in range(200):
+        cases.append((r.getrandbits(spec.bits), r.getrandbits(spec.bits),
+                      r.getrandbits(spec.bits)))
+    # edge cases incl. wraparound and degenerate intervals
+    cases += [(5, 5, 5), (5, 5, 9), (5, 9, 5), (0, m - 1, 1), (m - 1, m - 2, 0)]
+    key = jnp.stack([K.from_int(c[0], spec) for c in cases])
+    a = jnp.stack([K.from_int(c[1], spec) for c in cases])
+    b = jnp.stack([K.from_int(c[2], spec) for c in cases])
+
+    def py_between(k, x, y):  # open interval on the ring, ref semantics
+        if x == y:
+            return k != x
+        return 0 < (k - x) % m < (y - x) % m
+
+    expect = np.array([py_between(*c) for c in cases])
+    np.testing.assert_array_equal(np.asarray(K.is_between(key, a, b, spec)), expect)
+    expect_r = np.array([py_between(*c) or c[0] == c[2] for c in cases])
+    np.testing.assert_array_equal(np.asarray(K.is_between_r(key, a, b, spec)), expect_r)
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: f"bits{s.bits}")
+def test_shared_prefix_length(spec):
+    r = random.Random(9)
+    pairs = []
+    for plen in [0, 1, spec.bits // 2, spec.bits - 1, spec.bits]:
+        a = r.getrandbits(spec.bits)
+        if plen == spec.bits:
+            b = a
+        else:
+            # force first differing bit exactly at position plen from MSB
+            flip = 1 << (spec.bits - 1 - plen)
+            b = a ^ flip ^ (r.getrandbits(spec.bits) & (flip - 1))
+        pairs.append((a, b, plen))
+    a = jnp.stack([K.from_int(p[0], spec) for p in pairs])
+    b = jnp.stack([K.from_int(p[1], spec) for p in pairs])
+    got = np.asarray(K.shared_prefix_length(a, b, spec))
+    np.testing.assert_array_equal(got, np.array([p[2] for p in pairs]))
+
+
+def test_bit_indexing():
+    spec = K.KeySpec(160)
+    v = 0b1011 << 77 | 1
+    k = K.from_int(v, spec)
+    idx = jnp.arange(spec.bits)
+    bits = np.asarray(jax.vmap(lambda i: K.bit(k, i, spec))(idx))
+    expect = np.array([(v >> i) & 1 for i in range(spec.bits)])
+    np.testing.assert_array_equal(bits, expect)
+
+
+def test_ring_distance_and_metrics():
+    spec = K.KeySpec(160)
+    m = 1 << 160
+    a, b = 1234567, m - 999
+    ka, kb = K.from_int(a, spec), K.from_int(b, spec)
+    assert K.to_int(K.ring_distance(ka, kb, spec), spec) == (b - a) % m
+    assert K.to_int(K.cw_ring_distance(ka, kb, spec), spec) == (a - b) % m
+    assert K.to_int(K.xor_metric(ka, kb), spec) == a ^ b
+    bd = K.to_int(K.bidir_ring_distance(ka, kb, spec), spec)
+    assert bd == min((b - a) % m, (a - b) % m)
+
+
+def test_random_keys_masked_and_distinct():
+    spec = K.KeySpec(100)
+    ks = K.random_keys(jax.random.PRNGKey(0), (64,), spec)
+    vals = [K.to_int(ks[i], spec) for i in range(64)]
+    assert all(0 <= v < (1 << 100) for v in vals)
+    assert len(set(vals)) == 64  # collisions astronomically unlikely
+
+
+def test_sort_by_distance_topk():
+    spec = K.KeySpec(160)
+    r = random.Random(11)
+    target = r.getrandbits(160)
+    cand = [r.getrandbits(160) for _ in range(32)]
+    tk = K.from_int(target, spec)
+    ck = jnp.stack([K.from_int(c, spec) for c in cand])
+    dist = K.ring_distance(jnp.broadcast_to(tk, ck.shape), ck, spec)
+    idx = jnp.arange(32, dtype=jnp.int32)
+    _, (order,) = K.sort_by_distance(dist, (idx,))
+    m = 1 << 160
+    expect = sorted(range(32), key=lambda i: (cand[i] - target) % m)
+    np.testing.assert_array_equal(np.asarray(order), np.array(expect, dtype=np.int32))
+
+
+def test_log2_floor():
+    spec = K.KeySpec(160)
+    vals = [0, 1, 2, 3, 4, 1 << 80, (1 << 159) + 5]
+    ks = jnp.stack([K.from_int(v, spec) for v in vals])
+    got = np.asarray(K.log2_floor(ks, spec))
+    expect = np.array([v.bit_length() - 1 for v in vals], dtype=np.int32)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sha1_key_matches_hashlib():
+    import hashlib
+    spec = K.KeySpec(160)
+    v = int.from_bytes(hashlib.sha1(b"oversim").digest(), "big")
+    assert K.to_int(K.sha1_key(b"oversim", spec), spec) == v
