@@ -1,0 +1,95 @@
+"""KBRTestApp — the reference's benchmark workload, vectorized.
+
+Rebuild of src/applications/kbrtestapp/KBRTestApp.{h,cc}: each node
+periodically (testMsgInterval=60s, default.ini:38) routes a one-way test
+message to a key drawn from a random live node's nodeId
+(lookupNodeIds=true, default.ini:40; KBRTestApp::createDestKey).  The
+receiving node checks it is actually responsible for the key and records
+delivery, hop count and latency; wrong-node deliveries count as failures
+(KBRTestApp.cc:252-292).  Delivery ratio = delivered/sent is THE headline
+KPI (GlobalStatistics sentKBRTestAppMessages/deliveredKBRTestAppMessages,
+GlobalStatistics.h:79-80).
+
+The app is a passive strategy object used by the overlay logic: the
+overlay calls the hooks below from inside its vmapped per-node step and
+runs the actual lookups/routing.  RPC and lookup test modes
+(kbrRpcTest/kbrLookupTest, off by default) are TODO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class KbrTestParams:
+    test_interval: float = 60.0     # testMsgInterval, default.ini:38
+    test_msg_bytes: int = 100       # testMsgSize, default.ini:37
+    hop_hist_bins: int = 16
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KbrTestState:
+    t_test: jnp.ndarray   # [] i64 per node — next one-way test
+    seq: jnp.ndarray      # [] i32 — sequence number
+
+
+def init(n: int) -> KbrTestState:
+    return KbrTestState(t_test=jnp.full((n,), T_INF, I64),
+                        seq=jnp.zeros((n,), I32))
+
+
+STAT_SCALARS = ("kbr_hopcount", "kbr_latency_s")
+STAT_COUNTERS = ("kbr_sent", "kbr_delivered", "kbr_wrong_node",
+                 "kbr_lookup_failed")
+
+
+def stat_spec(p: KbrTestParams):
+    return dict(scalars=STAT_SCALARS,
+                hists=(("kbr_hop_hist", p.hop_hist_bins),),
+                counters=STAT_COUNTERS)
+
+
+# -- per-node hooks (used inside the overlay's vmapped step) ---------------
+
+def on_ready(app: KbrTestState, en, now, rng, p: KbrTestParams):
+    """Overlay became READY: schedule the first test after a uniform offset
+    (reference: BaseApp periodicTimer starts uniform(0, testMsgInterval))."""
+    off = jax.random.uniform(rng, (), minval=0.0, maxval=p.test_interval)
+    t = now + (off * NS).astype(I64)
+    return dataclasses.replace(app, t_test=jnp.where(en, t, app.t_test))
+
+
+def on_stop(app: KbrTestState, en):
+    """Node left / lost READY: park the timer."""
+    return dataclasses.replace(app,
+                               t_test=jnp.where(en, T_INF, app.t_test))
+
+
+def on_timer(app: KbrTestState, en, ctx, now, rng, p: KbrTestParams):
+    """Fire the periodic one-way test.  Returns
+    (app', want_route bool, dest_key [KL], seq i32): the overlay starts an
+    iterative lookup for dest_key and sends the payload to the sibling."""
+    dest = ctx.sample_ready(rng)
+    dest_key = ctx.keys[jnp.maximum(dest, 0)]
+    want = en & (dest != NO_NODE)
+    app = dataclasses.replace(
+        app,
+        t_test=jnp.where(en, now + jnp.int64(int(p.test_interval * NS)),
+                         app.t_test),
+        seq=app.seq + en.astype(I32))
+    return app, want, dest_key, app.seq
+
+
+def next_event(app: KbrTestState):
+    return app.t_test

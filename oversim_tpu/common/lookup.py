@@ -1,0 +1,364 @@
+"""Iterative KBR lookup engine as per-node state-machine arrays.
+
+TPU-native rebuild of the reference's IterativeLookup
+(src/common/IterativeLookup.{h,cc}): per lookup, a frontier of candidate
+next-hops is maintained and FindNode RPCs are issued to the closest
+unvisited candidate until a node answers with its sibling flag set
+(BaseOverlay::findNodeRpc sets `siblings` when the responder
+isSiblingFor the key, BaseOverlay.cc:1866-1871; a flagged non-empty
+response finishes the path, IterativeLookup.cc:893-902).
+
+Semantics implemented (default OverSim configuration, default.ini:420-433):
+  * lookupRedundantNodes=1, lookupParallelPaths=1, lookupParallelRpcs=1,
+    lookupMerge=false — each FindNodeResponse *replaces* the frontier
+    (IterativePathLookup::handleResponse clears nextHops when !merge,
+    IterativeLookup.cc:839-841) and the next RPC goes to the first entry.
+  * merge=true (Kademlia style) — response nodes are merged into the
+    frontier, kept sorted by a pluggable distance metric, capacity F
+    (BaseKeySortedVector semantics, NodeVector.h:40-44).
+  * lookupVisitOnlyOnce=true — a bounded visited ring buffer skips
+    re-queries (IterativePathLookup::sendRpc visited check).
+  * RPC timeout (rpcUdpTimeout=1.5s, default.ini:483) marks the queried
+    node failed and reports it to the overlay's handleFailedNode; the
+    global LOOKUP_TIMEOUT=10s (IterativeLookup.h:44) fails the lookup.
+  * Exhaustion (no unvisited candidate, nothing pending) fails the lookup
+    (IterativePathLookup::sendRpc "no further nodes to query").
+
+Every function operates on a SINGLE node's slice (the engine vmaps the
+whole per-node step); the L lookup slots of one node are a static axis.
+
+A lookup completion is recorded in the ``done/success/result`` fields and
+consumed by the owner (overlay logic) via ``take_completions`` — purpose
+dispatch (join / finger repair / app route) lives with the owner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NO_NODE = jnp.int32(-1)
+T_INF = jnp.int64(2**62)
+
+# frontier entry flags
+F_NEW = 0        # known, not queried
+F_PENDING = 1    # FindNodeCall in flight
+F_RESPONDED = 2
+F_FAILED = 3     # RPC timed out
+
+LOOKUP_TIMEOUT_NS = 10 * 1_000_000_000   # IterativeLookup.h:44
+RPC_TIMEOUT_NS = 1_500_000_000           # rpcUdpTimeout, default.ini:483
+MAX_HOPS = 32                            # engine bound (overflow-counted)
+
+
+@dataclasses.dataclass(frozen=True)
+class LookupConfig:
+    """Static knobs (reference: IterativeLookupConfiguration /
+    BaseOverlay.cc:140-160 `lookup*` params)."""
+
+    slots: int = 4          # L — concurrent lookups per node
+    frontier: int = 8       # F — candidate set width
+    visited: int = 16       # V — visited ring capacity
+    merge: bool = False     # lookupMerge
+    retries: int = 0        # lookupRetries... cut: fail directly
+    rpc_timeout_ns: int = RPC_TIMEOUT_NS
+    deadline_ns: int = LOOKUP_TIMEOUT_NS
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LookupState:
+    """One node's L lookup slots ([N, L, ...] at rest in the engine)."""
+
+    active: jnp.ndarray       # [L] bool
+    purpose: jnp.ndarray      # [L] i32 — owner-defined dispatch tag
+    aux: jnp.ndarray          # [L] i32 — owner payload (finger idx, seq, …)
+    target: jnp.ndarray       # [L, KL] u32
+    gen: jnp.ndarray          # [L] i32 — slot generation (stale-response guard)
+    frontier: jnp.ndarray     # [L, F] i32 node slots (NO_NODE padded)
+    fr_flags: jnp.ndarray     # [L, F] i32 F_* flags
+    visited: jnp.ndarray      # [L, V] i32
+    vis_n: jnp.ndarray        # [L] i32 visited write cursor
+    pending_dst: jnp.ndarray  # [L] i32 (NO_NODE = no RPC in flight)
+    t_to: jnp.ndarray         # [L] i64 — pending RPC timeout
+    deadline: jnp.ndarray     # [L] i64 — whole-lookup timeout
+    hops: jnp.ndarray         # [L] i32
+    t0: jnp.ndarray           # [L] i64 — start time
+    done: jnp.ndarray         # [L] bool — completed, not yet dispatched
+    success: jnp.ndarray      # [L] bool
+    result: jnp.ndarray       # [L] i32 — sibling node slot (NO_NODE on fail)
+    t_done: jnp.ndarray       # [L] i64 — completion time (next_event wake)
+
+
+def init(cfg: LookupConfig, kl: int) -> LookupState:
+    l, f, v = cfg.slots, cfg.frontier, cfg.visited
+    return LookupState(
+        active=jnp.zeros((l,), bool),
+        purpose=jnp.zeros((l,), I32),
+        aux=jnp.zeros((l,), I32),
+        target=jnp.zeros((l, kl), U32),
+        gen=jnp.zeros((l,), I32),
+        frontier=jnp.full((l, f), NO_NODE, I32),
+        fr_flags=jnp.zeros((l, f), I32),
+        visited=jnp.full((l, v), NO_NODE, I32),
+        vis_n=jnp.zeros((l,), I32),
+        pending_dst=jnp.full((l,), NO_NODE, I32),
+        t_to=jnp.full((l,), T_INF, I64),
+        deadline=jnp.full((l,), T_INF, I64),
+        hops=jnp.zeros((l,), I32),
+        t0=jnp.zeros((l,), I64),
+        done=jnp.zeros((l,), bool),
+        success=jnp.zeros((l,), bool),
+        result=jnp.full((l,), NO_NODE, I32),
+        t_done=jnp.full((l,), T_INF, I64),
+    )
+
+
+def free_slot(lk: LookupState):
+    """(slot index of a free lookup slot, have_free bool)."""
+    free = ~lk.active
+    return jnp.argmax(free).astype(I32), jnp.any(free)
+
+
+def num_free(lk: LookupState):
+    return jnp.sum((~lk.active).astype(I32))
+
+
+def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
+          now, cfg: LookupConfig) -> LookupState:
+    """Occupy ``slot`` with a new lookup (no RPC fired yet — ``pump`` does).
+
+    ``seed_nodes``: [F] i32 candidate slots from the owner's local
+    findNode() (IterativeLookup::start seeds nextHops from the local
+    routing state, IterativeLookup.cc:159).  If the seed is empty the
+    lookup will fail at the next pump (reference: empty local findNode →
+    path fails).
+    """
+    f = lk.frontier.shape[1]
+    slot = jnp.where(en, slot, jnp.int32(lk.active.shape[0]))  # OOB drop
+    seed = seed_nodes[:f]
+    return dataclasses.replace(
+        lk,
+        active=lk.active.at[slot].set(True, mode="drop"),
+        purpose=lk.purpose.at[slot].set(jnp.asarray(purpose, I32), mode="drop"),
+        aux=lk.aux.at[slot].set(jnp.asarray(aux, I32), mode="drop"),
+        target=lk.target.at[slot].set(target, mode="drop"),
+        gen=lk.gen.at[slot].add(1, mode="drop"),
+        frontier=lk.frontier.at[slot].set(seed, mode="drop"),
+        fr_flags=lk.fr_flags.at[slot].set(jnp.full((f,), F_NEW, I32),
+                                          mode="drop"),
+        visited=lk.visited.at[slot].set(
+            jnp.full((lk.visited.shape[1],), NO_NODE, I32), mode="drop"),
+        vis_n=lk.vis_n.at[slot].set(0, mode="drop"),
+        pending_dst=lk.pending_dst.at[slot].set(NO_NODE, mode="drop"),
+        t_to=lk.t_to.at[slot].set(T_INF, mode="drop"),
+        deadline=lk.deadline.at[slot].set(now + cfg.deadline_ns, mode="drop"),
+        hops=lk.hops.at[slot].set(0, mode="drop"),
+        t0=lk.t0.at[slot].set(now, mode="drop"),
+        done=lk.done.at[slot].set(False, mode="drop"),
+        success=lk.success.at[slot].set(False, mode="drop"),
+        result=lk.result.at[slot].set(NO_NODE, mode="drop"),
+        t_done=lk.t_done.at[slot].set(T_INF, mode="drop"),
+    )
+
+
+def _is_visited(lk: LookupState, l, node):
+    """node [F] i32 → [F] bool membership in slot l's visited ring."""
+    return jnp.any(lk.visited[l][None, :] == node[:, None], axis=1) & (
+        node != NO_NODE)
+
+
+def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
+    """Consume a FINDNODE_RES inbox message addressed to this node.
+
+    ``msg`` is a single-slot Msg view with a=lookup slot, b=generation,
+    c=siblings flag, nodes=[RMAX] closest-node payload.  ``metric_fn(nodes)
+    -> [K, KL]`` distances to the target (only used when cfg.merge).
+
+    Returns lk'.  Completion (sibling-flagged response) is recorded in
+    done/success/result (IterativeLookup.cc:893-902: flagged non-empty
+    response → path finished, returned nodes are the siblings).
+    """
+    l = jnp.clip(msg.a, 0, lk.active.shape[0] - 1)
+    ok = (msg.valid & lk.active[l] & (lk.gen[l] == msg.b) &
+          (lk.pending_dst[l] == msg.src) & ~lk.done[l])
+
+    f = lk.frontier.shape[1]
+    resp_nodes = msg.nodes[:f]
+    has_nodes = jnp.any(resp_nodes != NO_NODE)
+    is_sib = (msg.c != 0) & has_nodes
+
+    # clear pending state; count the hop (IterativeLookup.cc:825 hops++)
+    lk = dataclasses.replace(
+        lk,
+        pending_dst=lk.pending_dst.at[jnp.where(ok, l, lk.active.shape[0])].set(
+            NO_NODE, mode="drop"),
+        t_to=lk.t_to.at[jnp.where(ok, l, lk.active.shape[0])].set(
+            T_INF, mode="drop"),
+        hops=lk.hops.at[jnp.where(ok, l, lk.active.shape[0])].add(
+            1, mode="drop"))
+
+    # finished: responder was a sibling → result = first returned node
+    fin = ok & is_sib
+    slot_fin = jnp.where(fin, l, lk.active.shape[0])
+    lk = dataclasses.replace(
+        lk,
+        done=lk.done.at[slot_fin].set(True, mode="drop"),
+        success=lk.success.at[slot_fin].set(True, mode="drop"),
+        result=lk.result.at[slot_fin].set(resp_nodes[0], mode="drop"),
+        t_done=lk.t_done.at[slot_fin].set(msg.t_deliver, mode="drop"))
+
+    # not finished: update the frontier
+    upd = ok & ~is_sib
+    if cfg.merge:
+        # sorted union of old frontier + response, cap F, drop visited dups
+        cand = jnp.concatenate([lk.frontier[l], resp_nodes])
+        flags = jnp.concatenate([lk.fr_flags[l],
+                                 jnp.full((f,), F_NEW, I32)])
+        # dedupe: a response node equal to an existing frontier entry is
+        # invalidated (keeps the entry with its flag state)
+        eqm = cand[None, :] == cand[:, None]
+        earlier = jnp.tril(jnp.ones((2 * f, 2 * f), bool), k=-1)
+        dup = jnp.any(eqm & earlier, axis=1) | (cand == NO_NODE)
+        cand = jnp.where(dup, NO_NODE, cand)
+        dist = metric_fn(cand, lk.target[l])          # [2F, KL]
+        dist = jnp.where(dup[:, None], jnp.uint32(0xFFFFFFFF), dist)
+        _, (cand_s, flags_s) = keys_mod.sort_by_distance(dist, (cand, flags))
+        new_frontier = cand_s[:f]
+        new_flags = jnp.where(cand_s[:f] == NO_NODE, F_NEW, flags_s[:f])
+    else:
+        # replace mode: frontier := response nodes, in responder order
+        # (IterativeLookup.cc:839-841 + push_back add)
+        new_frontier = resp_nodes
+        new_flags = jnp.full((f,), F_NEW, I32)
+        # if the response was empty keep the old frontier (reference keeps
+        # nextHops when ClosestNodesArraySize()==0, IterativeLookup.cc:843)
+        new_frontier = jnp.where(has_nodes, new_frontier, lk.frontier[l])
+        new_flags = jnp.where(has_nodes, new_flags, lk.fr_flags[l])
+
+    slot_upd = jnp.where(upd, l, lk.active.shape[0])
+    lk = dataclasses.replace(
+        lk,
+        frontier=lk.frontier.at[slot_upd].set(new_frontier, mode="drop"),
+        fr_flags=lk.fr_flags.at[slot_upd].set(new_flags, mode="drop"))
+    return lk
+
+
+def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
+    """Expire pending RPCs / deadlines due strictly before ``t_end``.
+
+    Returns (lk', failed_nodes [L] i32) — failed_nodes lists the timed-out
+    query targets (NO_NODE where none) so the overlay can run its
+    handleFailedNode repair (BaseOverlay.cc:1697-1729 RPC timeout →
+    handleFailedNode; IterativePathLookup::handleTimeout).
+    """
+    l = lk.active.shape[0]
+    rpc_to = lk.active & (lk.pending_dst != NO_NODE) & (lk.t_to < t_end)
+    failed_nodes = jnp.where(rpc_to, lk.pending_dst, NO_NODE)
+
+    # mark the failed node in the frontier
+    fmask = rpc_to[:, None] & (lk.frontier == lk.pending_dst[:, None])
+    fr_flags = jnp.where(fmask, F_FAILED, lk.fr_flags)
+    pending_dst = jnp.where(rpc_to, NO_NODE, lk.pending_dst)
+    t_to = jnp.where(rpc_to, T_INF, lk.t_to)
+    # a timed-out round still counts as a hop attempt
+    hops = lk.hops + rpc_to.astype(I32)
+
+    # whole-lookup deadline (only for not-yet-done active lookups)
+    dead = lk.active & ~lk.done & (lk.deadline < t_end)
+    done = lk.done | dead
+    t_done = jnp.where(dead, now, lk.t_done)
+
+    return dataclasses.replace(
+        lk, fr_flags=fr_flags, pending_dst=pending_dst, t_to=t_to,
+        hops=hops, done=done, t_done=t_done), failed_nodes
+
+
+def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
+         cfg: LookupConfig, *, num_siblings: int = 1,
+         num_redundant: int = 1):
+    """Fire the next FindNodeCall for every active slot with no RPC in
+    flight; exhausted slots complete as failed.
+
+    Mirrors IterativePathLookup::sendRpc: pick the first unvisited,
+    not-failed frontier entry; if none and nothing pending, the path fails.
+    """
+    del rng
+    l_dim, f = lk.frontier.shape
+    idle = lk.active & ~lk.done & (lk.pending_dst == NO_NODE)
+
+    # candidate choice per slot: first frontier entry with flag F_NEW that
+    # is not in the visited set and not ourselves... (self entries are
+    # queried "locally" by the owner before seeding, so skip them here)
+    cand_ok = (lk.frontier != NO_NODE) & (lk.fr_flags == F_NEW)
+    vis = jax.vmap(lambda li: _is_visited(lk, li, lk.frontier[li]))(
+        jnp.arange(l_dim))
+    cand_ok = cand_ok & ~vis & (lk.frontier != node_idx)
+    has_cand = jnp.any(cand_ok, axis=1)
+    first = jnp.argmax(cand_ok, axis=1).astype(I32)
+    cand = jnp.take_along_axis(lk.frontier, first[:, None], axis=1)[:, 0]
+
+    fire = idle & has_cand & (lk.hops < MAX_HOPS)
+    fail = idle & (~has_cand | (lk.hops >= MAX_HOPS))
+
+    # visited ring append + flag update + pending bookkeeping
+    rows = jnp.where(fire, jnp.arange(l_dim, dtype=I32), l_dim)
+    vcol = lk.vis_n % lk.visited.shape[1]
+    visited = lk.visited.at[rows, vcol].set(cand, mode="drop")
+    vis_n = lk.vis_n + fire.astype(I32)
+    fr_flags = lk.fr_flags.at[rows, first].set(F_PENDING, mode="drop")
+    pending_dst = jnp.where(fire, cand, lk.pending_dst)
+    t_to = jnp.where(fire, now + cfg.rpc_timeout_ns, lk.t_to)
+
+    done = lk.done | fail
+    t_done = jnp.where(fail, now, lk.t_done)
+
+    lk = dataclasses.replace(
+        lk, visited=visited, vis_n=vis_n, fr_flags=fr_flags,
+        pending_dst=pending_dst, t_to=t_to, done=done, t_done=t_done)
+
+    # emit the FindNodeCalls (static loop over L slots)
+    for li in range(l_dim):
+        outbox.send(
+            fire[li], now, cand[li], wire.FINDNODE_CALL,
+            key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
+            c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
+            size_b=wire.findnode_call_b())
+    return lk, fire
+
+
+def take_completions(lk: LookupState, t_end):
+    """Harvest slots whose completion is due (done & t_done < t_end).
+
+    Returns (lk', comp) where comp is a dict of [L] arrays:
+    taken/success/result/purpose/aux/hops/t0/target.  Taken slots are freed.
+    """
+    taken = lk.done & (lk.t_done < t_end)
+    comp = dict(taken=taken, success=lk.success & taken, result=lk.result,
+                purpose=lk.purpose, aux=lk.aux, hops=lk.hops, t0=lk.t0,
+                target=lk.target)
+    lk = dataclasses.replace(
+        lk,
+        active=lk.active & ~taken,
+        done=lk.done & ~taken,
+        pending_dst=jnp.where(taken, NO_NODE, lk.pending_dst),
+        t_to=jnp.where(taken, T_INF, lk.t_to),
+        deadline=jnp.where(taken, T_INF, lk.deadline),
+        t_done=jnp.where(taken, T_INF, lk.t_done))
+    return lk, comp
+
+
+def next_event(lk: LookupState):
+    """Earliest timeout/completion wake-up for this node's lookups ([L]→scalar)."""
+    t = jnp.minimum(jnp.where(lk.active, lk.t_to, T_INF),
+                    jnp.where(lk.active & ~lk.done, lk.deadline, T_INF))
+    t = jnp.minimum(t, jnp.where(lk.done, lk.t_done, T_INF))
+    return jnp.min(t)

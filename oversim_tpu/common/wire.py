@@ -1,0 +1,50 @@
+"""Message-kind taxonomy and approximate wire sizes.
+
+TPU-native stand-in for the reference's `.msg`-generated message classes
+(src/common/CommonMessages.msg + per-protocol *.msg files): every in-flight
+message is one slot of the global pool (engine/pool.py) and its `kind`
+field selects the handler, replacing C++ RTTI dispatch in
+BaseOverlay::handleMessage / RPC_SWITCH macros.
+
+Sizes below approximate the reference's bit-length macros (realistic packet
+sizes feed the bandwidth-delay model and the bytes/s statistics; e.g.
+FINDNODECALL_L / FINDNODERESPONSE_L in CommonMessages.msg:246-262, Chord
+message lengths in src/overlay/chord/ChordMessage.msg).  A NodeHandle on
+the wire is ~25 B (key 20 B + ip 4 B + port 1-2 B, NODEHANDLE_L).
+"""
+
+# --- common overlay / RPC kinds (CommonMessages.msg) ---
+FINDNODE_CALL = 1       # FindNodeCall: lookupKey, numRedundant, numSiblings
+FINDNODE_RES = 2        # FindNodeResponse: closestNodes[], siblings flag
+PING_CALL = 3           # PingCall (liveness probe, BaseRpc::pingNode)
+PING_RES = 4
+FAILEDNODE_CALL = 5     # FailedNodeCall (IterativeLookup.cc:1025)
+FAILEDNODE_RES = 6
+
+# --- Chord protocol kinds (src/overlay/chord/ChordMessage.msg) ---
+CHORD_JOIN_CALL = 10
+CHORD_JOIN_RES = 11
+CHORD_STABILIZE_CALL = 12
+CHORD_STABILIZE_RES = 13
+CHORD_NOTIFY_CALL = 14
+CHORD_NOTIFY_RES = 15
+CHORD_SUCC_HINT = 16    # NewSuccessorHintMessage (aggressive join)
+
+# --- application payloads ---
+APP_ONEWAY = 30         # KBRTestApp one-way test payload (routed data)
+
+# --- Kademlia (src/overlay/kademlia) ---
+KAD_PING_CALL = 40      # routingAdd liveness ping (maintenance)
+KAD_PING_RES = 41
+
+NODEHANDLE_B = 25
+
+BASE_CALL_B = 16        # BaseRpcMessage overhead: nonce + srcNode handle
+
+
+def findnode_call_b() -> int:
+    return BASE_CALL_B + 20 + 2
+
+
+def findnode_res_b(num_nodes: int) -> int:
+    return BASE_CALL_B + 1 + NODEHANDLE_B * num_nodes

@@ -56,6 +56,7 @@ class Msg:
     d: jnp.ndarray
     nodes: jnp.ndarray
     size_b: jnp.ndarray
+    stamp: jnp.ndarray
 
     def slot(self, r: int) -> "Msg":
         """Select inbox slot r (fields lose their leading R axis)."""
@@ -113,9 +114,10 @@ class Outbox:
         self.d = jnp.zeros((m,), I32)
         self.nodes = jnp.full((m, rmax), NO_NODE, I32)
         self.size_b = jnp.zeros((m,), I32)
+        self.stamp = jnp.zeros((m,), I64)
 
     def send(self, en, t_send, dst, kind, *, key=None, nonce=0, hops=0,
-             a=0, b=0, c=0, d=0, nodes=None, size_b=40):
+             a=0, b=0, c=0, d=0, nodes=None, size_b=40, stamp=0):
         cur = jnp.where(en, self.cursor, jnp.int32(self.m))  # OOB -> dropped
         self.t_send = self.t_send.at[cur].set(t_send, mode="drop")
         self.dst = self.dst.at[cur].set(jnp.asarray(dst, I32), mode="drop")
@@ -137,6 +139,7 @@ class Outbox:
             self.nodes = self.nodes.at[cur].set(nodes, mode="drop")
         self.size_b = self.size_b.at[cur].set(jnp.asarray(size_b, I32),
                                               mode="drop")
+        self.stamp = self.stamp.at[cur].set(jnp.asarray(stamp, I64), mode="drop")
         self.cursor = self.cursor + en.astype(I32)
 
     def finish(self):
@@ -145,7 +148,7 @@ class Outbox:
         fields = dict(t_send=self.t_send, dst=self.dst, kind=self.kind,
                       key=self.key, nonce=self.nonce, hops=self.hops,
                       a=self.a, b=self.b, c=self.c, d=self.d,
-                      nodes=self.nodes, size_b=self.size_b)
+                      nodes=self.nodes, size_b=self.size_b, stamp=self.stamp)
         return fields, valid, jnp.maximum(self.cursor - self.m, 0)
 
 

@@ -55,6 +55,9 @@ class MsgPool:
     d: jnp.ndarray          # [P] i32
     nodes: jnp.ndarray      # [P, RMAX] i32 (NO_NODE padded)
     size_b: jnp.ndarray     # [P] i32 payload bytes (for delay model + stats)
+    stamp: jnp.ndarray      # [P] i64 ns timestamp payload (e.g. send time for
+                            # app-latency stats; reference keeps simTime() in
+                            # message fields, KBRTestApp.cc measurement path)
 
     @property
     def capacity(self):
@@ -62,7 +65,7 @@ class MsgPool:
 
 
 FIELDS = ("t_deliver", "src", "dst", "kind", "key", "nonce", "hops",
-          "a", "b", "c", "d", "nodes", "size_b")
+          "a", "b", "c", "d", "nodes", "size_b", "stamp")
 
 
 def empty(p: int, key_lanes: int, rmax: int) -> MsgPool:
@@ -79,6 +82,7 @@ def empty(p: int, key_lanes: int, rmax: int) -> MsgPool:
         c=jnp.zeros((p,), I32), d=jnp.zeros((p,), I32),
         nodes=jnp.full((p, rmax), NO_NODE, I32),
         size_b=jnp.zeros((p,), I32),
+        stamp=jnp.zeros((p,), I64),
     )
 
 

@@ -164,7 +164,8 @@ class Simulation:
             nonce=s.pool.nonce[safe], hops=s.pool.hops[safe],
             a=s.pool.a[safe], b=s.pool.b[safe],
             c=s.pool.c[safe], d=s.pool.d[safe],
-            nodes=s.pool.nodes[safe], size_b=s.pool.size_b[safe])
+            nodes=s.pool.nodes[safe], size_b=s.pool.size_b[safe],
+            stamp=s.pool.stamp[safe])
 
         # 4. context + vmapped node step
         ready = logic.ready_mask(logic_state) & alive

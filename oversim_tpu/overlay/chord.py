@@ -1,0 +1,663 @@
+"""Chord ring DHT as vectorized per-node logic.
+
+TPU-native rebuild of the reference Chord (src/overlay/chord/Chord.{h,cc} +
+ChordSuccessorList/ChordFingerTable), with protocol semantics preserved and
+state held as structure-of-arrays:
+
+  * successor list [N, S] node slots kept ring-distance sorted (reference
+    ChordSuccessorList: std::map sorted by distance from own key);
+  * predecessor [N]; finger table [N, B] (B = key bits) with 2^i targets;
+  * aggressive join (rpcJoin Chord.cc:917: responsible node adopts the
+    joiner as predecessor, hints its old predecessor in the JoinResponse,
+    and sends NEWSUCCESSORHINT to the old predecessor);
+  * periodic stabilize (StabilizeCall → successor's predecessor; adopt if
+    in (me, succ); then NotifyCall; NotifyResponse carries the successor's
+    successor list which replaces ours — Chord.cc:793/rpcStabilize/
+    rpcNotify/handleRpcNotifyResponse);
+  * periodic fixfingers (handleFixFingersTimerExpired Chord.cc:845: route
+    a lookup to me+2^i for every non-trivial finger — offset greater than
+    the distance to the successor; trivial fingers are removed).  We mark
+    those fingers dirty and repair them one lookup at a time, chained off
+    lookup completions (same convergence, bounded concurrency);
+  * predecessor liveness via periodic ping (checkPredecessorDelay=5s,
+    default.ini:172, handleCheckPredecessorTimerExpired);
+  * failure repair (handleFailedNode Chord.cc:502: drop from successor
+    list / fingers / predecessor, immediate re-stabilize, rejoin when the
+    last successor is gone);
+  * findNode (Chord.cc:548): siblings if responsible; successor list if
+    key in (me, succ]; otherwise closest preceding node over fingers +
+    successor list (closestPreceedingNode Chord.cc:602).
+
+Defaults follow simulations/default.ini:167-183 (joinDelay 10s,
+stabilizeDelay 20s, fixfingersDelay 120s, checkPredecessorDelay 5s,
+successorListSize 8, aggressiveJoinMode true, iterative routing).
+
+The embedded tier-1 app is pluggable in spirit; this first slice wires
+KBRTestApp (apps/kbrtest.py) directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import kbrtest
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+UMAX = jnp.uint32(0xFFFFFFFF)
+
+# node lifecycle (reference BaseOverlay States, BaseOverlay.h:86-102)
+DEAD, JOINING, READY = 0, 1, 2
+
+# lookup purposes (owner dispatch tags)
+P_JOIN, P_FINGER, P_APP = 1, 2, 3
+
+
+@dataclasses.dataclass(frozen=True)
+class ChordParams:
+    """default.ini:167-183."""
+
+    join_delay: float = 10.0
+    stabilize_delay: float = 20.0
+    fixfingers_delay: float = 120.0
+    check_pred_delay: float = 5.0
+    succ_size: int = 8
+    aggressive_join: bool = True
+    rpc_timeout: float = 1.5        # rpcUdpTimeout, default.ini:483
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ChordState:
+    state: jnp.ndarray         # [N] i32 DEAD/JOINING/READY
+    pred: jnp.ndarray          # [N] i32
+    succ: jnp.ndarray          # [N, S] i32 ring-sorted, NO_NODE padded
+    finger: jnp.ndarray        # [N, B] i32
+    finger_dirty: jnp.ndarray  # [N, B] bool
+    t_join: jnp.ndarray        # [N] i64
+    t_stab: jnp.ndarray        # [N] i64
+    t_fix: jnp.ndarray         # [N] i64
+    t_cp: jnp.ndarray          # [N] i64
+    stab_op: jnp.ndarray       # [N] i32 0=idle 1=stabilize 2=notify pending
+    stab_dst: jnp.ndarray      # [N] i32
+    stab_to: jnp.ndarray       # [N] i64
+    cp_to: jnp.ndarray         # [N] i64 pending predecessor-ping timeout
+    lk: lk_mod.LookupState     # [N, L, ...]
+    app: kbrtest.KbrTestState  # [N]
+
+
+def _sort_lanes(dist, payload):
+    return K.sort_by_distance(dist, payload)[1]
+
+
+def _lex_argmin(dist):
+    """Index of the lexicographically smallest [C, KL] distance row."""
+    idx = jnp.arange(dist.shape[0], dtype=I32)
+    (best,) = _sort_lanes(dist, (idx,))
+    return best[0]
+
+
+class ChordLogic:
+    """Implements the engine logic interface (engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: ChordParams = ChordParams(),
+                 lcfg: lk_mod.LookupConfig = lk_mod.LookupConfig(),
+                 app_params: kbrtest.KbrTestParams = kbrtest.KbrTestParams()):
+        self.key_spec = spec
+        self.p = params
+        self.lcfg = lcfg
+        self.ap = app_params
+        self._pow2 = K.pow2_table(spec)          # [B, KL] finger offsets
+
+    # -- engine interface ---------------------------------------------------
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        app = kbrtest.stat_spec(self.ap)
+        return stats_mod.StatSpec(
+            scalars=tuple(app["scalars"]) + ("lookup_hops",),
+            hists=tuple(app["hists"]),
+            counters=tuple(app["counters"]) + (
+                "chord_joins", "lookup_success", "lookup_failed"),
+        )
+
+    def init(self, rng, n: int) -> ChordState:
+        del rng
+        s, b = self.p.succ_size, self.key_spec.bits
+        return ChordState(
+            state=jnp.zeros((n,), I32),
+            pred=jnp.full((n,), NO_NODE, I32),
+            succ=jnp.full((n, s), NO_NODE, I32),
+            finger=jnp.full((n, b), NO_NODE, I32),
+            finger_dirty=jnp.zeros((n, b), bool),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_stab=jnp.full((n,), T_INF, I64),
+            t_fix=jnp.full((n,), T_INF, I64),
+            t_cp=jnp.full((n,), T_INF, I64),
+            stab_op=jnp.zeros((n,), I32),
+            stab_dst=jnp.full((n,), NO_NODE, I32),
+            stab_to=jnp.full((n,), T_INF, I64),
+            cp_to=jnp.full((n,), T_INF, I64),
+            lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
+                jnp.arange(n)),
+            app=kbrtest.init(n),
+        )
+
+    def reset(self, st: ChordState, clear, join, t_now, rng) -> ChordState:
+        n = st.state.shape[0]
+        fresh = self.init(None, n)
+        st = select_tree(clear, fresh, st)
+        jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: ChordState):
+        return st.state == READY
+
+    def next_event(self, st: ChordState):
+        joining = st.state == JOINING
+        ready = st.state == READY
+        t = jnp.where(joining, st.t_join, T_INF)
+        for timer in (st.t_stab, st.t_fix, st.t_cp):
+            t = jnp.minimum(t, jnp.where(ready, timer, T_INF))
+        t = jnp.minimum(t, st.stab_to)
+        t = jnp.minimum(t, st.cp_to)
+        t = jnp.minimum(t, jnp.where(ready, kbrtest.next_event(st.app), T_INF))
+        t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        return t
+
+    # -- internals (all per-node; vmapped by the engine) ---------------------
+
+    def _find_node(self, ctx, st, me_key, node_idx, key):
+        """Chord::findNode (Chord.cc:548) with numRedundantNodes=1.
+
+        Returns (next_hop i32 slot, is_sibling bool).  NO_NODE next hop
+        when not READY (reference returns an empty NodeVector).
+        """
+        spec = self.key_spec
+        ready = st.state == READY
+        pred_ok = st.pred != NO_NODE
+        pk = ctx.keys[jnp.maximum(st.pred, 0)]
+        succ0 = st.succ[0]
+        has_succ = succ0 != NO_NODE
+        s0k = ctx.keys[jnp.maximum(succ0, 0)]
+
+        alone = ~pred_ok & ~has_succ
+        is_sib = ready & (alone
+                          | (~pred_ok & K.eq(key, me_key))
+                          | (pred_ok & K.is_between_r(key, pk, me_key, spec)))
+        succ_case = ready & has_succ & ~is_sib & K.is_between_r(
+            key, me_key, s0k, spec)
+
+        # closest preceding node over fingers + successor list
+        cands = jnp.concatenate([st.finger, st.succ])
+        cks = ctx.keys[jnp.maximum(cands, 0)]
+        me_b = jnp.broadcast_to(me_key, cks.shape)
+        key_b = jnp.broadcast_to(key, cks.shape)
+        usable = (cands != NO_NODE) & (cands != node_idx) & K.is_between_r(
+            cks, me_b, key_b, spec)
+        d = K.sub(key_b, cks, spec)            # clockwise candidate→key
+        d = jnp.where(usable[:, None], d, UMAX)
+        best = cands[_lex_argmin(d)]
+        best = jnp.where(jnp.any(usable), best, succ0)  # fallback: successor
+
+        nxt = jnp.where(is_sib, node_idx, jnp.where(succ_case, succ0, best))
+        nxt = jnp.where(ready, nxt, NO_NODE)
+        return nxt, is_sib
+
+    def _succ_sorted(self, ctx, me_key, node_idx, cands):
+        """Ring-distance-sorted unique successor list from candidate slots
+        (ChordSuccessorList semantics: excludes self, sorted by clockwise
+        distance from own key, capacity S)."""
+        s = self.p.succ_size
+        c = cands
+        ck = ctx.keys[jnp.maximum(c, 0)]
+        eq = c[None, :] == c[:, None]
+        dup = jnp.any(eq & jnp.tril(jnp.ones((c.shape[0],) * 2, bool), -1),
+                      axis=1)
+        bad = (c == NO_NODE) | (c == node_idx) | dup
+        d = K.sub(ck, jnp.broadcast_to(me_key, ck.shape), self.key_spec)
+        d = jnp.where(bad[:, None], UMAX, d)
+        c_s, bad_s = _sort_lanes(d, (c, bad.astype(I32)))
+        out = jnp.where(bad_s[:s] != 0, NO_NODE, c_s[:s])
+        if out.shape[0] < s:
+            out = jnp.concatenate(
+                [out, jnp.full((s - out.shape[0],), NO_NODE, I32)])
+        return out
+
+    def _succ_add(self, ctx, me_key, node_idx, succ, node, en):
+        node = jnp.where(en, node, NO_NODE)
+        return self._succ_sorted(ctx, me_key, node_idx,
+                                 jnp.concatenate([succ, node[None]]))
+
+    def _handle_failed(self, ctx, st, me_key, node_idx, failed, now):
+        """Chord::handleFailedNode (Chord.cc:502) for one failed slot."""
+        en = failed != NO_NODE
+        pred = jnp.where(en & (st.pred == failed), NO_NODE, st.pred)
+        was_succ0 = en & (st.succ[0] == failed)
+        succ_masked = jnp.where(st.succ == failed, NO_NODE, st.succ)
+        succ = self._succ_sorted(ctx, me_key, node_idx, succ_masked)
+        succ = jnp.where(en, succ, st.succ)
+        fhit = en & (st.finger == failed)
+        finger = jnp.where(fhit, NO_NODE, st.finger)
+        finger_dirty = st.finger_dirty | fhit
+        t_stab = jnp.where(was_succ0, now, st.t_stab)
+
+        # lost the last successor while READY → rejoin
+        # (handleFailedNode: successorList empty → cancel timers, wait for
+        # join; BaseOverlay rejoinOnFailure path)
+        rejoin = en & (st.state == READY) & (succ[0] == NO_NODE)
+        st = dataclasses.replace(
+            st, pred=pred, succ=succ, finger=finger,
+            finger_dirty=finger_dirty, t_stab=t_stab)
+        fresh_lk = lk_mod.init(self.lcfg, self.key_spec.lanes)
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(rejoin, JOINING, st.state),
+            t_join=jnp.where(rejoin, now, st.t_join),
+            t_stab=jnp.where(rejoin, T_INF, st.t_stab),
+            t_fix=jnp.where(rejoin, T_INF, st.t_fix),
+            t_cp=jnp.where(rejoin, T_INF, st.t_cp),
+            stab_op=jnp.where(rejoin, 0, st.stab_op),
+            stab_to=jnp.where(rejoin, T_INF, st.stab_to),
+            cp_to=jnp.where(rejoin, T_INF, st.cp_to),
+            lk=select_tree(rejoin, fresh_lk, st.lk),
+            app=kbrtest.on_stop(st.app, rejoin))
+        return st
+
+    def _become_ready(self, ctx, st, en, now, rng):
+        """Schedule periodic protocols on entering READY.
+
+        Join response handler schedules immediate stabilize + fixfingers
+        (handleRpcJoinResponse Chord.cc: scheduleAt(simTime(), ...))."""
+        p = self.p
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(en, READY, st.state),
+            t_join=jnp.where(en, T_INF, st.t_join),
+            t_stab=jnp.where(en, now, st.t_stab),
+            t_fix=jnp.where(en, now, st.t_fix),
+            t_cp=jnp.where(en, now + jnp.int64(int(p.check_pred_delay * NS)),
+                           st.t_cp),
+            app=kbrtest.on_ready(st.app, en, now, rng, self.ap))
+        return st
+
+    # -- the per-node step ---------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, lcfg, spec = self.p, self.lcfg, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rpc_to_ns = jnp.int64(int(p.rpc_timeout * NS))
+        rngs = jax.random.split(rng, 6)
+        t0 = ctx.t_start
+
+        def pad_nodes(vec):
+            out = jnp.full((rmax,), NO_NODE, I32)
+            return out.at[:vec.shape[0]].set(vec[:rmax])
+
+        def metric_fn(cand_slots, target):
+            ck = ctx.keys[jnp.maximum(cand_slots, 0)]
+            return K.sub(jnp.broadcast_to(target, ck.shape), ck, spec)
+
+        # event accumulators
+        joins_cnt = jnp.int32(0)
+        sent_cnt = jnp.int32(0)
+        wrong_cnt = jnp.int32(0)
+        lkfail_cnt = jnp.int32(0)   # failed app routes only (KBR KPI)
+        anyfail_cnt = jnp.int32(0)  # failed lookups of any purpose
+        lksucc_cnt = jnp.int32(0)
+        deliv_hops, deliv_lat, deliv_mask = [], [], []
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # FindNodeCall → findNode + sibling flag (findNodeRpc,
+            # BaseOverlay.cc:1841)
+            en = v & (m.kind == wire.FINDNODE_CALL)
+            nxt, sib = self._find_node(ctx, st, me_key, node_idx, m.key)
+            ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
+                    a=m.a, b=m.b, c=sib.astype(I32),
+                    nodes=jnp.full((rmax,), NO_NODE, I32).at[0].set(nxt),
+                    size_b=wire.findnode_res_b(1))
+
+            # FindNodeResponse → lookup engine
+            en = v & (m.kind == wire.FINDNODE_RES)
+            st = dataclasses.replace(st, lk=lk_mod.on_response(
+                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
+
+            # JoinCall (rpcJoin, Chord.cc:917) — response compiled BEFORE
+            # the aggressive-join mutations (reference order)
+            en = v & (m.kind == wire.CHORD_JOIN_CALL) & (st.state == READY)
+            alone = (st.pred == NO_NODE) & (st.succ[0] == NO_NODE)
+            pred_hint = jnp.where(alone, node_idx, st.pred)
+            ob.send(en, now, m.src, wire.CHORD_JOIN_RES, a=pred_hint,
+                    nodes=pad_nodes(st.succ),
+                    size_b=wire.BASE_CALL_B
+                    + wire.NODEHANDLE_B * (p.succ_size + 1))
+            if p.aggressive_join:
+                ob.send(en & (st.pred != NO_NODE), now, st.pred,
+                        wire.CHORD_SUCC_HINT, a=m.src,
+                        size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+                pred2 = jnp.where(en, m.src, st.pred)
+            else:
+                pred2 = st.pred
+            succ2 = jnp.where(en & (st.succ[0] == NO_NODE),
+                              st.succ.at[0].set(m.src), st.succ)
+            st = dataclasses.replace(st, pred=pred2, succ=succ2)
+
+            # JoinResponse (handleRpcJoinResponse)
+            en = v & (m.kind == wire.CHORD_JOIN_RES) & (st.state == JOINING)
+            succ3 = self._succ_sorted(
+                ctx, me_key, node_idx,
+                jnp.concatenate([m.nodes[:p.succ_size], m.src[None]]))
+            got_succ = en & (succ3[0] != NO_NODE)
+            joins_cnt += got_succ.astype(I32)
+            st = dataclasses.replace(
+                st,
+                succ=jnp.where(got_succ, succ3, st.succ),
+                pred=jnp.where(got_succ & (m.a != NO_NODE)
+                               & jnp.bool_(p.aggressive_join), m.a, st.pred))
+            st = self._become_ready(ctx, st, got_succ, now, rngs[0])
+
+            # StabilizeCall → reply with predecessor (rpcStabilize)
+            en = v & (m.kind == wire.CHORD_STABILIZE_CALL) & (
+                st.state == READY)
+            ob.send(en, now, m.src, wire.CHORD_STABILIZE_RES, a=st.pred,
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+
+            # StabilizeResponse (handleRpcStabilizeResponse)
+            en = v & (m.kind == wire.CHORD_STABILIZE_RES) & (
+                st.state == READY) & (st.stab_op == 1) & (m.src == st.stab_dst)
+            cand = m.a
+            ck = ctx.keys[jnp.maximum(cand, 0)]
+            s0 = st.succ[0]
+            s0k = ctx.keys[jnp.maximum(s0, 0)]
+            succ_empty = s0 == NO_NODE
+            adopt = (cand != NO_NODE) & (succ_empty | K.is_between(
+                ck, me_key, s0k, spec))
+            new_node = jnp.where(adopt, cand,
+                                 jnp.where(succ_empty, m.src, NO_NODE))
+            succ4 = self._succ_add(ctx, me_key, node_idx, st.succ, new_node,
+                                   en)
+            succ4 = jnp.where(en, succ4, st.succ)
+            # notify the (possibly new) successor
+            ob.send(en & (succ4[0] != NO_NODE), now, succ4[0],
+                    wire.CHORD_NOTIFY_CALL,
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+            st = dataclasses.replace(
+                st, succ=succ4,
+                stab_op=jnp.where(en, 2, st.stab_op),
+                stab_dst=jnp.where(en, succ4[0], st.stab_dst),
+                stab_to=jnp.where(en, now + rpc_to_ns, st.stab_to))
+
+            # NotifyCall (rpcNotify): adopt closer predecessor, reply with
+            # successor list
+            en = v & (m.kind == wire.CHORD_NOTIFY_CALL) & (st.state == READY)
+            sk = ctx.keys[jnp.maximum(m.src, 0)]
+            pk = ctx.keys[jnp.maximum(st.pred, 0)]
+            newpred = en & ((st.pred == NO_NODE)
+                            | K.is_between(sk, pk, me_key, spec))
+            succ5 = jnp.where(newpred & (st.succ[0] == NO_NODE),
+                              st.succ.at[0].set(m.src), st.succ)
+            st = dataclasses.replace(
+                st, pred=jnp.where(newpred, m.src, st.pred), succ=succ5)
+            ob.send(en, now, m.src, wire.CHORD_NOTIFY_RES,
+                    nodes=pad_nodes(st.succ),
+                    size_b=wire.BASE_CALL_B
+                    + wire.NODEHANDLE_B * (p.succ_size + 1))
+
+            # NotifyResponse (handleRpcNotifyResponse): replace successor
+            # list with successor's list
+            en = v & (m.kind == wire.CHORD_NOTIFY_RES) & (
+                st.state == READY) & (st.stab_op == 2) & (
+                m.src == st.stab_dst) & (m.src == st.succ[0])
+            succ6 = self._succ_sorted(
+                ctx, me_key, node_idx,
+                jnp.concatenate([m.nodes[:p.succ_size], m.src[None]]))
+            fin = v & (m.kind == wire.CHORD_NOTIFY_RES) & (st.stab_op == 2) & (
+                m.src == st.stab_dst)
+            st = dataclasses.replace(
+                st, succ=jnp.where(en, succ6, st.succ),
+                stab_op=jnp.where(fin, 0, st.stab_op),
+                stab_to=jnp.where(fin, T_INF, st.stab_to))
+
+            # NewSuccessorHint (handleNewSuccessorHint)
+            en = v & (m.kind == wire.CHORD_SUCC_HINT) & (st.state == READY)
+            hk = ctx.keys[jnp.maximum(m.a, 0)]
+            s0k2 = ctx.keys[jnp.maximum(st.succ[0], 0)]
+            take = en & (m.a != NO_NODE) & (
+                (st.succ[0] == NO_NODE)
+                | K.is_between(hk, me_key, s0k2, spec))
+            st = dataclasses.replace(st, succ=jnp.where(
+                take, self._succ_add(ctx, me_key, node_idx, st.succ, m.a,
+                                     take), st.succ))
+
+            # app one-way payload (KBRTestApp::deliver).  Reuse the
+            # findNode result computed for this slot above: no handler
+            # between there and here fires for an APP_ONEWAY kind, so the
+            # state it read is unchanged.
+            en = v & (m.kind == wire.APP_ONEWAY)
+            sib_here = sib
+            good = en & sib_here
+            deliv_mask.append(good & (m.c != 0))
+            deliv_hops.append(m.hops + 1)
+            deliv_lat.append((now - m.stamp).astype(jnp.float32) / NS)
+            wrong_cnt += (en & ~sib_here & (m.c != 0)).astype(I32)
+
+            # ping (predecessor liveness + generic)
+            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
+                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+            en = v & (m.kind == wire.PING_RES) & (m.src == st.pred)
+            st = dataclasses.replace(
+                st, cp_to=jnp.where(en, T_INF, st.cp_to))
+
+        # ------------------------------------------------------- timers ----
+        t_end = ctx.t_end
+
+        # join (joinOverlay / handleJoinTimerExpired Chord.cc:758)
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1])
+        no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
+        alone_start = en_j & (boot == NO_NODE)
+        st = self._become_ready(ctx, st, alone_start, now_j, rngs[2])
+        joins_cnt += alone_start.astype(I32)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_join = en_j & (boot != NO_NODE) & no_join_lk & have
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(boot)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_join, slot, P_JOIN, 0, me_key, seed, now_j, lcfg))
+        st = dataclasses.replace(st, t_join=jnp.where(
+            en_j & ~alone_start,
+            now_j + jnp.int64(int(p.join_delay * NS)), st.t_join))
+
+        # stabilize (handleStabilizeTimerExpired)
+        en_s = (st.state == READY) & (st.t_stab < t_end)
+        now_s = jnp.maximum(st.t_stab, t0)
+        has_succ = st.succ[0] != NO_NODE
+        fire_s = en_s & has_succ
+        ob.send(fire_s, now_s, st.succ[0], wire.CHORD_STABILIZE_CALL,
+                size_b=wire.BASE_CALL_B)
+        st = dataclasses.replace(
+            st,
+            stab_op=jnp.where(fire_s, 1, st.stab_op),
+            stab_dst=jnp.where(fire_s, st.succ[0], st.stab_dst),
+            stab_to=jnp.where(fire_s, now_s + rpc_to_ns, st.stab_to),
+            t_stab=jnp.where(en_s, now_s + jnp.int64(
+                int(p.stabilize_delay * NS)), st.t_stab))
+
+        # fixfingers (handleFixFingersTimerExpired): mark non-trivial
+        # fingers dirty, remove trivial ones
+        en_f = (st.state == READY) & (st.t_fix < t_end) & has_succ
+        s0k = ctx.keys[jnp.maximum(st.succ[0], 0)]
+        sdist = K.sub(s0k, me_key, spec)                    # me → succ
+        nontrivial = K.gt(self._pow2, jnp.broadcast_to(sdist,
+                                                       self._pow2.shape))
+        st = dataclasses.replace(
+            st,
+            finger_dirty=jnp.where(en_f, nontrivial, st.finger_dirty),
+            finger=jnp.where(en_f & ~nontrivial, NO_NODE, st.finger),
+            t_fix=jnp.where((st.state == READY) & (st.t_fix < t_end),
+                            jnp.maximum(st.t_fix, t0)
+                            + jnp.int64(int(p.fixfingers_delay * NS)),
+                            st.t_fix))
+
+        # predecessor check (handleCheckPredecessorTimerExpired)
+        en_c = (st.state == READY) & (st.t_cp < t_end)
+        now_c = jnp.maximum(st.t_cp, t0)
+        fire_c = en_c & (st.pred != NO_NODE) & (st.cp_to == T_INF)
+        ob.send(fire_c, now_c, st.pred, wire.PING_CALL,
+                size_b=wire.BASE_CALL_B)
+        st = dataclasses.replace(
+            st,
+            cp_to=jnp.where(fire_c, now_c + rpc_to_ns, st.cp_to),
+            t_cp=jnp.where(en_c, now_c + jnp.int64(
+                int(p.check_pred_delay * NS)), st.t_cp))
+
+        # app timer → start an app lookup (KBRTestApp::handleTimerEvent →
+        # callRoute → iterative lookup, SURVEY §3.2)
+        en_a = (st.state == READY) & (st.app.t_test < t_end)
+        now_a = jnp.maximum(st.app.t_test, t0)
+        app, want, dest_key, seq = kbrtest.on_timer(
+            st.app, en_a, ctx, now_a, rngs[3], self.ap)
+        st = dataclasses.replace(st, app=app)
+        nxt_a, sib_a = self._find_node(ctx, st, me_key, node_idx, dest_key)
+        sent_cnt += want.astype(I32)
+        # local delivery (sendToKey with local sibling → direct deliver,
+        # hopCount 0)
+        local = want & sib_a
+        deliv_mask.append(local & ctx.measuring)
+        deliv_hops.append(jnp.int32(0))
+        deliv_lat.append(jnp.float32(0))
+        slot, have = lk_mod.free_slot(st.lk)
+        start_app = want & ~sib_a & have & (nxt_a != NO_NODE)
+        lkfail_cnt += (want & ~sib_a & ~start_app).astype(I32)
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(nxt_a)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_app, slot, P_APP, seq, dest_key, seed, now_a, lcfg))
+
+        # ------------------------------------------------ lookup timeouts --
+        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+        for li in range(lcfg.slots):
+            st = self._handle_failed(ctx, st, me_key, node_idx,
+                                     failed_nodes[li], t0)
+
+        # stabilize / notify RPC timeout → failed successor
+        en = (st.stab_op != 0) & (st.stab_to < t_end)
+        st = dataclasses.replace(
+            st, stab_op=jnp.where(en, 0, st.stab_op),
+            stab_to=jnp.where(en, T_INF, st.stab_to))
+        st = self._handle_failed(ctx, st, me_key, node_idx,
+                                 jnp.where(en, st.stab_dst, NO_NODE), t0)
+
+        # predecessor ping timeout → drop predecessor
+        en = st.cp_to < t_end
+        st = dataclasses.replace(
+            st, pred=jnp.where(en, NO_NODE, st.pred),
+            cp_to=jnp.where(en, T_INF, st.cp_to))
+
+        # ------------------------------------------------- completions -----
+        new_lk, comp = lk_mod.take_completions(st.lk, t_end)
+        st = dataclasses.replace(st, lk=new_lk)
+        comp_hops_ev = (comp["hops"].astype(jnp.float32),
+                        comp["taken"] & comp["success"])
+        for li in range(lcfg.slots):
+            en = comp["taken"][li]
+            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
+            res = comp["result"][li]
+            pur = comp["purpose"][li]
+            lksucc_cnt += (en & suc).astype(I32)
+            anyfail_cnt += (en & ~suc).astype(I32)
+            # the KBR KPI only counts the app's own routes failing
+            # (reference KBRTestApp records only its own lookups)
+            lkfail_cnt += (en & ~suc & (pur == P_APP)).astype(I32)
+
+            # join: contact our successor directly
+            ob.send(en & suc & (pur == P_JOIN), t0, res,
+                    wire.CHORD_JOIN_CALL,
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+
+            # finger repair result
+            enf = en & (pur == P_FINGER)
+            fi = jnp.clip(comp["aux"][li], 0, spec.bits - 1)
+            st = dataclasses.replace(
+                st,
+                finger=jnp.where(enf & suc,
+                                 st.finger.at[fi].set(res), st.finger),
+                finger_dirty=jnp.where(
+                    enf, st.finger_dirty.at[fi].set(False),
+                    st.finger_dirty))
+
+            # app route: final hop to the sibling
+            ena = en & (pur == P_APP)
+            ob.send(ena & suc & (res != node_idx), t0, res, wire.APP_ONEWAY,
+                    key=comp["target"][li], hops=comp["hops"][li],
+                    c=ctx.measuring.astype(I32), stamp=comp["t0"][li],
+                    size_b=self.ap.test_msg_bytes)
+            # lookup ended on ourselves → local delivery
+            self_del = ena & suc & (res == node_idx)
+            deliv_mask.append(self_del & ctx.measuring)
+            deliv_hops.append(comp["hops"][li])
+            deliv_lat.append((t0 - comp["t0"][li]).astype(jnp.float32) / NS)
+
+        # -------------------------------------------- finger repair pump ---
+        dirty_any = (st.state == READY) & jnp.any(st.finger_dirty)
+        no_finger_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_FINGER))
+        fi = jnp.argmax(st.finger_dirty).astype(I32)
+        target = K.add(me_key, self._pow2[fi], spec)
+        nxt_f, sib_f = self._find_node(ctx, st, me_key, node_idx, target)
+        # responsible ourselves → no finger needed (covered by succ list)
+        self_fix = dirty_any & no_finger_lk & sib_f
+        st = dataclasses.replace(
+            st,
+            finger_dirty=jnp.where(self_fix,
+                                   st.finger_dirty.at[fi].set(False),
+                                   st.finger_dirty))
+        slot, have = lk_mod.free_slot(st.lk)
+        start_fix = dirty_any & no_finger_lk & ~sib_f & have & (
+            nxt_f != NO_NODE)
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(nxt_f)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_fix, slot, P_FINGER, fi, target, seed, t0, lcfg))
+
+        # ------------------------------------------------------- pump ------
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[4], lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+
+        # ------------------------------------------------------ events -----
+        dh = jnp.stack([jnp.asarray(x, jnp.float32) for x in deliv_hops])
+        dl = jnp.stack([jnp.asarray(x, jnp.float32) for x in deliv_lat])
+        dm = jnp.stack(deliv_mask)
+        events = {
+            "c:chord_joins": joins_cnt,
+            "c:kbr_sent": sent_cnt,
+            "c:kbr_delivered": jnp.sum(dm.astype(I32)),
+            "c:kbr_wrong_node": wrong_cnt,
+            "c:kbr_lookup_failed": lkfail_cnt,
+            "c:lookup_success": lksucc_cnt,
+            "c:lookup_failed": anyfail_cnt,
+            "s:kbr_hopcount": (dh, dm),
+            "s:kbr_latency_s": (dl, dm),
+            "h:kbr_hop_hist": (dh.astype(I32), dm),
+            "s:lookup_hops": comp_hops_ev,
+        }
+        return st, ob, events

@@ -1,0 +1,57 @@
+"""Dev smoke run: 8-node Chord ring, NoChurn, KBRTestApp."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+
+import time
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic
+from oversim_tpu.core import keys as K
+
+logic = ChordLogic()
+cp = churn_mod.ChurnParams(model="none", target_num=8, init_interval=1.0)
+ep = sim_mod.EngineParams(window=0.010, transition_time=20.0)
+s = sim_mod.Simulation(logic, cp, engine_params=ep)
+
+t0 = time.time()
+st = s.init(seed=7)
+print("init ok", time.time() - t0)
+
+t0 = time.time()
+st = s.run_chunk(st, 1)
+print("first tick compiled+ran in", time.time() - t0)
+
+t0 = time.time()
+st = s.run_until(st, 300.0, chunk=512)
+print("sim to t=300s in", time.time() - t0, "wall")
+
+out = s.summary(st)
+for k, v in sorted(out.items()):
+    print(k, v)
+
+# ring check
+chord = st.logic
+alive = np.asarray(st.alive)
+keys_int = [K.to_int(k) for k in np.asarray(st.node_keys)]
+order = sorted(range(len(keys_int)), key=lambda i: keys_int[i])
+succ = np.asarray(chord.succ)
+pred = np.asarray(chord.pred)
+states = np.asarray(chord.state)
+print("states:", states, "alive:", alive.sum())
+ok = True
+for pos, i in enumerate(order):
+    expect = order[(pos + 1) % len(order)]
+    got = succ[i, 0]
+    if got != expect:
+        ok = False
+        print(f"node {i}: succ {got} expected {expect} pred {pred[i]}")
+print("ring correct:", ok)
+print("succ0:", succ[:, 0], "pred:", pred)

@@ -47,6 +47,7 @@ def test_pool_alloc_inbox_free_roundtrip():
         "c": jnp.zeros((q,), I32), "d": jnp.zeros((q,), I32),
         "nodes": jnp.full((q, 4), -1, I32),
         "size_b": jnp.zeros((q,), I32),
+        "stamp": jnp.zeros((q,), I64),
     }
     want = jnp.asarray([True, True, True, True, True, True])
     p, overflow = pool_mod.alloc(p, out, want)
