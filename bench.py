@@ -1,0 +1,82 @@
+"""Headline benchmark: simulated KBR lookups per wallclock second.
+
+Scenario = driver config #1 (BASELINE.md): Chord ring, SimpleUnderlay
+delay model, KBRTestApp one-way workload, no churn.  The reference
+(trucndt/oversim) runs this as a single-threaded discrete-event loop
+(~1e5-1e6 events/core-s, one handleMessage per event); here every tick
+advances all N nodes at once on the accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env overrides: OVERSIM_BENCH_N (nodes), OVERSIM_BENCH_SIMTIME (measured
+simulated seconds), OVERSIM_BENCH_INTERVAL (per-node test period, s).
+"""
+
+import json
+import os
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# sim-step graphs compile slowly; cache persistently across invocations
+jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from oversim_tpu import churn as churn_mod  # noqa: E402
+from oversim_tpu.apps import kbrtest  # noqa: E402
+from oversim_tpu.apps.kbrtest import KbrTestApp  # noqa: E402
+from oversim_tpu.engine import sim as sim_mod  # noqa: E402
+from oversim_tpu.overlay.chord import ChordLogic  # noqa: E402
+
+# The reference publishes no benchmark numbers (BASELINE.json published={}).
+# Baseline estimate for the same workload on one CPU core: an OMNeT++
+# SimpleUnderlay event costs ~2-10us (hashmap lookup + calcDelay + FES
+# insert, SURVEY.md §2.2), and one KBR lookup is ~12-16 events (6 RPC
+# round trips + final hop + timers) → ~2e4 lookups/core-s.  This constant
+# is the denominator for vs_baseline until a measured reference number
+# replaces it.
+BASELINE_LOOKUPS_PER_SEC = 2.0e4
+
+
+def main():
+    n = int(os.environ.get("OVERSIM_BENCH_N", 1024))
+    sim_seconds = float(os.environ.get("OVERSIM_BENCH_SIMTIME", 30.0))
+    interval = float(os.environ.get("OVERSIM_BENCH_INTERVAL", 1.0))
+
+    cp = churn_mod.ChurnParams(model="none", target_num=n,
+                               init_interval=0.02, init_deviation=0.002)
+    logic = ChordLogic(app=KbrTestApp(kbrtest.KbrTestParams(
+        test_interval=interval)))
+    sim = sim_mod.Simulation(logic, cp)
+
+    s = sim.init(seed=7)
+    # build + join phase (not measured): all nodes created and joined
+    warm_until = cp.init_finished_time + 15.0
+    s = sim.run_until(s, warm_until)
+    jax.block_until_ready(s.t_now)
+    base = sim.summary(s)
+
+    t0 = time.perf_counter()
+    s = sim.run_until(s, warm_until + sim_seconds)
+    jax.block_until_ready(s.t_now)
+    wall = time.perf_counter() - t0
+
+    out = sim.summary(s)
+    delivered = out["kbr_delivered"] - base["kbr_delivered"]
+    sent = out["kbr_sent"] - base["kbr_sent"]
+    rate = delivered / wall if wall > 0 else 0.0
+
+    result = {
+        "metric": "kbr_lookups_per_sec",
+        "value": round(rate, 2),
+        "unit": f"lookups/s (Chord {n} nodes, delivery "
+                f"{delivered}/{sent}, {out['_ticks']} ticks, "
+                f"{wall:.1f}s wall)",
+        "vs_baseline": round(rate / BASELINE_LOOKUPS_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Tier-app interface: the Common API between overlays and applications.
+
+The reference stacks pluggable tier apps over any overlay via the Common
+API (BaseApp deliver/forward/update + routed RPC, SURVEY.md §1/§2.4,
+reference src/common/BaseApp.{h,cc}).  Here an app is a strategy object
+the overlay logic drives from inside its vmapped per-node step:
+
+  stat_spec() -> dict(scalars=(), hists=(), counters=())
+  init(n) -> state pytree of [N, ...] arrays
+  glob_init(rng) -> simulation-global pytree (or None)   # oracle maps etc.
+  post_step(ctx, app_state, glob, events) -> (app_state, glob)
+      # runs un-vmapped after the node sweep: fold per-node staging
+      # fields / "g:" events into the global part, clear the staging
+  on_ready(state, en, now, rng) -> state    # overlay became READY
+  on_stop(state, en) -> state               # node left / lost READY
+  next_event(state) -> [N] i64              # earliest app timer
+  on_timer(state_n, en, ctx, now, rng) -> (state_n, LookupReq)
+      # fire app timers due in the window; optionally request ONE lookup
+  on_lookup_done(state_n, done, ctx, ob, ev, now, node_idx) -> state_n
+      # a requested lookup finished; ``done`` is a LookupDone; the app
+      # emits follow-up messages (payload hop, DHT puts/gets) via ``ob``
+  on_msg(state_n, m, ctx, ob, ev, is_sib) -> state_n
+      # one inbound message of an app-owned kind (wire.py kind >= 30)
+
+All hooks are pure functions over one node's slice (vmapped), except
+``init/glob_init/post_step/on_ready/on_stop/next_event`` which see full
+[N, ...] arrays.  ``ev`` is an `AppEvents` accumulator; ``ob`` the
+engine Outbox.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+@dataclasses.dataclass
+class LookupReq:
+    """App asks the overlay to resolve ``key``; ``tag`` comes back in the
+    completion (reference: callRoute / LookupCall context pointer)."""
+
+    want: jnp.ndarray        # bool
+    key: jnp.ndarray         # [KL]
+    tag: jnp.ndarray         # i32 opaque app payload
+
+
+@dataclasses.dataclass
+class LookupDone:
+    """Completion of an app lookup (overlay → app)."""
+
+    en: jnp.ndarray          # bool — a completion is being dispatched
+    success: jnp.ndarray     # bool
+    tag: jnp.ndarray         # i32
+    target: jnp.ndarray      # [KL] the looked-up key
+    results: jnp.ndarray     # [R] i32 sibling slots (NO_NODE padded)
+    hops: jnp.ndarray        # i32
+    t0: jnp.ndarray          # i64 lookup start time
+
+
+class AppEvents:
+    """Accumulates stat events across the overlay step's unrolled handler
+    calls, then finalizes into the engine events dict (values emitted
+    multiple times stack into batched (values, mask) arrays)."""
+
+    def __init__(self):
+        self._counts: dict = {}
+        self._vals: dict = {}
+
+    def count(self, name: str, inc):
+        inc = jnp.asarray(inc)
+        if inc.dtype == bool:
+            inc = inc.astype(I32)
+        self._counts[name] = self._counts.get(name, jnp.int32(0)) + inc
+
+    def value(self, name: str, val, mask):
+        self._vals.setdefault(name, []).append(
+            (jnp.asarray(val, jnp.float32), jnp.asarray(mask)))
+
+    def finish(self, events: dict, hist_bins: dict | None = None):
+        """Write accumulated events; ``hist_bins`` maps a scalar-event name
+        to a histogram event name to emit alongside."""
+        for name, v in self._counts.items():
+            events["c:" + name] = events.get("c:" + name, 0) + v
+        for name, pairs in self._vals.items():
+            vals = jnp.stack([p[0] for p in pairs])
+            mask = jnp.stack([p[1] for p in pairs])
+            events["s:" + name] = (vals, mask)
+            if hist_bins and name in hist_bins:
+                events["h:" + hist_bins[name]] = (vals.astype(I32), mask)
+        return events

@@ -1,0 +1,352 @@
+"""DHT storage tier + DHTTestApp driver + GlobalDhtTestMap oracle.
+
+TPU-native rebuild of the reference stack (SURVEY.md §2.4/§3.4):
+
+  * tier 1 — DHT (src/applications/dht/DHT.{h,cc} + DHTDataStorage):
+    PUT = sibling lookup for numReplica replicas, then a routed
+    ``DHTPutCall`` to each (sendPutLookupCall DHT.cc:504); GET = lookup +
+    ``DHTGetCall``; per-key TTL eviction.  Values travel as 32-bit ids —
+    arbitrary payload bytes live host-side, keyed by id (the delay model
+    only needs sizes; reference BinaryValue semantics preserved for the
+    test workload);
+  * tier 2 — DHTTestApp (src/tier2/dhttestapp/DHTTestApp.{h,cc}):
+    periodic alternating PUT(random oracle key, fresh value) /
+    GET(known key) every testInterval=60s (default.ini:76), validated
+    against the global truth;
+  * GlobalDhtTestMap (src/tier2/dhttestapp/GlobalDhtTestMap.{h,cc}):
+    simulation-global key→value truth.  Vmapped node handlers cannot
+    write shared state, so commits flow as "g:" events folded in by
+    ``post_step`` (engine/logic.py LogicBase discipline).  A PUT's truth
+    is recorded when the initiator's quorum completes — the same moment
+    the reference's DHTTestApp stores into GlobalDhtTestMap (on
+    DHTputCAPIResponse, DHTTestApp.cc:163-182).
+
+Simplifications vs the reference (documented): one outstanding DHT
+operation per node (the reference allows several concurrent CAPI calls);
+GET quorum is first-response (numGetRequests=1) rather than
+ratioIdentical voting over 4 parallel gets; no ownership handover puts
+on churn yet (update() maintenance TODO).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+NO_VAL = jnp.int32(-1)
+
+OP_NONE, OP_PUT, OP_GET = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class DhtParams:
+    """default.ini:67-77 + tier2 dhtTestApp namespace."""
+
+    num_replica: int = 4          # numReplica
+    test_interval: float = 60.0   # dhtTestApp.testInterval
+    test_ttl: float = 300.0       # dhtTestApp.testTtl
+    storage_slots: int = 32       # per-node DHTDataStorage capacity
+    num_test_keys: int = 64       # GlobalDhtTestMap key pool size
+    op_timeout: float = 10.0      # CAPI timeout (lookup+put round)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DhtState:
+    """Per-node tier-1 storage + tier-2 driver state ([N, ...])."""
+
+    # DHTDataStorage
+    s_key: jnp.ndarray     # [N, D, KL] u32
+    s_val: jnp.ndarray     # [N, D] i32 (NO_VAL = empty)
+    s_expire: jnp.ndarray  # [N, D] i64
+    # test driver
+    t_test: jnp.ndarray    # [N] i64
+    seq: jnp.ndarray       # [N] i32
+    # one outstanding operation
+    op: jnp.ndarray        # [N] i32 OP_*
+    op_seq: jnp.ndarray    # [N] i32 — op nonce (stale-completion guard)
+    op_g: jnp.ndarray      # [N] i32 oracle slot
+    op_val: jnp.ndarray    # [N] i32 value being put
+    op_expect: jnp.ndarray  # [N] i32 truth value for pending GET
+    op_pending: jnp.ndarray  # [N] i32 replica responses awaited
+    op_acks: jnp.ndarray   # [N] i32
+    op_to: jnp.ndarray     # [N] i64 op timeout
+    op_t0: jnp.ndarray     # [N] i64 op start (latency stat)
+    # staged truth commit, folded into DhtGlobal by post_step
+    commit_g: jnp.ndarray      # [N] i32 oracle slot (-1 = none)
+    commit_val: jnp.ndarray    # [N] i32
+    commit_expire: jnp.ndarray  # [N] i64
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DhtGlobal:
+    """GlobalDhtTestMap: the key pool and current truth values."""
+
+    keys: jnp.ndarray   # [G, KL] u32 — fixed random test keys
+    val: jnp.ndarray    # [G] i32 — current truth (-1 = never put)
+    expire: jnp.ndarray  # [G] i64 — truth TTL deadline
+
+
+class DhtApp:
+    """Tier app (interface: apps/base.py)."""
+
+    def __init__(self, params: DhtParams = DhtParams(),
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+        self.p = params
+        self.spec = spec
+
+    def stat_spec(self):
+        return dict(
+            scalars=("dht_put_latency_s", "dht_get_latency_s"),
+            hists=(),
+            counters=("dht_put_attempts", "dht_put_success",
+                      "dht_get_attempts", "dht_get_success",
+                      "dht_get_wrong", "dht_get_notfound",
+                      "dht_lookup_failed", "dht_stored"))
+
+    def init(self, n: int) -> DhtState:
+        p, kl = self.p, self.spec.lanes
+        d = p.storage_slots
+        return DhtState(
+            s_key=jnp.zeros((n, d, kl), U32),
+            s_val=jnp.full((n, d), NO_VAL, I32),
+            s_expire=jnp.zeros((n, d), I64),
+            t_test=jnp.full((n,), T_INF, I64),
+            seq=jnp.zeros((n,), I32),
+            op=jnp.zeros((n,), I32),
+            op_seq=jnp.zeros((n,), I32),
+            op_g=jnp.zeros((n,), I32),
+            op_val=jnp.full((n,), NO_VAL, I32),
+            op_expect=jnp.full((n,), NO_VAL, I32),
+            op_pending=jnp.zeros((n,), I32),
+            op_acks=jnp.zeros((n,), I32),
+            op_to=jnp.full((n,), T_INF, I64),
+            op_t0=jnp.zeros((n,), I64),
+            commit_g=jnp.full((n,), -1, I32),
+            commit_val=jnp.full((n,), NO_VAL, I32),
+            commit_expire=jnp.zeros((n,), I64),
+        )
+
+    def glob_init(self, rng) -> DhtGlobal:
+        g = self.p.num_test_keys
+        return DhtGlobal(
+            keys=keys_mod.random_keys(rng, (g,), self.spec),
+            val=jnp.full((g,), NO_VAL, I32),
+            expire=jnp.zeros((g,), I64))
+
+    def post_step(self, ctx, state: DhtState, glob: DhtGlobal, events):
+        """Fold per-node staged put-commits into the truth map (the
+        moment the reference's DHTTestApp stores into GlobalDhtTestMap)."""
+        del events
+        rows = jnp.where(state.commit_g >= 0, state.commit_g,
+                         glob.val.shape[0])
+        glob = dataclasses.replace(
+            glob,
+            val=glob.val.at[rows].set(state.commit_val, mode="drop"),
+            expire=glob.expire.at[rows].set(state.commit_expire,
+                                            mode="drop"))
+        n = state.commit_g.shape[0]
+        state = dataclasses.replace(
+            state, commit_g=jnp.full((n,), -1, I32))
+        return state, glob
+
+    def on_ready(self, app, en, now, rng):
+        off = jax.random.uniform(rng, (), minval=0.0,
+                                 maxval=self.p.test_interval)
+        t = now + (off * NS).astype(I64)
+        return dataclasses.replace(app, t_test=jnp.where(en, t, app.t_test))
+
+    def on_stop(self, app, en):
+        return dataclasses.replace(
+            app,
+            t_test=jnp.where(en, T_INF, app.t_test),
+            op=jnp.where(en, OP_NONE, app.op),
+            op_to=jnp.where(en, T_INF, app.op_to))
+
+    def next_event(self, app):
+        return jnp.minimum(app.t_test, app.op_to)
+
+    # -- timers --------------------------------------------------------------
+
+    def on_timer(self, app, en, ctx, now, rng, ev):
+        p = self.p
+        glob: DhtGlobal = ctx.glob
+        g_n = glob.val.shape[0]
+
+        # op timeout → failed operation
+        to = (app.op != OP_NONE) & (app.op_to < ctx.t_end)
+        ev.count("dht_lookup_failed", to)
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(to, OP_NONE, app.op),
+            op_to=jnp.where(to, T_INF, app.op_to))
+
+        # periodic test: alternate PUT / GET (DHTTestApp::handleTimerEvent
+        # issues a put or get per tick of its own timers; we alternate on
+        # the sequence number)
+        fire = en & (app.t_test < ctx.t_end) & (app.op == OP_NONE)
+        r_g, r_v = jax.random.split(rng)
+        g = jax.random.randint(r_g, (), 0, g_n, dtype=I32)
+        do_get_pref = (app.seq % 2) == 1
+        truth_ok = (glob.val[g] != NO_VAL) & (glob.expire[g] > now)
+        do_get = fire & do_get_pref & truth_ok
+        do_put = fire & ~do_get
+        ev.count("dht_put_attempts", do_put)
+        ev.count("dht_get_attempts", do_get)
+        # fresh value id: unique per (node, seq) — 24 bits of rng + seq mix
+        val = jnp.abs(jax.random.randint(r_v, (), 0, 2**30, dtype=I32))
+        key = glob.keys[g]
+        app = dataclasses.replace(
+            app,
+            t_test=jnp.where(fire | (en & (app.t_test < ctx.t_end)),
+                             jnp.maximum(app.t_test, now) + jnp.int64(
+                                 int(p.test_interval * NS)),
+                             app.t_test),
+            seq=app.seq + fire.astype(I32),
+            op=jnp.where(do_put, OP_PUT, jnp.where(do_get, OP_GET, app.op)),
+            op_seq=jnp.where(fire, app.seq, app.op_seq),
+            op_g=jnp.where(fire, g, app.op_g),
+            op_val=jnp.where(do_put, val, app.op_val),
+            op_expect=jnp.where(do_get, glob.val[g], app.op_expect),
+            op_pending=jnp.where(fire, 0, app.op_pending),
+            op_acks=jnp.where(fire, 0, app.op_acks),
+            op_to=jnp.where(fire, now + jnp.int64(int(p.op_timeout * NS)),
+                            app.op_to),
+            op_t0=jnp.where(fire, now, app.op_t0))
+        return app, base.LookupReq(want=do_put | do_get, key=key,
+                                   tag=app.op_seq)
+
+    # -- lookup completion → replica fan-out ---------------------------------
+
+    def on_lookup_done(self, app, done: base.LookupDone, ctx, ob, ev, now,
+                       node_idx):
+        p = self.p
+        # op nonce match rejects completions of a previously-timed-out op
+        # (a fresh op may have started in the same window)
+        en = done.en & (app.op != OP_NONE) & (done.tag == app.op_seq)
+        suc = done.success & (done.results[0] != NO_NODE)
+        ev.count("dht_lookup_failed", en & ~suc)
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(en & ~suc, OP_NONE, app.op),
+            op_to=jnp.where(en & ~suc, T_INF, app.op_to))
+
+        # PUT: DHTPutCall to up to numReplica siblings (DHT.cc:210-237)
+        is_put = en & suc & (app.op == OP_PUT)
+        nrep = jnp.int32(0)
+        for i in range(min(p.num_replica, done.results.shape[0])):
+            tgt = done.results[i]
+            send = is_put & (tgt != NO_NODE)
+            # self-replica: store locally via on_msg loopback (send to self
+            # costs nothing in the delay model, SimpleUDP.cc:322)
+            # ns-precise expiry rides the stamp field — replica and truth
+            # map must share the exact same deadline
+            ob.send(send, now, tgt, wire.DHT_PUT_CALL, key=done.target,
+                    a=app.op_val,
+                    stamp=app.op_t0 + jnp.int64(int(self.p.test_ttl * NS)),
+                    size_b=wire.BASE_CALL_B + 20 + 8)
+            nrep += send.astype(I32)
+        app = dataclasses.replace(
+            app, op_pending=jnp.where(is_put, nrep, app.op_pending))
+
+        # GET: DHTGetCall to the closest sibling
+        is_get = en & suc & (app.op == OP_GET)
+        ob.send(is_get, now, done.results[0], wire.DHT_GET_CALL,
+                key=done.target, size_b=wire.BASE_CALL_B + 20)
+        return app
+
+    # -- inbound messages ----------------------------------------------------
+
+    def _store(self, app, en, key, val, expire):
+        """DHTDataStorage::addData: overwrite same key, else free slot,
+        else evict the earliest-expiring entry."""
+        same = en & jnp.any(jnp.all(app.s_key == key[None, :], axis=-1)
+                            & (app.s_val != NO_VAL))
+        col_same = jnp.argmax(
+            jnp.all(app.s_key == key[None, :], axis=-1)
+            & (app.s_val != NO_VAL)).astype(I32)
+        free = app.s_val == NO_VAL
+        col_free = jnp.argmax(free).astype(I32)
+        col_evict = jnp.argmin(app.s_expire).astype(I32)
+        col = jnp.where(same, col_same,
+                        jnp.where(jnp.any(free), col_free, col_evict))
+        col = jnp.where(en, col, app.s_val.shape[0])  # OOB drop
+        return dataclasses.replace(
+            app,
+            s_key=app.s_key.at[col].set(key, mode="drop"),
+            s_val=app.s_val.at[col].set(val, mode="drop"),
+            s_expire=app.s_expire.at[col].set(expire, mode="drop"))
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        p = self.p
+        now = m.t_deliver
+
+        # DHTPutCall → store + ack (DHT::handlePutRequest)
+        en = m.valid & (m.kind == wire.DHT_PUT_CALL)
+        expire = m.stamp
+        app = self._store(app, en, m.key, m.a, expire)
+        ev.count("dht_stored", en)
+        ob.send(en, now, m.src, wire.DHT_PUT_RES, key=m.key,
+                size_b=wire.BASE_CALL_B)
+
+        # DHTPutResponse → ack counting; full quorum = success
+        en = m.valid & (m.kind == wire.DHT_PUT_RES) & (app.op == OP_PUT)
+        acks = app.op_acks + en.astype(I32)
+        complete = en & (acks >= app.op_pending) & (app.op_pending > 0)
+        ev.count("dht_put_success", complete)
+        ev.value("dht_put_latency_s",
+                 (now - app.op_t0).astype(jnp.float32) / NS, complete)
+        app = dataclasses.replace(
+            app,
+            op_acks=acks,
+            op=jnp.where(complete, OP_NONE, app.op),
+            op_to=jnp.where(complete, T_INF, app.op_to),
+            # stage the truth commit for post_step
+            commit_g=jnp.where(complete, app.op_g, app.commit_g),
+            commit_val=jnp.where(complete, app.op_val, app.commit_val),
+            commit_expire=jnp.where(
+                complete, app.op_t0 + jnp.int64(int(p.test_ttl * NS)),
+                app.commit_expire))
+
+        # DHTGetCall → storage probe + reply (DHT::handleGetRequest)
+        en = m.valid & (m.kind == wire.DHT_GET_CALL)
+        hit = (jnp.all(app.s_key == m.key[None, :], axis=-1)
+               & (app.s_val != NO_VAL) & (app.s_expire > now))
+        found = jnp.any(hit)
+        val = jnp.where(found, app.s_val[jnp.argmax(hit)], NO_VAL)
+        ob.send(en, now, m.src, wire.DHT_GET_RES, key=m.key, a=val,
+                size_b=wire.BASE_CALL_B + 8)
+
+        # DHTGetResponse → validate vs the CURRENT truth (the reference
+        # reads GlobalDhtTestMap at response time, DHTTestApp.cc:121-182)
+        en = m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
+        expect = ctx.glob.val[jnp.clip(app.op_g, 0,
+                                       ctx.glob.val.shape[0] - 1)]
+        good = en & (m.a == expect) & (m.a != NO_VAL)
+        ev.count("dht_get_success", good)
+        ev.count("dht_get_wrong", en & (m.a != expect) & (m.a != NO_VAL))
+        ev.count("dht_get_notfound", en & (m.a == NO_VAL))
+        ev.value("dht_get_latency_s",
+                 (now - app.op_t0).astype(jnp.float32) / NS, good)
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(en, OP_NONE, app.op),
+            op_to=jnp.where(en, T_INF, app.op_to))
+        return app
+
+    @property
+    def hist_map(self):
+        return {}

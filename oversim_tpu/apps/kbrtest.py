@@ -10,10 +10,8 @@ delivery, hop count and latency; wrong-node deliveries count as failures
 KPI (GlobalStatistics sentKBRTestAppMessages/deliveredKBRTestAppMessages,
 GlobalStatistics.h:79-80).
 
-The app is a passive strategy object used by the overlay logic: the
-overlay calls the hooks below from inside its vmapped per-node step and
-runs the actual lookups/routing.  RPC and lookup test modes
-(kbrRpcTest/kbrLookupTest, off by default) are TODO.
+Implements the tier-app interface of apps/base.py; the RPC and lookup
+test modes (kbrRpcTest/kbrLookupTest, off by default) are TODO.
 """
 
 from __future__ import annotations
@@ -22,6 +20,9 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.common import wire
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -40,56 +41,97 @@ class KbrTestParams:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KbrTestState:
-    t_test: jnp.ndarray   # [] i64 per node — next one-way test
-    seq: jnp.ndarray      # [] i32 — sequence number
+    t_test: jnp.ndarray   # [N] i64 — next one-way test
+    seq: jnp.ndarray      # [N] i32 — sequence number
 
 
-def init(n: int) -> KbrTestState:
-    return KbrTestState(t_test=jnp.full((n,), T_INF, I64),
-                        seq=jnp.zeros((n,), I32))
+class KbrTestApp:
+    """Tier-1 app object (interface: apps/base.py docstring)."""
 
+    def __init__(self, params: KbrTestParams = KbrTestParams()):
+        self.p = params
 
-STAT_SCALARS = ("kbr_hopcount", "kbr_latency_s")
-STAT_COUNTERS = ("kbr_sent", "kbr_delivered", "kbr_wrong_node",
-                 "kbr_lookup_failed")
+    def stat_spec(self):
+        return dict(
+            scalars=("kbr_hopcount", "kbr_latency_s"),
+            hists=(("kbr_hop_hist", self.p.hop_hist_bins),),
+            counters=("kbr_sent", "kbr_delivered", "kbr_wrong_node",
+                      "kbr_lookup_failed"))
 
+    def init(self, n: int) -> KbrTestState:
+        return KbrTestState(t_test=jnp.full((n,), T_INF, I64),
+                            seq=jnp.zeros((n,), I32))
 
-def stat_spec(p: KbrTestParams):
-    return dict(scalars=STAT_SCALARS,
-                hists=(("kbr_hop_hist", p.hop_hist_bins),),
-                counters=STAT_COUNTERS)
+    def glob_init(self, rng):
+        return None
 
+    def post_step(self, ctx, state, glob, events):
+        return state, glob
 
-# -- per-node hooks (used inside the overlay's vmapped step) ---------------
+    def on_ready(self, app, en, now, rng):
+        """Overlay became READY: first test after a uniform offset
+        (reference: BaseApp periodicTimer starts uniform(0, interval))."""
+        off = jax.random.uniform(rng, (), minval=0.0,
+                                 maxval=self.p.test_interval)
+        t = now + (off * NS).astype(I64)
+        return dataclasses.replace(app,
+                                   t_test=jnp.where(en, t, app.t_test))
 
-def on_ready(app: KbrTestState, en, now, rng, p: KbrTestParams):
-    """Overlay became READY: schedule the first test after a uniform offset
-    (reference: BaseApp periodicTimer starts uniform(0, testMsgInterval))."""
-    off = jax.random.uniform(rng, (), minval=0.0, maxval=p.test_interval)
-    t = now + (off * NS).astype(I64)
-    return dataclasses.replace(app, t_test=jnp.where(en, t, app.t_test))
+    def on_stop(self, app, en):
+        return dataclasses.replace(app,
+                                   t_test=jnp.where(en, T_INF, app.t_test))
 
+    def next_event(self, app):
+        return app.t_test
 
-def on_stop(app: KbrTestState, en):
-    """Node left / lost READY: park the timer."""
-    return dataclasses.replace(app,
-                               t_test=jnp.where(en, T_INF, app.t_test))
+    def on_timer(self, app, en, ctx, now, rng, ev):
+        """Fire the periodic one-way test: request a route to a key drawn
+        from a random live node (createDestKey, lookupNodeIds=true)."""
+        en = en & (app.t_test < ctx.t_end)
+        dest = ctx.sample_ready(rng)
+        dest_key = ctx.keys[jnp.maximum(dest, 0)]
+        want = en & (dest != NO_NODE)
+        ev.count("kbr_sent", want)
+        app2 = dataclasses.replace(
+            app,
+            t_test=jnp.where(en, now + jnp.int64(
+                int(self.p.test_interval * NS)), app.t_test),
+            seq=app.seq + en.astype(I32))
+        return app2, base.LookupReq(want=want, key=dest_key, tag=app.seq)
 
+    def on_lookup_done(self, app, done: base.LookupDone, ctx, ob, ev, now,
+                       node_idx):
+        en = done.en
+        suc = done.success & (done.results[0] != NO_NODE)
+        ev.count("kbr_lookup_failed", en & ~suc)
+        res = done.results[0]
+        # final hop: payload to the sibling (sendToKey final direct hop)
+        ob.send(en & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
+                key=done.target, hops=done.hops,
+                c=ctx.measuring.astype(I32), stamp=done.t0,
+                size_b=self.p.test_msg_bytes)
+        # lookup ended on ourselves → local delivery
+        self_del = en & suc & (res == node_idx)
+        ev.count("kbr_delivered", self_del & ctx.measuring)
+        ev.value("kbr_hopcount", done.hops,
+                 self_del & ctx.measuring)
+        ev.value("kbr_latency_s",
+                 (now - done.t0).astype(jnp.float32) / NS,
+                 self_del & ctx.measuring)
+        return app
 
-def on_timer(app: KbrTestState, en, ctx, now, rng, p: KbrTestParams):
-    """Fire the periodic one-way test.  Returns
-    (app', want_route bool, dest_key [KL], seq i32): the overlay starts an
-    iterative lookup for dest_key and sends the payload to the sibling."""
-    dest = ctx.sample_ready(rng)
-    dest_key = ctx.keys[jnp.maximum(dest, 0)]
-    want = en & (dest != NO_NODE)
-    app = dataclasses.replace(
-        app,
-        t_test=jnp.where(en, now + jnp.int64(int(p.test_interval * NS)),
-                         app.t_test),
-        seq=app.seq + en.astype(I32))
-    return app, want, dest_key, app.seq
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        """KBRTestApp::deliver — seqnum dedup is subsumed by exactly-once
+        pool delivery; wrong-node check mirrors KBRTestApp.cc:252-286."""
+        en = m.valid & (m.kind == wire.APP_ONEWAY)
+        good = en & is_sib & (m.c != 0)
+        ev.count("kbr_delivered", good)
+        ev.count("kbr_wrong_node", en & ~is_sib & (m.c != 0))
+        ev.value("kbr_hopcount", m.hops + 1, good)
+        ev.value("kbr_latency_s",
+                 (m.t_deliver - m.stamp).astype(jnp.float32) / NS, good)
+        return app
 
-
-def next_event(app: KbrTestState):
-    return app.t_test
+    @property
+    def hist_map(self):
+        return {"kbr_hopcount": "kbr_hop_hist"}

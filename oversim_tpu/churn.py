@@ -20,6 +20,15 @@ Population conventions match the reference:
     re-create after a dead-time draw from the same distribution, with a
     fresh lifetime.  Distributions (LifetimeChurn.cc:distributionFunction):
     weibull (scale mean/Γ(1+1/k)), pareto_shifted, truncnormal.
+  * ParetoChurn (ParetoChurn.cc:44-219): two-level process — per-slot
+    individual mean life/dead times from a generalized pareto (alpha 3),
+    equilibrium init-phase population (alive w.p. l/(l+d)), a stretch
+    factor correcting the population-mean session to lifetimeMean, and
+    residual (alpha 2) draws for the sessions in progress at init.
+  * RandomChurn (RandomChurn.{h,cc}): a periodic tick every
+    churnChangeInterval that probabilistically creates or removes one
+    random node.
+  * TraceChurn replays GlobalTraceManager traces (see trace.py).
 """
 
 from __future__ import annotations
@@ -47,18 +56,29 @@ def _truncnormal(rng, mean, stddev, shape=()):
 class ChurnParams:
     """Reference params: default.ini:498-506 + ChurnGenerator.ned."""
 
-    model: str = "none"               # "none" | "lifetime"
+    model: str = "none"               # "none"|"lifetime"|"pareto"|"random"
     target_num: int = 10              # targetOverlayTerminalNum
     init_interval: float = 1.0        # initPhaseCreationInterval (s)
     init_deviation: float = 0.1
     lifetime_mean: float = 10000.0    # lifetimeMean (s)
+    deadtime_mean: float | None = None  # deadtimeMean (pareto; None = life)
     lifetime_dist: str = "weibull"    # lifetimeDistName
     lifetime_par1: float = 1.0        # lifetimeDistPar1
     graceful_leave_delay: float = 15.0
+    # RandomChurn (RandomChurn.{h,cc}): periodic probabilistic events
+    churn_change_interval: float = 10.0   # churnChangeInterval
+    creation_probability: float = 0.5     # creationProbability
+    removal_probability: float = 0.5      # removalProbability
 
     @property
     def num_slots(self) -> int:
-        return self.target_num if self.model == "none" else 2 * self.target_num
+        if self.model == "none":
+            return self.target_num
+        if self.model == "pareto":
+            # the reference draws nodes until `target` come up alive
+            # (expected availability l/(l+d)); 3x slots bounds the draw
+            return 3 * self.target_num
+        return 2 * self.target_num
 
     @property
     def init_finished_time(self) -> float:
@@ -71,6 +91,9 @@ class ChurnParams:
 class ChurnState:
     t_create: jnp.ndarray  # [N] i64 — pending create events (T_INF if none)
     t_kill: jnp.ndarray    # [N] i64 — pending kill events
+    l_mean: jnp.ndarray    # [N] f32 — per-slot mean lifetime (pareto)
+    d_mean: jnp.ndarray    # [N] f32 — per-slot mean deadtime (pareto)
+    t_tick: jnp.ndarray    # [] i64 — next periodic churn tick (random model)
 
 
 def _draw_lifetime(rng, p: ChurnParams, shape):
@@ -88,16 +111,26 @@ def _draw_lifetime(rng, p: ChurnParams, shape):
     raise ValueError(f"unknown lifetime distribution {p.lifetime_dist}")
 
 
+def _shifted_pareto(rng, alpha: float, mean, shape=()):
+    """ParetoChurn::shiftedPareto with betaByMean folded in
+    (ParetoChurn.cc:209-219): mean*(3-1)*(u^(-1/alpha) - 1).  beta derives
+    from the *schedule* alpha 3 even for the residual draw (alpha 2)."""
+    u = jax.random.uniform(rng, shape, minval=1e-12, maxval=1.0)
+    return mean * 2.0 * (jnp.power(u, -1.0 / alpha) - 1.0)
+
+
 def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
     n = p.num_slots
     tgt = p.target_num
+    zeros = jnp.zeros((n,), jnp.float32)
     r1, r2, r3, r4 = jax.random.split(rng, 4)
     if p.model == "none":
         stagger = _truncnormal(r1, p.init_interval, p.init_deviation, (n,))
         t_create = jnp.cumsum(stagger)
         return ChurnState(
             t_create=(t_create * NS).astype(I64),
-            t_kill=jnp.full((n,), T_INF, I64))
+            t_kill=jnp.full((n,), T_INF, I64),
+            l_mean=zeros, d_mean=zeros, t_tick=T_INF)
     if p.model == "lifetime":
         fin = p.init_finished_time
         i = jnp.arange(tgt)
@@ -112,12 +145,71 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         t_kill = jnp.maximum(t_kill - p.graceful_leave_delay, t_create)
         return ChurnState(
             t_create=(t_create * NS).astype(I64),
-            t_kill=(t_kill * NS).astype(I64))
+            t_kill=(t_kill * NS).astype(I64),
+            l_mean=zeros, d_mean=zeros, t_tick=T_INF)
+    if p.model == "pareto":
+        # ParetoChurn.cc:66-126: per-slot individual mean life/dead times,
+        # equilibrium init (alive w.p. availability), stretch to hit the
+        # configured global mean, residual draws for the first sessions
+        fin = p.init_finished_time
+        dmean = p.deadtime_mean if p.deadtime_mean is not None \
+            else p.lifetime_mean
+        ra, rb, rc, rd, re, rf = jax.random.split(rng, 6)
+        l_i = _shifted_pareto(ra, 3.0, p.lifetime_mean, (n,))
+        d_i = _shifted_pareto(rb, 3.0, dmean, (n,))
+        avail = l_i / (l_i + d_i)
+        alive0 = jax.random.uniform(rc, (n,)) < avail
+        # the reference draws slots until `tgt` come up alive
+        # (ParetoChurn.cc:71): only slots up to (and including) the
+        # tgt-th alive draw participate; later slots never exist — this
+        # keeps the long-run population at target (each participating
+        # slot contributes availability a_i, sum ≈ tgt)
+        alive_rank = jnp.cumsum(alive0.astype(jnp.int32))
+        is_init_alive = alive0 & (alive_rank <= tgt)
+        participating = alive_rank <= tgt
+        # (if fewer than tgt come up alive — vanishingly unlikely with 3x
+        # slots — the surplus dead slots simply all participate)
+        sum_li = jnp.sum(1.0 / (l_i + d_i))
+        mean_life = jnp.sum(l_i / ((l_i + d_i) * sum_li))
+        stretch = p.lifetime_mean / mean_life
+        l_i = l_i * stretch
+        d_i = d_i * stretch
+        live_idx = jnp.where(is_init_alive, alive_rank - 1, 0)
+        stagger = _truncnormal(rd, p.init_interval * live_idx,
+                               p.init_deviation, (n,))
+        res_l = _shifted_pareto(re, 2.0, l_i, (n,))
+        res_d = _shifted_pareto(rf, 2.0, d_i, (n,))
+        t_create = jnp.where(is_init_alive, stagger, fin + res_d)
+        first_life = jnp.where(is_init_alive, fin - stagger + res_l,
+                               _shifted_pareto(re, 3.0, l_i, (n,)))
+        t_kill = jnp.maximum(t_create + first_life - p.graceful_leave_delay,
+                             t_create)
+        t_create = jnp.where(participating, t_create, T_INF / NS)
+        t_kill = jnp.where(participating, t_kill, T_INF / NS)
+        return ChurnState(
+            t_create=(t_create * NS).astype(I64),
+            t_kill=(t_kill * NS).astype(I64),
+            l_mean=l_i.astype(jnp.float32), d_mean=d_i.astype(jnp.float32),
+            t_tick=T_INF)
+    if p.model == "random":
+        # RandomChurn: start tgt nodes, then probabilistic create/remove
+        # ticks every churnChangeInterval (step() drives the process)
+        stagger = _truncnormal(r1, p.init_interval, p.init_deviation, (n,))
+        t_create = jnp.cumsum(stagger)
+        t_create = jnp.where(jnp.arange(n) < tgt, t_create, T_INF / NS)
+        return ChurnState(
+            t_create=(t_create * NS).astype(I64),
+            t_kill=jnp.full((n,), T_INF, I64),
+            l_mean=zeros, d_mean=zeros,
+            t_tick=jnp.int64(int((p.init_finished_time
+                                  + p.churn_change_interval) * NS)))
     raise ValueError(f"unknown churn model {p.model}")
 
 
 def next_event(state: ChurnState):
-    return jnp.minimum(jnp.min(state.t_create), jnp.min(state.t_kill))
+    return jnp.minimum(state.t_tick,
+                       jnp.minimum(jnp.min(state.t_create),
+                                   jnp.min(state.t_kill)))
 
 
 def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
@@ -132,9 +224,10 @@ def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
 
     t_create = jnp.where(created, T_INF, state.t_create)
     t_kill = state.t_kill
+    t_tick = state.t_tick
+    n = p.num_slots
 
     if p.model == "lifetime":
-        n = p.num_slots
         r1, r2 = jax.random.split(rng)
         dead_time = (_draw_lifetime(r1, p, (n,)) * NS).astype(I64)
         lifetime = (_draw_lifetime(r2, p, (n,)) * NS).astype(I64)
@@ -143,7 +236,45 @@ def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
         next_kill = jnp.maximum(next_create + lifetime - graceful, next_create)
         t_create = jnp.where(killed, next_create, t_create)
         t_kill = jnp.where(killed, next_kill, t_kill)
+    elif p.model == "pareto":
+        # ParetoChurn::deleteNode (ParetoChurn.cc:182-196): rebirth after
+        # individualLifetime(d_i), next session individualLifetime(l_i)
+        r1, r2 = jax.random.split(rng)
+        dead_time = (_shifted_pareto(r1, 3.0, state.d_mean, (n,))
+                     * NS).astype(I64)
+        lifetime = (_shifted_pareto(r2, 3.0, state.l_mean, (n,))
+                    * NS).astype(I64)
+        graceful = jnp.int64(p.graceful_leave_delay * NS)
+        next_create = state.t_kill + dead_time
+        next_kill = jnp.maximum(next_create + lifetime - graceful, next_create)
+        t_create = jnp.where(killed, next_create, t_create)
+        t_kill = jnp.where(killed, next_kill, t_kill)
+    elif p.model == "random":
+        # RandomChurn::handleMessage: every churnChangeInterval flip a coin
+        # for one create and one removal (probabilistic population drift)
+        t_kill = jnp.where(killed, T_INF, t_kill)
+        tick = t_tick < t_end
+        r1, r2, r3, r4 = jax.random.split(rng, 4)
+        do_create = tick & (jax.random.uniform(r1) < p.creation_probability)
+        do_remove = tick & (jax.random.uniform(r2) < p.removal_probability)
+        cur_alive = (alive | created) & ~killed
+        # random dead slot → create now; random alive slot → kill now
+        dead_w = jnp.where(~cur_alive & (t_create >= T_INF), 1.0, 0.0)
+        alive_w = jnp.where(cur_alive, 1.0, 0.0)
+        has_dead = jnp.sum(dead_w) > 0
+        has_alive = jnp.sum(alive_w) > 0
+        di = jax.random.categorical(r3, jnp.log(jnp.maximum(dead_w, 1e-30)))
+        ai = jax.random.categorical(r4, jnp.log(jnp.maximum(alive_w, 1e-30)))
+        t_create = t_create.at[di].set(
+            jnp.where(do_create & has_dead, t_end, t_create[di]))
+        t_kill = t_kill.at[ai].set(
+            jnp.where(do_remove & has_alive, t_end, t_kill[ai]))
+        t_tick = jnp.where(
+            tick, t_tick + jnp.int64(int(p.churn_change_interval * NS)),
+            t_tick)
     else:
         t_kill = jnp.where(killed, T_INF, t_kill)
 
-    return ChurnState(t_create=t_create, t_kill=t_kill), created, killed
+    return ChurnState(t_create=t_create, t_kill=t_kill,
+                      l_mean=state.l_mean, d_mean=state.d_mean,
+                      t_tick=t_tick), created, killed

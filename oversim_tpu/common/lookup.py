@@ -95,6 +95,9 @@ class LookupState:
     done: jnp.ndarray         # [L] bool — completed, not yet dispatched
     success: jnp.ndarray      # [L] bool
     result: jnp.ndarray       # [L] i32 — sibling node slot (NO_NODE on fail)
+    results: jnp.ndarray      # [L, F] i32 — full final sibling set (the
+                              # FindNodeResponse payload; DHT replica puts
+                              # need numReplica siblings, DHT.cc:504)
     t_done: jnp.ndarray       # [L] i64 — completion time (next_event wake)
 
 
@@ -118,6 +121,7 @@ def init(cfg: LookupConfig, kl: int) -> LookupState:
         done=jnp.zeros((l,), bool),
         success=jnp.zeros((l,), bool),
         result=jnp.full((l,), NO_NODE, I32),
+        results=jnp.full((l, f), NO_NODE, I32),
         t_done=jnp.full((l,), T_INF, I64),
     )
 
@@ -166,6 +170,8 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
         done=lk.done.at[slot].set(False, mode="drop"),
         success=lk.success.at[slot].set(False, mode="drop"),
         result=lk.result.at[slot].set(NO_NODE, mode="drop"),
+        results=lk.results.at[slot].set(
+            jnp.full((f,), NO_NODE, I32), mode="drop"),
         t_done=lk.t_done.at[slot].set(T_INF, mode="drop"),
     )
 
@@ -214,6 +220,7 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         done=lk.done.at[slot_fin].set(True, mode="drop"),
         success=lk.success.at[slot_fin].set(True, mode="drop"),
         result=lk.result.at[slot_fin].set(resp_nodes[0], mode="drop"),
+        results=lk.results.at[slot_fin].set(resp_nodes, mode="drop"),
         t_done=lk.t_done.at[slot_fin].set(msg.t_deliver, mode="drop"))
 
     # not finished: update the frontier
@@ -225,9 +232,7 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
                                  jnp.full((f,), F_NEW, I32)])
         # dedupe: a response node equal to an existing frontier entry is
         # invalidated (keeps the entry with its flag state)
-        eqm = cand[None, :] == cand[:, None]
-        earlier = jnp.tril(jnp.ones((2 * f, 2 * f), bool), k=-1)
-        dup = jnp.any(eqm & earlier, axis=1) | (cand == NO_NODE)
+        dup = keys_mod.dup_mask(cand) | (cand == NO_NODE)
         cand = jnp.where(dup, NO_NODE, cand)
         dist = metric_fn(cand, lk.target[l])          # [2F, KL]
         dist = jnp.where(dup[:, None], jnp.uint32(0xFFFFFFFF), dist)
@@ -343,8 +348,8 @@ def take_completions(lk: LookupState, t_end):
     """
     taken = lk.done & (lk.t_done < t_end)
     comp = dict(taken=taken, success=lk.success & taken, result=lk.result,
-                purpose=lk.purpose, aux=lk.aux, hops=lk.hops, t0=lk.t0,
-                target=lk.target)
+                results=lk.results, purpose=lk.purpose, aux=lk.aux,
+                hops=lk.hops, t0=lk.t0, target=lk.target)
     lk = dataclasses.replace(
         lk,
         active=lk.active & ~taken,

@@ -32,10 +32,26 @@ CHORD_SUCC_HINT = 16    # NewSuccessorHintMessage (aggressive join)
 
 # --- application payloads ---
 APP_ONEWAY = 30         # KBRTestApp one-way test payload (routed data)
+DHT_PUT_CALL = 31       # DHTPutCall: key, value id, ttl (DHT.msg)
+DHT_PUT_RES = 32
+DHT_GET_CALL = 33       # DHTGetCall: key
+DHT_GET_RES = 34        # DHTGetResponse: value id (-1 = not found)
 
 # --- Kademlia (src/overlay/kademlia) ---
 KAD_PING_CALL = 40      # routingAdd liveness ping (maintenance)
 KAD_PING_RES = 41
+
+# --- Pastry / Bamboo (src/overlay/pastry, bamboo; PastryMessage.msg) ---
+PASTRY_STATE_CALL = 20  # RequestStateMessage / leafset push-pull
+PASTRY_STATE_RES = 21   # PastryStateMessage: leafset (+ self) payload
+
+# --- GIA (src/overlay/gia; GiaMessage.msg) ---
+GIA_NEIGHBOR_CALL = 60  # GiaNeighborMessage: connect request (capacity)
+GIA_NEIGHBOR_RES = 61   # accept/deny + own neighbor sample
+GIA_TOKEN = 62          # GiaTokenFactory::sendToken flow-control grant
+GIA_QUERY = 63          # GiaSearchMessage: biased random-walk search
+GIA_QUERY_RES = 64      # GiaSearchResponseMessage (direct to originator)
+GIA_DISCONNECT = 65     # GiaDisconnectMessage (dropped neighbor notice)
 
 NODEHANDLE_B = 25
 

@@ -1,0 +1,184 @@
+"""Scenario builder: resolved .ini parameters → a runnable Simulation.
+
+The reference wires a simulation from string-configured module types
+(``**.overlayType = "oversim.overlay.chord.ChordModules"``,
+``**.tier1Type = "...KBRTestAppModules"``, churnGeneratorTypes —
+simulations/default.ini:622-628) plus per-module parameter namespaces.
+This module is the equivalent factory: it reads the same namespaces off an
+`IniFile` and instantiates the engine's typed params / logic objects, so a
+reference config runs against the TPU backend unchanged.
+"""
+
+from __future__ import annotations
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps import kbrtest
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.config.ini import IniFile, Study
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.underlay import simple as underlay_mod
+
+HOST = "OverSim.overlayTerminal[0]"   # representative node path
+
+
+def _value(x, default=None):
+    if isinstance(x, Study):
+        x = x.default()
+    return default if x is None else x
+
+
+class ScenarioError(ValueError):
+    pass
+
+
+def _get(ini, config, suffix, default=None):
+    return _value(ini.get(f"{HOST}.{suffix}", config), default)
+
+
+def build_churn(ini: IniFile, config: str) -> churn_mod.ChurnParams:
+    gen = str(ini.get("OverSim.churnGenerator[0].__type__", config)
+              or _value(ini.get("**.churnGeneratorTypes", config),
+                        "oversim.common.NoChurn"))
+    target = int(_value(ini.get("**.targetOverlayTerminalNum", config), 10))
+    init_interval = float(_value(
+        ini.get("**.initPhaseCreationInterval", config), 0.1))
+    model = ("lifetime" if "LifetimeChurn" in gen
+             else "pareto" if "ParetoChurn" in gen
+             else "random" if "RandomChurn" in gen
+             else "none")
+    kw = {}
+    if model in ("lifetime", "pareto"):
+        kw["lifetime_mean"] = float(_value(
+            ini.get("**.lifetimeMean", config), 10000.0))
+        dist = str(_value(ini.get("**.lifetimeDistName", config), "weibull"))
+        kw["lifetime_dist"] = dist
+        kw["lifetime_par1"] = float(_value(
+            ini.get("**.lifetimeDistPar1", config), 1.0))
+    if model == "pareto":
+        dm = ini.get("**.deadtimeMean", config)
+        if dm is not None:
+            kw["deadtime_mean"] = float(_value(dm))
+    return churn_mod.ChurnParams(
+        model=model, target_num=target, init_interval=init_interval, **kw)
+
+
+def build_underlay(ini: IniFile, config: str) -> underlay_mod.UnderlayParams:
+    return underlay_mod.UnderlayParams(
+        field_size=float(_value(ini.get("**.fieldSize", config), 150.0)),
+        send_queue_bytes=int(_value(
+            ini.get("**.sendQueueLength", config), 1_000_000)),
+        constant_delay=float(_value(
+            ini.get("**.constantDelay", config), 0.050)),
+        use_coordinate_based_delay=bool(_value(
+            ini.get("**.useCoordinateBasedDelay", config), True)),
+    )
+
+
+def build_app(ini: IniFile, config: str, spec: K.KeySpec):
+    """tier1Type/tier2Type string → app object (reference default.ini:622-628
+    module-type plugin selection)."""
+    t1 = str(_value(ini.get("**.tier1Type", config), ""))
+    t2 = str(_value(ini.get("**.tier2Type", config), ""))
+    if "DHT" in t1 or "DHTTestApp" in t2:
+        from oversim_tpu.apps.dht import DhtApp, DhtParams
+        return DhtApp(DhtParams(
+            num_replica=int(_get(ini, config, "tier1.dht.numReplica", 4)),
+            test_interval=float(_get(
+                ini, config, "tier2.dhtTestApp.testInterval", 60.0)),
+            test_ttl=float(_get(
+                ini, config, "tier2.dhtTestApp.testTtl", 300.0)),
+        ), spec)
+    from oversim_tpu.apps.kbrtest import KbrTestApp
+    return KbrTestApp(kbrtest.KbrTestParams(
+        test_interval=float(_get(
+            ini, config, "tier1.kbrTestApp.testMsgInterval", 60.0)),
+        test_msg_bytes=int(_get(
+            ini, config, "tier1.kbrTestApp.testMsgSize", 100)),
+    ))
+
+
+def build_lookup_config(ini: IniFile, config: str, proto: str,
+                        merge_default: bool) -> lk_mod.LookupConfig:
+    ns = f"overlay.{proto}"
+    return lk_mod.LookupConfig(
+        merge=bool(_get(ini, config, f"{ns}.lookupMerge", merge_default)),
+        rpc_timeout_ns=int(float(_value(
+            ini.get("**.rpcUdpTimeout", config), 1.5)) * 1e9),
+    )
+
+
+def build_simulation(ini: IniFile, config: str = "General",
+                     engine_params: sim_mod.EngineParams | None = None):
+    """Instantiate the full Simulation for one [Config ...] section."""
+    overlay_type = str(_value(ini.get("**.overlayType", config), ""))
+    spec = K.KeySpec(int(_value(ini.get("**.keyLength", config), 160)))
+    cp = build_churn(ini, config)
+    up = build_underlay(ini, config)
+    ap = build_app(ini, config, spec)
+    ep = engine_params or sim_mod.EngineParams(
+        transition_time=float(_value(
+            ini.get("**.transitionTime", config), 0.0)),
+        measurement_time=float(_value(
+            ini.get("**.measurementTime", config), -1.0)),
+    )
+
+    if "chord" in overlay_type.lower():
+        from oversim_tpu.overlay.chord import ChordLogic, ChordParams
+        params = ChordParams(
+            join_delay=float(_get(ini, config, "overlay.chord.joinDelay",
+                                  10.0)),
+            stabilize_delay=float(_get(
+                ini, config, "overlay.chord.stabilizeDelay", 20.0)),
+            fixfingers_delay=float(_get(
+                ini, config, "overlay.chord.fixfingersDelay", 120.0)),
+            check_pred_delay=float(_get(
+                ini, config, "overlay.chord.checkPredecessorDelay", 5.0)),
+            succ_size=int(_get(
+                ini, config, "overlay.chord.successorListSize", 8)),
+            aggressive_join=bool(_get(
+                ini, config, "overlay.chord.aggressiveJoinMode", True)),
+        )
+        logic = ChordLogic(spec, params,
+                           build_lookup_config(ini, config, "chord", False),
+                           ap)
+    elif "kademlia" in overlay_type.lower():
+        from oversim_tpu.overlay.kademlia import (KademliaLogic,
+                                                  KademliaParams)
+        params = KademliaParams(
+            k=int(_get(ini, config, "overlay.kademlia.k", 8)),
+            s=int(_get(ini, config, "overlay.kademlia.s", 8)),
+            max_stale=int(_get(
+                ini, config, "overlay.kademlia.maxStaleCount", 0)),
+            sibling_refresh=float(_get(
+                ini, config,
+                "overlay.kademlia.minSiblingTableRefreshInterval", 1000.0)),
+            bucket_refresh=float(_get(
+                ini, config,
+                "overlay.kademlia.minBucketRefreshInterval", 1000.0)),
+            redundant_nodes=int(_get(
+                ini, config, "overlay.kademlia.lookupRedundantNodes", 8)),
+        )
+        logic = KademliaLogic(spec, params,
+                              build_lookup_config(ini, config, "kademlia",
+                                                  True), ap)
+    elif "pastry" in overlay_type.lower() or "bamboo" in overlay_type.lower():
+        from oversim_tpu.overlay.pastry import (BambooLogic, PastryLogic,
+                                                PastryParams)
+        proto = ("bamboo" if "bamboo" in overlay_type.lower() else "pastry")
+        params = PastryParams(
+            bits_per_digit=int(_get(
+                ini, config, f"overlay.{proto}.bitsPerDigit", 4)),
+            num_leaves=int(_get(
+                ini, config, f"overlay.{proto}.numberOfLeaves",
+                8 if proto == "bamboo" else 16)),
+            join_delay=int(_get(
+                ini, config, f"overlay.{proto}.joinTimeout", 20)),
+        )
+        cls = BambooLogic if proto == "bamboo" else PastryLogic
+        logic = cls(spec, params,
+                    build_lookup_config(ini, config, proto, False), ap)
+    else:
+        raise ScenarioError(f"unsupported overlayType: {overlay_type!r}")
+
+    return sim_mod.Simulation(logic, cp, up, ep)

@@ -191,6 +191,34 @@ def bit(key, index, spec: KeySpec = DEFAULT_SPEC):
     return (word >> off.astype(U32)) & jnp.uint32(1)
 
 
+def digit(key, index, b: int, spec: KeySpec = DEFAULT_SPEC):
+    """b-bit digit ``index`` counted from the MSB (Pastry prefix digits;
+    reference OverlayKey::getBitRange as used by PastryRoutingTable).
+    ``index`` may be traced."""
+    index = jnp.asarray(index)
+    out = jnp.zeros(jnp.broadcast_shapes(index.shape, key.shape[:-1]),
+                    dtype=jnp.int32)
+    for j in range(b):
+        pos = spec.bits - 1 - (index * b + j)
+        out = (out << 1) | jnp.where(
+            pos >= 0, bit(key, jnp.maximum(pos, 0), spec).astype(jnp.int32), 0)
+    return out
+
+
+def shared_prefix_digits(a, b_key, b: int, spec: KeySpec = DEFAULT_SPEC):
+    """Number of common leading b-bit digits (Pastry row index)."""
+    return shared_prefix_length(a, b_key, spec) // b
+
+
+def abs_diff(a, b, spec: KeySpec = DEFAULT_SPEC):
+    """Plain numerical |a - b| (NON-modular; Pastry's numeric-closeness
+    metric, BasePastry 'numerically closest' comparisons)."""
+    a_ge = ge(a, b)
+    d1 = sub(a, b, spec)
+    d2 = sub(b, a, spec)
+    return jnp.where(a_ge[..., None], d1, d2)
+
+
 def pow2(exponent: int, spec: KeySpec = DEFAULT_SPEC) -> jnp.ndarray:
     """Single key 2**exponent (host-side; finger-table offsets)."""
     return from_int(1 << exponent, spec)
@@ -291,6 +319,15 @@ def shared_prefix_length(a, b, spec: KeySpec = DEFAULT_SPEC):
 def log2_floor(key, spec: KeySpec = DEFAULT_SPEC):
     """floor(log2(key)) as int32; -1 for key == 0 (bucket indexing)."""
     return spec.bits - 1 - shared_prefix_length(key, jnp.zeros_like(key), spec)
+
+
+def dup_mask(vec):
+    """[C] → [C] bool marking every later duplicate of an earlier entry
+    (keep-first semantics).  Shared dedupe primitive for candidate-set
+    merges (NodeVector::add rejects keys already present, NodeVector.h)."""
+    c = vec.shape[0]
+    eq = vec[None, :] == vec[:, None]
+    return jnp.any(eq & jnp.tril(jnp.ones((c, c), bool), k=-1), axis=1)
 
 
 # ---------------------------------------------------------------------------

@@ -72,9 +72,11 @@ class Ctx:
     t_end: jnp.ndarray        # i64 scalar — window end (exclusive)
     keys: jnp.ndarray         # [N, KL] u32 — global node-key table (oracle)
     alive: jnp.ndarray        # [N] bool
+    ready: jnp.ndarray        # [N] bool — overlay READY at window start
     ready_cumsum: jnp.ndarray  # [N] i32 inclusive cumsum of ready mask
     n_ready: jnp.ndarray      # i32 scalar
     measuring: jnp.ndarray    # bool scalar — inside measurement phase
+    glob: object = None       # logic-global read-only state (see LogicBase)
 
     def sample_ready(self, rng):
         """Draw a uniformly random READY node slot (-1 if none).
@@ -87,6 +89,35 @@ class Ctx:
                                dtype=I32)
         idx = jnp.searchsorted(self.ready_cumsum, k + 1, side="left").astype(I32)
         return jnp.where(self.n_ready > 0, idx, NO_NODE)
+
+
+class LogicBase:
+    """Optional base for logic objects: splits state into a vmapped
+    per-node part and a simulation-global part.
+
+    The reference has true singletons next to the per-node module stacks
+    (GlobalNodeList, GlobalStatistics, GlobalDhtTestMap — SURVEY.md §1).
+    Per-node handlers run vmapped and cannot write shared arrays, so
+    global state follows a gather/scatter discipline:
+
+      * ``split(state) -> (node_part, glob)``: ``node_part`` is the
+        [N, ...] pytree the engine vmaps over; ``glob`` is broadcast
+        read-only into every handler as ``ctx.glob``;
+      * handlers emit ``"g:name"`` entries in their events dict
+        (per-node update requests; ignored by the stats sink);
+      * ``post_step(ctx, state, events) -> state`` runs un-vmapped after
+        the node sweep and folds those events into the global part.
+    """
+
+    def split(self, state):
+        return state, None
+
+    def merge(self, node_part, glob):
+        return node_part
+
+    def post_step(self, ctx, state, events):
+        del ctx, events
+        return state
 
 
 class Outbox:

@@ -178,16 +178,22 @@ class Simulation:
         if ep.measurement_time >= 0:
             measuring &= t_next < measure_start + jnp.int64(
                 int(ep.measurement_time * NS))
+        node_part, glob = (logic.split(logic_state)
+                           if hasattr(logic, "split") else (logic_state, None))
         ctx = Ctx(t_start=t_next, t_end=t_end, keys=node_keys, alive=alive,
-                  ready_cumsum=ready_cumsum, n_ready=ready_cumsum[-1],
-                  measuring=measuring)
+                  ready=ready, ready_cumsum=ready_cumsum,
+                  n_ready=ready_cumsum[-1], measuring=measuring, glob=glob)
         node_rngs = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
             jax.random.fold_in(r_nodes, s.tick), jnp.arange(n))
         node_idx = jnp.arange(n, dtype=I32)
 
-        logic_state, out_fields, out_valid, out_overflow, events = jax.vmap(
+        node_part, out_fields, out_valid, out_overflow, events = jax.vmap(
             self._node_step, in_axes=(None, 0, 0, 0, 0))(
-                ctx, logic_state, msgs, node_rngs, node_idx)
+                ctx, node_part, msgs, node_rngs, node_idx)
+        logic_state = (logic.merge(node_part, glob)
+                       if hasattr(logic, "merge") else node_part)
+        if hasattr(logic, "post_step"):
+            logic_state = logic.post_step(ctx, logic_state, events)
 
         # 5. free delivered, send outbox through the underlay
         new_pool = pool_mod.free(s.pool, delivered | to_dead)

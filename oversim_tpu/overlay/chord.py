@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 
 from oversim_tpu import stats as stats_mod
-from oversim_tpu.apps import kbrtest
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
@@ -94,8 +95,10 @@ class ChordState:
     stab_dst: jnp.ndarray      # [N] i32
     stab_to: jnp.ndarray       # [N] i64
     cp_to: jnp.ndarray         # [N] i64 pending predecessor-ping timeout
+    cp_dst: jnp.ndarray        # [N] i32 the node that ping targeted
     lk: lk_mod.LookupState     # [N, L, ...]
-    app: kbrtest.KbrTestState  # [N]
+    app: object                # [N, ...] tier-app state (apps/base.py)
+    app_glob: object           # simulation-global app state (oracle maps)
 
 
 def _sort_lanes(dist, payload):
@@ -115,17 +118,17 @@ class ChordLogic:
     def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
                  params: ChordParams = ChordParams(),
                  lcfg: lk_mod.LookupConfig = lk_mod.LookupConfig(),
-                 app_params: kbrtest.KbrTestParams = kbrtest.KbrTestParams()):
+                 app=None):
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg
-        self.ap = app_params
+        self.app = app or KbrTestApp()
         self._pow2 = K.pow2_table(spec)          # [B, KL] finger offsets
 
     # -- engine interface ---------------------------------------------------
 
     def stat_spec(self) -> stats_mod.StatSpec:
-        app = kbrtest.stat_spec(self.ap)
+        app = self.app.stat_spec()
         return stats_mod.StatSpec(
             scalars=tuple(app["scalars"]) + ("lookup_hops",),
             hists=tuple(app["hists"]),
@@ -133,8 +136,17 @@ class ChordLogic:
                 "chord_joins", "lookup_success", "lookup_failed"),
         )
 
+    def split(self, st: ChordState):
+        return dataclasses.replace(st, app_glob=None), st.app_glob
+
+    def merge(self, node_part: ChordState, glob):
+        return dataclasses.replace(node_part, app_glob=glob)
+
+    def post_step(self, ctx, st: ChordState, events):
+        app, glob = self.app.post_step(ctx, st.app, st.app_glob, events)
+        return dataclasses.replace(st, app=app, app_glob=glob)
+
     def init(self, rng, n: int) -> ChordState:
-        del rng
         s, b = self.p.succ_size, self.key_spec.bits
         return ChordState(
             state=jnp.zeros((n,), I32),
@@ -150,15 +162,20 @@ class ChordLogic:
             stab_dst=jnp.full((n,), NO_NODE, I32),
             stab_to=jnp.full((n,), T_INF, I64),
             cp_to=jnp.full((n,), T_INF, I64),
+            cp_dst=jnp.full((n,), NO_NODE, I32),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
-            app=kbrtest.init(n),
+            app=self.app.init(n),
+            app_glob=self.app.glob_init(rng),
         )
 
     def reset(self, st: ChordState, clear, join, t_now, rng) -> ChordState:
         n = st.state.shape[0]
-        fresh = self.init(None, n)
+        glob = st.app_glob
+        st = dataclasses.replace(st, app_glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), app_glob=None)
         st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, app_glob=glob)
         jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
         return dataclasses.replace(
             st,
@@ -176,7 +193,8 @@ class ChordLogic:
             t = jnp.minimum(t, jnp.where(ready, timer, T_INF))
         t = jnp.minimum(t, st.stab_to)
         t = jnp.minimum(t, st.cp_to)
-        t = jnp.minimum(t, jnp.where(ready, kbrtest.next_event(st.app), T_INF))
+        t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
+                                     T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
         return t
 
@@ -226,10 +244,7 @@ class ChordLogic:
         s = self.p.succ_size
         c = cands
         ck = ctx.keys[jnp.maximum(c, 0)]
-        eq = c[None, :] == c[:, None]
-        dup = jnp.any(eq & jnp.tril(jnp.ones((c.shape[0],) * 2, bool), -1),
-                      axis=1)
-        bad = (c == NO_NODE) | (c == node_idx) | dup
+        bad = (c == NO_NODE) | (c == node_idx) | K.dup_mask(c)
         d = K.sub(ck, jnp.broadcast_to(me_key, ck.shape), self.key_spec)
         d = jnp.where(bad[:, None], UMAX, d)
         c_s, bad_s = _sort_lanes(d, (c, bad.astype(I32)))
@@ -245,14 +260,22 @@ class ChordLogic:
                                  jnp.concatenate([succ, node[None]]))
 
     def _handle_failed(self, ctx, st, me_key, node_idx, failed, now):
-        """Chord::handleFailedNode (Chord.cc:502) for one failed slot."""
-        en = failed != NO_NODE
-        pred = jnp.where(en & (st.pred == failed), NO_NODE, st.pred)
-        was_succ0 = en & (st.succ[0] == failed)
-        succ_masked = jnp.where(st.succ == failed, NO_NODE, st.succ)
+        """Chord::handleFailedNode (Chord.cc:502) for a [F] vector of
+        failed slots (NO_NODE entries ignored) — one sort for the whole
+        batch instead of one call per failure source."""
+        failed = jnp.where(failed == node_idx, NO_NODE, failed)
+        any_failed = jnp.any(failed != NO_NODE)
+
+        def hit(x):
+            return (x[..., None] == failed).any(-1) & (x != NO_NODE)
+
+        en = any_failed
+        pred = jnp.where(hit(st.pred), NO_NODE, st.pred)
+        was_succ0 = hit(st.succ[0])
+        succ_masked = jnp.where(hit(st.succ), NO_NODE, st.succ)
         succ = self._succ_sorted(ctx, me_key, node_idx, succ_masked)
         succ = jnp.where(en, succ, st.succ)
-        fhit = en & (st.finger == failed)
+        fhit = hit(st.finger)
         finger = jnp.where(fhit, NO_NODE, st.finger)
         finger_dirty = st.finger_dirty | fhit
         t_stab = jnp.where(was_succ0, now, st.t_stab)
@@ -275,8 +298,9 @@ class ChordLogic:
             stab_op=jnp.where(rejoin, 0, st.stab_op),
             stab_to=jnp.where(rejoin, T_INF, st.stab_to),
             cp_to=jnp.where(rejoin, T_INF, st.cp_to),
+            cp_dst=jnp.where(rejoin, NO_NODE, st.cp_dst),
             lk=select_tree(rejoin, fresh_lk, st.lk),
-            app=kbrtest.on_stop(st.app, rejoin))
+            app=self.app.on_stop(st.app, rejoin))
         return st
 
     def _become_ready(self, ctx, st, en, now, rng):
@@ -293,7 +317,7 @@ class ChordLogic:
             t_fix=jnp.where(en, now, st.t_fix),
             t_cp=jnp.where(en, now + jnp.int64(int(p.check_pred_delay * NS)),
                            st.t_cp),
-            app=kbrtest.on_ready(st.app, en, now, rng, self.ap))
+            app=self.app.on_ready(st.app, en, now, rng))
         return st
 
     # -- the per-node step ---------------------------------------------------
@@ -315,13 +339,10 @@ class ChordLogic:
             return K.sub(jnp.broadcast_to(target, ck.shape), ck, spec)
 
         # event accumulators
+        ev = app_base.AppEvents()
         joins_cnt = jnp.int32(0)
-        sent_cnt = jnp.int32(0)
-        wrong_cnt = jnp.int32(0)
-        lkfail_cnt = jnp.int32(0)   # failed app routes only (KBR KPI)
         anyfail_cnt = jnp.int32(0)  # failed lookups of any purpose
         lksucc_cnt = jnp.int32(0)
-        deliv_hops, deliv_lat, deliv_mask = [], [], []
 
         # ------------------------------------------------------- inbox -----
         for r in range(msgs.valid.shape[0]):
@@ -330,13 +351,20 @@ class ChordLogic:
             v = m.valid
 
             # FindNodeCall → findNode + sibling flag (findNodeRpc,
-            # BaseOverlay.cc:1841)
+            # BaseOverlay.cc:1841).  When responsible, the response is the
+            # sibling set — ourselves followed by our successor list
+            # (Chord::findNode returns siblings for isSiblingFor keys,
+            # Chord.cc:548-560) — so callers wanting numSiblings replicas
+            # (DHT puts) get the full replica set.
             en = v & (m.kind == wire.FINDNODE_CALL)
             nxt, sib = self._find_node(ctx, st, me_key, node_idx, m.key)
+            sib_set = pad_nodes(jnp.concatenate([node_idx[None], st.succ]))
+            res_nodes = jnp.where(
+                sib, sib_set, jnp.full((rmax,), NO_NODE, I32).at[0].set(nxt))
+            n_res = jnp.sum((res_nodes != NO_NODE).astype(I32))
             ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
-                    a=m.a, b=m.b, c=sib.astype(I32),
-                    nodes=jnp.full((rmax,), NO_NODE, I32).at[0].set(nxt),
-                    size_b=wire.findnode_res_b(1))
+                    a=m.a, b=m.b, c=sib.astype(I32), nodes=res_nodes,
+                    size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
 
             # FindNodeResponse → lookup engine
             en = v & (m.kind == wire.FINDNODE_RES)
@@ -450,24 +478,21 @@ class ChordLogic:
                 take, self._succ_add(ctx, me_key, node_idx, st.succ, m.a,
                                      take), st.succ))
 
-            # app one-way payload (KBRTestApp::deliver).  Reuse the
-            # findNode result computed for this slot above: no handler
-            # between there and here fires for an APP_ONEWAY kind, so the
-            # state it read is unchanged.
-            en = v & (m.kind == wire.APP_ONEWAY)
-            sib_here = sib
-            good = en & sib_here
-            deliv_mask.append(good & (m.c != 0))
-            deliv_hops.append(m.hops + 1)
-            deliv_lat.append((now - m.stamp).astype(jnp.float32) / NS)
-            wrong_cnt += (en & ~sib_here & (m.c != 0)).astype(I32)
+            # app-owned message kinds (Common API deliver path,
+            # BaseApp::handleCommonAPIMessage).  Reuse the findNode
+            # sibling flag computed for this slot above: no handler
+            # between there and here fires for an app kind, so the state
+            # it read is unchanged.
+            st = dataclasses.replace(st, app=self.app.on_msg(
+                st.app, m, ctx, ob, ev, sib))
 
             # ping (predecessor liveness + generic)
             ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
                     wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
-            en = v & (m.kind == wire.PING_RES) & (m.src == st.pred)
+            en = v & (m.kind == wire.PING_RES) & (m.src == st.cp_dst)
             st = dataclasses.replace(
-                st, cp_to=jnp.where(en, T_INF, st.cp_to))
+                st, cp_to=jnp.where(en, T_INF, st.cp_to),
+                cp_dst=jnp.where(en, NO_NODE, st.cp_dst))
 
         # ------------------------------------------------------- timers ----
         t_end = ctx.t_end
@@ -529,51 +554,64 @@ class ChordLogic:
         st = dataclasses.replace(
             st,
             cp_to=jnp.where(fire_c, now_c + rpc_to_ns, st.cp_to),
+            cp_dst=jnp.where(fire_c, st.pred, st.cp_dst),
             t_cp=jnp.where(en_c, now_c + jnp.int64(
                 int(p.check_pred_delay * NS)), st.t_cp))
 
         # app timer → start an app lookup (KBRTestApp::handleTimerEvent →
         # callRoute → iterative lookup, SURVEY §3.2)
-        en_a = (st.state == READY) & (st.app.t_test < t_end)
-        now_a = jnp.maximum(st.app.t_test, t0)
-        app, want, dest_key, seq = kbrtest.on_timer(
-            st.app, en_a, ctx, now_a, rngs[3], self.ap)
+        en_a = (st.state == READY) & (
+            self.app.next_event(st.app) < t_end)
+        now_a = jnp.maximum(self.app.next_event(st.app), t0)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev)
         st = dataclasses.replace(st, app=app)
-        nxt_a, sib_a = self._find_node(ctx, st, me_key, node_idx, dest_key)
-        sent_cnt += want.astype(I32)
-        # local delivery (sendToKey with local sibling → direct deliver,
-        # hopCount 0)
-        local = want & sib_a
-        deliv_mask.append(local & ctx.measuring)
-        deliv_hops.append(jnp.int32(0))
-        deliv_lat.append(jnp.float32(0))
+        nxt_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key)
+        # local responsibility → immediate completion, hopCount 0
+        # (sendToKey with local sibling → direct deliver)
+        local = req.want & sib_a
+        res_local = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(
+            node_idx)
         slot, have = lk_mod.free_slot(st.lk)
-        start_app = want & ~sib_a & have & (nxt_a != NO_NODE)
-        lkfail_cnt += (want & ~sib_a & ~start_app).astype(I32)
+        start_app = req.want & ~sib_a & have & (nxt_a != NO_NODE)
+        # could not even start (no slot / empty local findNode) → failed
+        # completion right away
+        insta_fail = req.want & ~sib_a & ~start_app
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=local | insta_fail, success=local, tag=req.tag,
+                target=req.key,
+                results=jnp.where(local, res_local, NO_NODE),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
         seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(nxt_a)
         st = dataclasses.replace(st, lk=lk_mod.start(
-            st.lk, start_app, slot, P_APP, seq, dest_key, seed, now_a, lcfg))
+            st.lk, start_app, slot, P_APP, req.tag, req.key, seed, now_a,
+            lcfg))
 
         # ------------------------------------------------ lookup timeouts --
         new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
-        for li in range(lcfg.slots):
-            st = self._handle_failed(ctx, st, me_key, node_idx,
-                                     failed_nodes[li], t0)
 
         # stabilize / notify RPC timeout → failed successor
         en = (st.stab_op != 0) & (st.stab_to < t_end)
+        stab_failed = jnp.where(en, st.stab_dst, NO_NODE)
         st = dataclasses.replace(
             st, stab_op=jnp.where(en, 0, st.stab_op),
             stab_to=jnp.where(en, T_INF, st.stab_to))
-        st = self._handle_failed(ctx, st, me_key, node_idx,
-                                 jnp.where(en, st.stab_dst, NO_NODE), t0)
 
-        # predecessor ping timeout → drop predecessor
+        # predecessor ping timeout → the PINGED node failed (a predecessor
+        # adopted after the ping was sent is NOT dropped)
         en = st.cp_to < t_end
+        cp_failed = jnp.where(en, st.cp_dst, NO_NODE)
         st = dataclasses.replace(
-            st, pred=jnp.where(en, NO_NODE, st.pred),
-            cp_to=jnp.where(en, T_INF, st.cp_to))
+            st, cp_to=jnp.where(en, T_INF, st.cp_to),
+            cp_dst=jnp.where(en, NO_NODE, st.cp_dst))
+
+        # one batched repair pass for every failure source this tick
+        st = self._handle_failed(
+            ctx, st, me_key, node_idx,
+            jnp.concatenate([failed_nodes, stab_failed[None],
+                             cp_failed[None]]), t0)
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
@@ -587,9 +625,6 @@ class ChordLogic:
             pur = comp["purpose"][li]
             lksucc_cnt += (en & suc).astype(I32)
             anyfail_cnt += (en & ~suc).astype(I32)
-            # the KBR KPI only counts the app's own routes failing
-            # (reference KBRTestApp records only its own lookups)
-            lkfail_cnt += (en & ~suc & (pur == P_APP)).astype(I32)
 
             # join: contact our successor directly
             ob.send(en & suc & (pur == P_JOIN), t0, res,
@@ -607,17 +642,14 @@ class ChordLogic:
                     enf, st.finger_dirty.at[fi].set(False),
                     st.finger_dirty))
 
-            # app route: final hop to the sibling
+            # app lookup → app completion hook
             ena = en & (pur == P_APP)
-            ob.send(ena & suc & (res != node_idx), t0, res, wire.APP_ONEWAY,
-                    key=comp["target"][li], hops=comp["hops"][li],
-                    c=ctx.measuring.astype(I32), stamp=comp["t0"][li],
-                    size_b=self.ap.test_msg_bytes)
-            # lookup ended on ourselves → local delivery
-            self_del = ena & suc & (res == node_idx)
-            deliv_mask.append(self_del & ctx.measuring)
-            deliv_hops.append(comp["hops"][li])
-            deliv_lat.append((t0 - comp["t0"][li]).astype(jnp.float32) / NS)
+            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                st.app, app_base.LookupDone(
+                    en=ena, success=ena & suc, tag=comp["aux"][li],
+                    target=comp["target"][li], results=comp["results"][li],
+                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                ctx, ob, ev, t0, node_idx))
 
         # -------------------------------------------- finger repair pump ---
         dirty_any = (st.state == READY) & jnp.any(st.finger_dirty)
@@ -644,20 +676,11 @@ class ChordLogic:
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----
-        dh = jnp.stack([jnp.asarray(x, jnp.float32) for x in deliv_hops])
-        dl = jnp.stack([jnp.asarray(x, jnp.float32) for x in deliv_lat])
-        dm = jnp.stack(deliv_mask)
         events = {
             "c:chord_joins": joins_cnt,
-            "c:kbr_sent": sent_cnt,
-            "c:kbr_delivered": jnp.sum(dm.astype(I32)),
-            "c:kbr_wrong_node": wrong_cnt,
-            "c:kbr_lookup_failed": lkfail_cnt,
             "c:lookup_success": lksucc_cnt,
             "c:lookup_failed": anyfail_cnt,
-            "s:kbr_hopcount": (dh, dm),
-            "s:kbr_latency_s": (dl, dm),
-            "h:kbr_hop_hist": (dh.astype(I32), dm),
             "s:lookup_hops": comp_hops_ev,
         }
+        ev.finish(events, self.app.hist_map)
         return st, ob, events

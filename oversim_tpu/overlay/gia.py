@@ -1,0 +1,429 @@
+"""GIA capacity-aware unstructured overlay + search workload, vectorized.
+
+TPU-native rebuild of the reference GIA (src/overlay/gia/Gia.{h,cc},
+GiaNeighbors, GiaTokenFactory, GiaKeyList, + the GIASearchApp workload,
+src/applications/giasearchapp/; params default.ini gia section:
+minNeighbors/maxNeighbors, maxTopAdaptionInterval, tokenWaitTime,
+maxResponses, keyListSize).  GIA is NOT a KBR overlay (kbr=false): there
+is no key responsibility — searches are capacity-biased random walks.
+
+State per node (structure-of-arrays):
+
+  * ``capacity`` [N]: drawn from a power-law-ish spread over channel
+    bandwidth classes (reference derives capacity from access bandwidth);
+  * neighbor set [N, D] with degree bounds: topology adaptation keeps
+    level-of-satisfaction S = Σ_j cap_j/deg_j / cap_i → 1 by acquiring
+    neighbors while S < 1 (Gia.h:121-176 levelOfSatisfaction); acceptance
+    at the receiver follows the GIA subset rule — accept if there is
+    room, else accept iff the candidate's capacity exceeds the weakest
+    neighbor's (dropping it with a disconnect notice);
+  * token buckets [N, D]: each tokenInterval every node grants one
+    forwarding token to a capacity-biased neighbor
+    (GiaTokenFactory::sendToken); a query may only be forwarded to a
+    neighbor we hold a token from, consuming it;
+  * search (GIASearchApp): each node "shares" its own key; a periodic
+    search draws a random live node's key (GlobalNodeList key-list
+    oracle) and releases a biased random walk with maxResponses=1 and a
+    TTL; any node whose key matches answers the originator directly;
+    success ratio/hop count are recorded at the originator.
+
+Simplifications vs the reference (documented): neighbor candidates are
+drawn via the bootstrap oracle instead of PICK-neighbor random walks;
+per-query visited-node bookkeeping (GiaMessageBookkeeping) is replaced by
+the TTL bound plus don't-send-back; one outstanding search per node.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+F32 = jnp.float32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class GiaParams:
+    """default.ini gia namespace."""
+
+    min_neighbors: int = 3        # minNeighbors
+    max_neighbors: int = 10       # maxNeighbors (D axis bound)
+    adapt_interval: float = 10.0  # maxTopAdaptionInterval
+    token_interval: float = 2.0   # token generation period
+    max_tokens: int = 10          # per-neighbor token cap
+    search_interval: float = 60.0
+    search_ttl: int = 20          # maxHopCount for walks
+    max_responses: int = 1        # maxResponses
+    search_timeout: float = 15.0
+    join_delay: float = 5.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class GiaState:
+    state: jnp.ndarray      # [N] i32
+    capacity: jnp.ndarray   # [N] f32
+    nbr: jnp.ndarray        # [N, D] i32
+    nbr_cap: jnp.ndarray    # [N, D] f32 — neighbor's advertised capacity
+    tokens: jnp.ndarray     # [N, D] i32 — tokens we hold FROM neighbor d
+    t_join: jnp.ndarray     # [N] i64
+    t_adapt: jnp.ndarray    # [N] i64
+    t_token: jnp.ndarray    # [N] i64
+    t_search: jnp.ndarray   # [N] i64
+    # one outstanding search
+    s_active: jnp.ndarray   # [N] bool
+    s_seq: jnp.ndarray      # [N] i32
+    s_t0: jnp.ndarray       # [N] i64
+    s_to: jnp.ndarray       # [N] i64
+
+
+class GiaLogic:
+    """Engine logic interface (no KBR: searches instead of lookups)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: GiaParams = GiaParams()):
+        self.key_spec = spec
+        self.p = params
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        return stats_mod.StatSpec(
+            scalars=("gia_search_hops", "gia_search_latency_s",
+                     "gia_satisfaction"),
+            hists=(),
+            counters=("gia_joins", "gia_searches", "gia_search_success",
+                      "gia_search_failed", "gia_query_drops"))
+
+    def init(self, rng, n: int) -> GiaState:
+        p = self.p
+        d = p.max_neighbors
+        # capacity classes 1/10/100/1000 with decreasing probability
+        # (reference assigns capacity by access-channel class)
+        cls = jax.random.categorical(
+            rng, jnp.log(jnp.asarray([0.5, 0.3, 0.15, 0.05])), shape=(n,))
+        capacity = jnp.asarray([1.0, 10.0, 100.0, 1000.0], F32)[cls]
+        return GiaState(
+            state=jnp.zeros((n,), I32),
+            capacity=capacity,
+            nbr=jnp.full((n, d), NO_NODE, I32),
+            nbr_cap=jnp.zeros((n, d), F32),
+            tokens=jnp.zeros((n, d), I32),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_adapt=jnp.full((n,), T_INF, I64),
+            t_token=jnp.full((n,), T_INF, I64),
+            t_search=jnp.full((n,), T_INF, I64),
+            s_active=jnp.zeros((n,), bool),
+            s_seq=jnp.zeros((n,), I32),
+            s_t0=jnp.zeros((n,), I64),
+            s_to=jnp.full((n,), T_INF, I64),
+        )
+
+    def split(self, st):
+        return st, None
+
+    def merge(self, node_part, glob):
+        return node_part
+
+    def post_step(self, ctx, st, events):
+        return st
+
+    def reset(self, st: GiaState, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        r_init, r_j = jax.random.split(rng)
+        fresh = self.init(r_init, n)
+        # keep capacities stable for surviving nodes
+        fresh = dataclasses.replace(fresh, capacity=jnp.where(
+            clear, fresh.capacity, st.capacity))
+        st = select_tree(clear, fresh, st)
+        jitter = (jax.random.uniform(r_j, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: GiaState):
+        return st.state == READY
+
+    def next_event(self, st: GiaState):
+        ready = st.state == READY
+        t = jnp.where(st.state == JOINING, st.t_join, T_INF)
+        for timer in (st.t_adapt, st.t_token, st.t_search):
+            t = jnp.minimum(t, jnp.where(ready, timer, T_INF))
+        t = jnp.minimum(t, jnp.where(st.s_active, st.s_to, T_INF))
+        return t
+
+    # -- per-node helpers -----------------------------------------------------
+
+    def _deg(self, st):
+        return jnp.sum((st.nbr != NO_NODE).astype(I32))
+
+    def _satisfaction(self, st):
+        """levelOfSatisfaction (Gia.cc): Σ cap_j / deg_j(≈own view) /cap_i.
+
+        The reference divides each neighbor's capacity by ITS degree; the
+        neighbor's degree is not carried on the wire here, so its own
+        advertised capacity serves normalized by our degree — the
+        qualitative adaptation signal (grow while undersatisfied) is
+        preserved."""
+        deg = jnp.maximum(self._deg(st), 1)
+        total = jnp.sum(jnp.where(st.nbr != NO_NODE, st.nbr_cap, 0.0))
+        return total / (st.capacity * deg.astype(F32))
+
+    def _nbr_add(self, st, peer, cap, en):
+        """Insert into a free slot; returns (st, accepted, dropped_slot)."""
+        free = st.nbr == NO_NODE
+        has_free = jnp.any(free)
+        already = jnp.any(st.nbr == peer)
+        col_free = jnp.argmax(free).astype(I32)
+        # subset rule: no room → replace the weakest if the candidate has
+        # strictly higher capacity
+        weakest = jnp.argmin(jnp.where(st.nbr != NO_NODE, st.nbr_cap,
+                                       jnp.inf)).astype(I32)
+        can_replace = ~has_free & (cap > st.nbr_cap[weakest])
+        col = jnp.where(has_free, col_free, weakest)
+        accept = en & ~already & (has_free | can_replace)
+        dropped = jnp.where(accept & ~has_free, st.nbr[weakest], NO_NODE)
+        col = jnp.where(accept, col, st.nbr.shape[0])
+        st = dataclasses.replace(
+            st,
+            nbr=st.nbr.at[col].set(peer, mode="drop"),
+            nbr_cap=st.nbr_cap.at[col].set(cap, mode="drop"),
+            tokens=st.tokens.at[col].set(0, mode="drop"))
+        return st, accept, dropped
+
+    def _nbr_drop(self, st, peer):
+        hit = st.nbr == peer
+        return dataclasses.replace(
+            st,
+            nbr=jnp.where(hit, NO_NODE, st.nbr),
+            nbr_cap=jnp.where(hit, 0.0, st.nbr_cap),
+            tokens=jnp.where(hit, 0, st.tokens))
+
+    def _forward_target(self, st, rng, exclude):
+        """Pick the highest-capacity neighbor holding a token, excluding
+        ``exclude`` (biased random walk, Gia::forwardSearchMessage)."""
+        ok = (st.nbr != NO_NODE) & (st.tokens > 0) & (st.nbr != exclude)
+        score = jnp.where(ok, st.nbr_cap, -1.0)
+        # capacity-weighted random choice among token holders
+        g = jax.random.gumbel(rng, score.shape)
+        pick = jnp.argmax(jnp.where(ok, jnp.log(score + 1e-3) + g, -jnp.inf))
+        has = jnp.any(ok)
+        return jnp.where(has, st.nbr[pick], NO_NODE), pick.astype(I32), has
+
+    # -- the per-node step ----------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, spec = self.p, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rngs = jax.random.split(rng, 8)
+        t0 = ctx.t_start
+        t_end = ctx.t_end
+
+        joins_cnt = jnp.int32(0)
+        searches = jnp.int32(0)
+        succ_cnt = jnp.int32(0)
+        fail_cnt = jnp.int32(0)
+        drop_cnt = jnp.int32(0)
+        hops_vals, hops_mask = [], []
+        lat_vals, lat_mask = [], []
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # neighbor connect request (GiaNeighborMessage)
+            en = v & (m.kind == wire.GIA_NEIGHBOR_CALL) & (
+                st.state == READY)
+            cap = m.a.astype(F32) / 16.0
+            st2, accept, dropped = self._nbr_add(st, m.src, cap, en)
+            st = st2
+            ob.send(en & accept & (dropped != NO_NODE), now, dropped,
+                    wire.GIA_DISCONNECT, size_b=wire.BASE_CALL_B)
+            ob.send(en, now, m.src, wire.GIA_NEIGHBOR_RES,
+                    a=(st.capacity * 16.0).astype(I32),
+                    c=accept.astype(I32), size_b=wire.BASE_CALL_B + 8)
+
+            # neighbor connect response
+            en = v & (m.kind == wire.GIA_NEIGHBOR_RES) & (m.c != 0)
+            cap = m.a.astype(F32) / 16.0
+            st2, _, dropped = self._nbr_add(st, m.src, cap, en)
+            st = st2
+            ob.send(en & (dropped != NO_NODE), now, dropped,
+                    wire.GIA_DISCONNECT, size_b=wire.BASE_CALL_B)
+            # first accepted neighbor while joining → READY
+            got = en & (st.state == JOINING)
+            joins_cnt += got.astype(I32)
+            st = dataclasses.replace(
+                st,
+                state=jnp.where(got, READY, st.state),
+                t_join=jnp.where(got, T_INF, st.t_join),
+                t_adapt=jnp.where(got, now, st.t_adapt),
+                t_token=jnp.where(got, now, st.t_token),
+                t_search=jnp.where(
+                    got, now + (jax.random.uniform(rngs[0])
+                                * p.search_interval * NS).astype(I64),
+                    st.t_search))
+
+            # disconnect notice
+            en = v & (m.kind == wire.GIA_DISCONNECT)
+            st = select_tree(en, self._nbr_drop(st, m.src), st)
+
+            # token grant (GiaTokenFactory::sendToken)
+            en = v & (m.kind == wire.GIA_TOKEN)
+            col = jnp.argmax(st.nbr == m.src).astype(I32)
+            is_nbr = jnp.any(st.nbr == m.src)
+            col = jnp.where(en & is_nbr, col, st.nbr.shape[0])
+            st = dataclasses.replace(st, tokens=st.tokens.at[col].set(
+                jnp.minimum(st.tokens[jnp.minimum(col, st.nbr.shape[0] - 1)]
+                            + 1, p.max_tokens), mode="drop"))
+
+            # search query walk (Gia::handleSearchMessage): answer if our
+            # key matches, else forward along a token edge
+            en = v & (m.kind == wire.GIA_QUERY) & (st.state == READY)
+            hit = K.eq(m.key, me_key)
+            ob.send(en & hit, now, m.a, wire.GIA_QUERY_RES, key=m.key,
+                    b=m.b, hops=m.hops, stamp=m.stamp,
+                    size_b=wire.BASE_CALL_B + 20)
+            fwd = en & ~hit & (m.hops < p.search_ttl)
+            tgt, col, has = self._forward_target(st, rngs[1 + (r % 4)],
+                                                 m.src)
+            ob.send(fwd & has, now, tgt, wire.GIA_QUERY, key=m.key,
+                    a=m.a, b=m.b, hops=m.hops + 1, stamp=m.stamp,
+                    size_b=wire.BASE_CALL_B + 20 + 8)
+            col = jnp.where(fwd & has, col, st.nbr.shape[0])
+            st = dataclasses.replace(st, tokens=st.tokens.at[col].add(
+                -1, mode="drop"))
+            drop_cnt += (en & ~hit & ~(fwd & has)).astype(I32)
+
+            # search response at the originator
+            en = v & (m.kind == wire.GIA_QUERY_RES) & st.s_active & (
+                m.b == st.s_seq)
+            succ_cnt += en.astype(I32)
+            hops_vals.append((m.hops + 1).astype(F32))
+            hops_mask.append(en & ctx.measuring)
+            lat_vals.append((now - m.stamp).astype(F32) / NS)
+            lat_mask.append(en & ctx.measuring)
+            st = dataclasses.replace(
+                st,
+                s_active=jnp.where(en, False, st.s_active),
+                s_to=jnp.where(en, T_INF, st.s_to))
+
+        # ------------------------------------------------------- timers ----
+        # join: connect to a random ready node (oracle bootstrap; the
+        # reference walks PICK messages — simplification, module doc)
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[5])
+        alone = en_j & (boot == NO_NODE)
+        joins_cnt += alone.astype(I32)
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(alone, READY, st.state),
+            t_join=jnp.where(
+                alone, T_INF,
+                jnp.where(en_j, now_j + jnp.int64(int(p.join_delay * NS)),
+                          st.t_join)),
+            t_adapt=jnp.where(alone, now_j, st.t_adapt),
+            t_token=jnp.where(alone, now_j, st.t_token),
+            t_search=jnp.where(alone, T_INF, st.t_search))
+        ob.send(en_j & (boot != NO_NODE), now_j, boot,
+                wire.GIA_NEIGHBOR_CALL,
+                a=(st.capacity * 16.0).astype(I32),
+                size_b=wire.BASE_CALL_B + 8)
+
+        # topology adaptation (Gia::handleTimerEvent adaptation)
+        en_t = (st.state == READY) & (st.t_adapt < t_end)
+        now_t = jnp.maximum(st.t_adapt, t0)
+        sat = self._satisfaction(st)
+        deg = self._deg(st)
+        want_more = en_t & ((sat < 1.0) | (deg < p.min_neighbors)) & (
+            deg < p.max_neighbors)
+        cand = ctx.sample_ready(rngs[6])
+        ob.send(want_more & (cand != NO_NODE) & (cand != node_idx), now_t,
+                cand, wire.GIA_NEIGHBOR_CALL,
+                a=(st.capacity * 16.0).astype(I32),
+                size_b=wire.BASE_CALL_B + 8)
+        st = dataclasses.replace(st, t_adapt=jnp.where(
+            en_t, now_t + jnp.int64(int(p.adapt_interval * NS)),
+            st.t_adapt))
+
+        # token generation: grant to a capacity-biased neighbor
+        en_k = (st.state == READY) & (st.t_token < t_end)
+        now_k = jnp.maximum(st.t_token, t0)
+        okn = st.nbr != NO_NODE
+        g = jax.random.gumbel(rngs[7], okn.shape)
+        pick = jnp.argmax(jnp.where(okn, jnp.log(st.nbr_cap + 1e-3) + g,
+                                    -jnp.inf))
+        has_n = jnp.any(okn)
+        ob.send(en_k & has_n, now_k, st.nbr[pick], wire.GIA_TOKEN,
+                size_b=wire.BASE_CALL_B)
+        st = dataclasses.replace(st, t_token=jnp.where(
+            en_k, now_k + jnp.int64(int(p.token_interval * NS)),
+            st.t_token))
+
+        # search timeout
+        en_to = st.s_active & (st.s_to < t_end)
+        fail_cnt += en_to.astype(I32)
+        st = dataclasses.replace(
+            st, s_active=jnp.where(en_to, False, st.s_active),
+            s_to=jnp.where(en_to, T_INF, st.s_to))
+
+        # periodic search (GIASearchApp::handleTimerEvent)
+        en_s = (st.state == READY) & (st.t_search < t_end) & ~st.s_active
+        now_s = jnp.maximum(st.t_search, t0)
+        victim = ctx.sample_ready(rngs[2])
+        key = ctx.keys[jnp.maximum(victim, 0)]
+        tgt, col, has = self._forward_target(st, rngs[3], NO_NODE)
+        fire = en_s & (victim != NO_NODE) & (victim != node_idx) & has
+        searches += fire.astype(I32)
+        seq = st.s_seq + 1
+        ob.send(fire, now_s, tgt, wire.GIA_QUERY, key=key, a=node_idx,
+                b=seq, hops=0, stamp=now_s,
+                size_b=wire.BASE_CALL_B + 20 + 8)
+        col = jnp.where(fire, col, st.nbr.shape[0])
+        st = dataclasses.replace(
+            st,
+            tokens=st.tokens.at[col].add(-1, mode="drop"),
+            s_active=jnp.where(fire, True, st.s_active),
+            s_seq=jnp.where(fire, seq, st.s_seq),
+            s_t0=jnp.where(fire, now_s, st.s_t0),
+            s_to=jnp.where(fire, now_s + jnp.int64(
+                int(p.search_timeout * NS)), st.s_to),
+            t_search=jnp.where(
+                (st.state == READY) & (st.t_search < t_end),
+                now_s + jnp.int64(int(p.search_interval * NS)),
+                st.t_search))
+
+        # ------------------------------------------------------ events -----
+        hv = jnp.stack(hops_vals) if hops_vals else jnp.zeros((1,), F32)
+        hm = jnp.stack(hops_mask) if hops_mask else jnp.zeros((1,), bool)
+        lv = jnp.stack(lat_vals) if lat_vals else jnp.zeros((1,), F32)
+        lm = jnp.stack(lat_mask) if lat_mask else jnp.zeros((1,), bool)
+        events = {
+            "c:gia_joins": joins_cnt,
+            "c:gia_searches": searches,
+            "c:gia_search_success": succ_cnt,
+            "c:gia_search_failed": fail_cnt,
+            "c:gia_query_drops": drop_cnt,
+            "s:gia_search_hops": (hv, hm),
+            "s:gia_search_latency_s": (lv, lm),
+            "s:gia_satisfaction": (
+                jnp.minimum(self._satisfaction(st), 10.0)[None].astype(F32),
+                ((st.state == READY) & ctx.measuring)[None]),
+        }
+        return st, ob, events

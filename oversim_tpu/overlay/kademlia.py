@@ -1,0 +1,578 @@
+"""Kademlia XOR-metric DHT as vectorized per-node logic.
+
+TPU-native rebuild of the reference Kademlia
+(src/overlay/kademlia/Kademlia.{h,cc} + KademliaBucket/KademliaBucketEntry),
+default configuration (simulations/default.ini:185-200: k=8, s=8, b=1,
+maxStaleCount=0, lookupMerge=true, iterative routing, exhaustiveRefresh,
+minSibling/BucketRefreshInterval=1000s).  State is structure-of-arrays:
+
+  * sibling table [N, S]: the s XOR-closest known nodes, kept sorted by
+    distance from own key (KademliaBucket extends BaseKeySortedVector);
+  * k-buckets [N, B, K] with last-seen [N, B, K] and stale counters:
+    bucket index = sharedPrefixLength(own, other) clipped to B-1
+    (reference routingBucketIndex Kademlia.cc:357 = first non-zero digit
+    of the XOR delta — identical partition for b=1; distant-prefix
+    buckets beyond B-1 collapse onto the last row, which only matters
+    for astronomically-close non-sibling keys);
+  * routingAdd (Kademlia.cc:432): every message source is added alive
+    with the full policy (sibling merge incl. displacement of the
+    furthest sibling into a bucket; in-bucket lastSeen refresh; free-slot
+    insert; stale-entry replacement).  Nodes learned from
+    FindNodeResponse payloads are added unverified (isAlive=false,
+    Kademlia.cc:1412): they merge into the sibling table and fill FREE
+    bucket slots only — no displacement (the reference's replacement
+    cache and bucket-ping machinery are TODO);
+  * isSiblingFor (Kademlia.cc:888): table smaller than numSiblings →
+    true; key farther than the furthest sibling while full → false;
+    otherwise membership of self in the numSiblings closest of
+    siblings ∪ self;
+  * findNode (Kademlia.cc:1101): top-R by XOR distance over
+    self ∪ siblings ∪ all buckets (the reference walks best bucket →
+    surrounding buckets → siblings; same result set);
+  * join (Kademlia.cc:1027-1081): iterative lookup of the own key seeded
+    from the bootstrap node, then bucket refresh;
+  * periodic refresh: sibling-table refresh = lookup own key; bucket
+    refresh = lookup a random key with the bucket's exact shared-prefix
+    length, for buckets unused for minBucketRefreshInterval
+    (handleBucketRefreshTimerExpired Kademlia.cc:1591) — repaired one
+    lookup at a time off a dirty mask (bounded concurrency);
+  * handleFailedNode (Kademlia.cc:979): drop from siblings; stale+1 in
+    buckets, evict when staleCount > maxStaleCount;
+  * downlists (lookupFinished Kademlia.cc:1543) are TODO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps.kbrtest import KbrTestApp
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+UMAX = jnp.uint32(0xFFFFFFFF)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+# lookup purposes
+P_JOIN, P_REFRESH, P_APP, P_SIB = 1, 2, 3, 4
+
+
+@dataclasses.dataclass(frozen=True)
+class KademliaParams:
+    """default.ini:185-200 + Kademlia.ned defaults."""
+
+    k: int = 8                    # bucket size
+    s: int = 8                    # sibling table size
+    num_buckets: int = 32         # B — prefix-length clip (see module doc)
+    max_stale: int = 0            # maxStaleCount
+    join_delay: float = 10.0      # joinDelay (BaseOverlay)
+    sibling_refresh: float = 1000.0   # minSiblingTableRefreshInterval
+    bucket_refresh: float = 1000.0    # minBucketRefreshInterval
+    redundant_nodes: int = 8      # lookupRedundantNodes
+    rpc_timeout: float = 1.5
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KademliaState:
+    state: jnp.ndarray      # [N] i32
+    sib: jnp.ndarray        # [N, S] i32 sorted by xor distance from own key
+    buckets: jnp.ndarray    # [N, B, K] i32
+    b_seen: jnp.ndarray     # [N, B, K] i64 — lastSeen (0 = unverified)
+    b_stale: jnp.ndarray    # [N, B, K] i32
+    b_used: jnp.ndarray     # [N, B] i64 — bucket lastUsage
+    refresh_dirty: jnp.ndarray  # [N, B] bool
+    t_join: jnp.ndarray     # [N] i64
+    t_refresh: jnp.ndarray  # [N] i64 — periodic bucket/sibling refresh tick
+    sib_used: jnp.ndarray   # [N] i64 — sibling table lastUsage
+    lk: lk_mod.LookupState
+    app: object                # [N, ...] tier-app state (apps/base.py)
+    app_glob: object           # simulation-global app state (oracle maps)
+
+
+class KademliaLogic:
+    """Engine logic interface (see engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: KademliaParams = KademliaParams(),
+                 lcfg: lk_mod.LookupConfig | None = None,
+                 app=None):
+        self.key_spec = spec
+        self.p = params
+        self.lcfg = lcfg or lk_mod.LookupConfig(merge=True)
+        self.app = app or KbrTestApp()
+        self._pow2 = K.pow2_table(spec)
+
+    # -- engine interface ---------------------------------------------------
+
+    def split(self, st: KademliaState):
+        return dataclasses.replace(st, app_glob=None), st.app_glob
+
+    def merge(self, node_part: KademliaState, glob):
+        return dataclasses.replace(node_part, app_glob=glob)
+
+    def post_step(self, ctx, st: KademliaState, events):
+        app, glob = self.app.post_step(ctx, st.app, st.app_glob, events)
+        return dataclasses.replace(st, app=app, app_glob=glob)
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        app = self.app.stat_spec()
+        return stats_mod.StatSpec(
+            scalars=tuple(app["scalars"]) + ("lookup_hops",),
+            hists=tuple(app["hists"]),
+            counters=tuple(app["counters"]) + (
+                "kad_joins", "lookup_success", "lookup_failed"),
+        )
+
+    def init(self, rng, n: int) -> KademliaState:
+        p = self.p
+        return KademliaState(
+            state=jnp.zeros((n,), I32),
+            sib=jnp.full((n, p.s), NO_NODE, I32),
+            buckets=jnp.full((n, p.num_buckets, p.k), NO_NODE, I32),
+            b_seen=jnp.zeros((n, p.num_buckets, p.k), I64),
+            b_stale=jnp.zeros((n, p.num_buckets, p.k), I32),
+            b_used=jnp.zeros((n, p.num_buckets), I64),
+            refresh_dirty=jnp.zeros((n, p.num_buckets), bool),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_refresh=jnp.full((n,), T_INF, I64),
+            sib_used=jnp.zeros((n,), I64),
+            lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
+                jnp.arange(n)),
+            app=self.app.init(n),
+            app_glob=self.app.glob_init(rng),
+        )
+
+    def reset(self, st: KademliaState, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        glob = st.app_glob
+        st = dataclasses.replace(st, app_glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), app_glob=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, app_glob=glob)
+        jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: KademliaState):
+        return st.state == READY
+
+    def next_event(self, st: KademliaState):
+        joining = st.state == JOINING
+        ready = st.state == READY
+        t = jnp.where(joining, st.t_join, T_INF)
+        t = jnp.minimum(t, jnp.where(ready, st.t_refresh, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
+                                     T_INF))
+        t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        return t
+
+    # -- key-space helpers (single node slice) -------------------------------
+
+    def _xor_to(self, ctx, slots, key):
+        """[C] slots → [C, KL] xor distance of slot keys to ``key``
+        (NO_NODE → max distance)."""
+        ck = ctx.keys[jnp.maximum(slots, 0)]
+        d = ck ^ jnp.broadcast_to(key, ck.shape)
+        return jnp.where((slots == NO_NODE)[:, None], UMAX, d)
+
+    def _bucket_index(self, me_key, other_key):
+        """Shared-prefix bucket index, clipped to B-1."""
+        pl = K.shared_prefix_length(me_key, other_key, self.key_spec)
+        return jnp.clip(pl, 0, self.p.num_buckets - 1)
+
+    def _sib_merge(self, ctx, me_key, node_idx, sib, cands, cand_ok):
+        """Merge candidate slots into the sibling table.
+
+        Returns (new_sib [S], displaced i32): the one node pushed out of a
+        previously-full table (NO_NODE if none) — reference routingAdd
+        moves it into its bucket (Kademlia.cc:613 area).
+        """
+        s = self.p.s
+        c = jnp.concatenate([sib, jnp.where(cand_ok, cands, NO_NODE)])
+        # dedupe (keep first occurrence — table entries win over candidates)
+        bad = (c == NO_NODE) | (c == node_idx) | K.dup_mask(c)
+        c = jnp.where(bad, NO_NODE, c)
+        d = self._xor_to(ctx, c, me_key)
+        (c_s,) = K.sort_by_distance(d, (c,))[1]
+        new_sib = c_s[:s]
+        # displaced: previously a sibling, no longer one
+        was = sib != NO_NODE
+        still = jnp.any(sib[:, None] == new_sib[None, :], axis=1)
+        disp_mask = was & ~still
+        disp = jnp.where(jnp.any(disp_mask), sib[jnp.argmax(disp_mask)],
+                         NO_NODE)
+        return new_sib, disp
+
+    def _bucket_add(self, ctx, st, me_key, cand, alive, now):
+        """Full routingAdd bucket policy for ONE candidate slot."""
+        p = self.p
+        alive = jnp.asarray(alive, bool)
+        en = (cand != NO_NODE)
+        ck = ctx.keys[jnp.maximum(cand, 0)]
+        bi = self._bucket_index(me_key, ck)
+        row = st.buckets[bi]           # [K]
+        seen = st.b_seen[bi]
+        stale = st.b_stale[bi]
+
+        present = row == cand
+        is_present = jnp.any(present)
+        free = row == NO_NODE
+        has_free = jnp.any(free)
+        # stale eviction candidate: highest stale count above threshold
+        evict_ok = alive & (stale > p.max_stale) & ~free
+        has_evict = jnp.any(evict_ok)
+
+        col_present = jnp.argmax(present).astype(I32)
+        col_free = jnp.argmax(free).astype(I32)
+        col_evict = jnp.argmax(jnp.where(evict_ok, stale, -1)).astype(I32)
+
+        col = jnp.where(is_present, col_present,
+                        jnp.where(has_free, col_free, col_evict))
+        do = en & (is_present | has_free | (alive & has_evict))
+        # unverified learned nodes never displace (free slots only)
+        do = do & (alive | is_present | has_free)
+
+        col = jnp.where(do, col, p.k)  # OOB drop
+        new_row = row.at[col].set(cand, mode="drop")
+        new_seen = seen.at[col].set(jnp.where(alive, now, jnp.int64(0)),
+                                    mode="drop")
+        # presence refresh only bumps seen/stale when the contact is alive
+        keep_old = is_present & ~alive
+        new_seen = jnp.where(keep_old, seen, new_seen)
+        new_stale = jnp.where(keep_old, stale, stale.at[col].set(0, mode="drop"))
+
+        return dataclasses.replace(
+            st,
+            buckets=st.buckets.at[bi].set(new_row),
+            b_seen=st.b_seen.at[bi].set(new_seen),
+            b_stale=st.b_stale.at[bi].set(new_stale))
+
+    def _routing_add(self, ctx, st, me_key, node_idx, cand, alive, now):
+        """Full routingAdd for one heard-from node (Kademlia.cc:432)."""
+        en = (cand != NO_NODE) & (cand != node_idx)
+        cand = jnp.where(en, cand, NO_NODE)
+        new_sib, disp = self._sib_merge(
+            ctx, me_key, node_idx, st.sib, cand[None], en[None])
+        became_sib = jnp.any(new_sib == cand) & en
+        st = dataclasses.replace(st, sib=jnp.where(en, new_sib, st.sib))
+        # bucket candidate: the displaced ex-sibling, or the node itself if
+        # it did not make the sibling table
+        bucket_cand = jnp.where(became_sib, disp, cand)
+        st = self._bucket_add(ctx, st, me_key, bucket_cand,
+                              alive | became_sib, now)
+        return st
+
+    def _learn_batch(self, ctx, st, me_key, node_idx, cands, ok, now):
+        """Unverified batch learn (FindNodeResponse payload,
+        Kademlia.cc:1412): sibling merge + free-slot bucket inserts."""
+        new_sib, disp = self._sib_merge(ctx, me_key, node_idx, st.sib,
+                                        cands, ok)
+        st = dataclasses.replace(st, sib=new_sib)
+        st = self._bucket_add(ctx, st, me_key, disp, False, now)
+        # free-slot-only bucket insert for each learned node not in siblings
+        in_sib = jnp.any(cands[:, None] == new_sib[None, :], axis=1)
+        todo = ok & ~in_sib & (cands != NO_NODE) & (cands != node_idx)
+        for i in range(cands.shape[0]):
+            st = self._bucket_add(ctx, st, me_key,
+                                  jnp.where(todo[i], cands[i], NO_NODE),
+                                  False, now)
+        return st
+
+    def _find_node(self, ctx, st, me_key, node_idx, key, rmax):
+        """Top-R closest known nodes by XOR distance (Kademlia.cc:1101).
+
+        Returns ([rmax] i32 slots NO_NODE-padded, is_sibling bool)."""
+        p = self.p
+        # mask bucket entries that were since promoted into the sibling
+        # table (routingAdd can adopt a bucket resident without purging
+        # its bucket slot) so the result set never repeats a node
+        flat = st.buckets.reshape(-1)
+        in_sib = jnp.any(flat[:, None] == st.sib[None, :], axis=1)
+        flat = jnp.where(in_sib, NO_NODE, flat)
+        cands = jnp.concatenate([node_idx[None], st.sib, flat])
+        d = self._xor_to(ctx, cands, key)
+        (c_s,) = K.sort_by_distance(d, (cands,))[1]
+        ready = st.state == READY
+        out = jnp.where(ready, c_s[:rmax], NO_NODE)
+        r = p.redundant_nodes
+        if r < rmax:
+            out = out.at[r:].set(NO_NODE)
+
+        # isSiblingFor(self, key, numSiblings=1) (Kademlia.cc:888)
+        n_sib = jnp.sum((st.sib != NO_NODE).astype(I32))
+        full = n_sib >= p.s
+        d_me = (me_key ^ key)[None]
+        d_far = self._xor_to(ctx, st.sib[-1:], key * 0 + me_key)  # xor to me
+        not_ours = full & K.gt(d_me, d_far)[0]
+        d_sib_key = self._xor_to(ctx, st.sib, key)
+        closer_sib = jnp.any(K.lt(d_sib_key, jnp.broadcast_to(d_me, d_sib_key.shape)))
+        is_sib = ready & (n_sib < 1) | (ready & ~not_ours & ~closer_sib)
+        return out, is_sib
+
+    def _handle_failed(self, ctx, st, me_key, node_idx, failed):
+        """handleFailedNode (Kademlia.cc:979): drop sibling / stale+evict."""
+        en = failed != NO_NODE
+        # sibling drop + re-sort
+        hit = st.sib == failed
+        sib_masked = jnp.where(hit, NO_NODE, st.sib)
+        d = self._xor_to(ctx, sib_masked, me_key)
+        (sib_s,) = K.sort_by_distance(d, (sib_masked,))[1]
+        st = dataclasses.replace(
+            st, sib=jnp.where(en, sib_s, st.sib))
+        # bucket stale increment + eviction
+        bhit = en & (st.buckets == failed)
+        stale = st.b_stale + bhit.astype(I32)
+        evict = bhit & (stale > self.p.max_stale)
+        return dataclasses.replace(
+            st,
+            buckets=jnp.where(evict, NO_NODE, st.buckets),
+            b_stale=jnp.where(evict, 0, stale),
+            b_seen=jnp.where(evict, 0, st.b_seen))
+
+    def _become_ready(self, ctx, st, en, now, rng):
+        p = self.p
+        return dataclasses.replace(
+            st,
+            state=jnp.where(en, READY, st.state),
+            t_join=jnp.where(en, T_INF, st.t_join),
+            # immediate bucket refresh pass after join (Kademlia.cc:1043)
+            t_refresh=jnp.where(en, now, st.t_refresh),
+            app=self.app.on_ready(st.app, en, now, rng))
+
+    # -- the per-node step ---------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, lcfg, spec = self.p, self.lcfg, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rngs = jax.random.split(rng, 8)
+        t0 = ctx.t_start
+        t_end = ctx.t_end
+
+        def metric_fn(cand_slots, target):
+            return self._xor_to(ctx, cand_slots, target)
+
+        ev = app_base.AppEvents()
+        joins_cnt = jnp.int32(0)
+        anyfail_cnt = jnp.int32(0)
+        lksucc_cnt = jnp.int32(0)
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # every received message: routingAdd(src, alive)
+            # (Kademlia.cc:1027/1419)
+            st = select_tree(
+                v, self._routing_add(ctx, st, me_key, node_idx, m.src,
+                                     jnp.bool_(True), now), st)
+
+            # FindNodeCall → findNode + sibling flag
+            en = v & (m.kind == wire.FINDNODE_CALL)
+            res, sib = self._find_node(ctx, st, me_key, node_idx, m.key, rmax)
+            ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
+                    a=m.a, b=m.b, c=sib.astype(I32), nodes=res,
+                    size_b=wire.findnode_res_b(p.redundant_nodes))
+
+            # FindNodeResponse → lookup engine + unverified learns
+            en = v & (m.kind == wire.FINDNODE_RES)
+            st = dataclasses.replace(st, lk=lk_mod.on_response(
+                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
+            learned = m.nodes[:lcfg.frontier]
+            st = select_tree(
+                en, self._learn_batch(ctx, st, me_key, node_idx, learned,
+                                      learned != NO_NODE, now), st)
+
+            # app-owned message kinds (Common API deliver path)
+            st = dataclasses.replace(st, app=self.app.on_msg(
+                st.app, m, ctx, ob, ev, sib))
+
+            # ping (generic liveness)
+            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
+                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+
+        # ------------------------------------------------------- timers ----
+        # join (joinOverlay: lookup own key via bootstrap,
+        # Kademlia.cc:1027-1081)
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1])
+        no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
+        alone_start = en_j & (boot == NO_NODE)
+        st = self._become_ready(ctx, st, alone_start, now_j, rngs[2])
+        joins_cnt += alone_start.astype(I32)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_join = en_j & (boot != NO_NODE) & no_join_lk & have
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(boot)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_join, slot, P_JOIN, 0, me_key, seed, now_j, lcfg))
+        st = dataclasses.replace(st, t_join=jnp.where(
+            en_j & ~alone_start,
+            now_j + jnp.int64(int(p.join_delay * NS)), st.t_join))
+
+        # periodic refresh tick: mark stale buckets dirty + sibling refresh
+        en_r = (st.state == READY) & (st.t_refresh < t_end)
+        now_r = jnp.maximum(st.t_refresh, t0)
+        refresh_ns = jnp.int64(int(p.bucket_refresh * NS))
+        # only buckets for prefixes we can actually populate: any bucket
+        # whose index <= index of the furthest sibling (reference refreshes
+        # buckets up to routingBucketIndex(siblingTable->back()),
+        # Kademlia.cc:1591 area)
+        far_sib = st.sib[-1]
+        has_sib = far_sib != NO_NODE
+        max_bi = jnp.where(
+            has_sib,
+            self._bucket_index(me_key, ctx.keys[jnp.maximum(far_sib, 0)]),
+            -1)
+        bi_range = jnp.arange(p.num_buckets, dtype=I32)
+        stale_bucket = st.b_used + refresh_ns < now_r
+        mark = en_r & (bi_range <= max_bi) & stale_bucket
+        st = dataclasses.replace(
+            st,
+            refresh_dirty=st.refresh_dirty | mark,
+            t_refresh=jnp.where(en_r, now_r + refresh_ns, st.t_refresh))
+        # sibling-table refresh: lookup own key when unused for the interval
+        sib_stale = en_r & (st.sib_used + jnp.int64(
+            int(p.sibling_refresh * NS)) < now_r)
+        no_sib_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_SIB))
+        slot, have = lk_mod.free_slot(st.lk)
+        res0, _ = self._find_node(ctx, st, me_key, node_idx, me_key, rmax)
+        start_sib = sib_stale & no_sib_lk & have & (res0[0] != NO_NODE)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_sib, slot, P_SIB, 0, me_key,
+            res0[:lcfg.frontier], now_r, lcfg))
+        st = dataclasses.replace(
+            st, sib_used=jnp.where(start_sib, now_r, st.sib_used))
+
+        # app timer
+        en_a = (st.state == READY) & (
+            self.app.next_event(st.app) < t_end)
+        now_a = jnp.maximum(self.app.next_event(st.app), t0)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev)
+        st = dataclasses.replace(st, app=app)
+        seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
+                                        rmax)
+        local = req.want & sib_a
+        res_local = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(
+            node_idx)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=local | insta_fail, success=local, tag=req.tag,
+                target=req.key,
+                results=jnp.where(local, res_local, NO_NODE),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_app, slot, P_APP, req.tag, req.key,
+            seed_a[:lcfg.frontier], now_a, lcfg))
+
+        # ------------------------------------------------ lookup timeouts --
+        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+        for li in range(lcfg.slots):
+            st = self._handle_failed(ctx, st, me_key, node_idx,
+                                     failed_nodes[li])
+
+        # ------------------------------------------------- completions -----
+        new_lk, comp = lk_mod.take_completions(st.lk, t_end)
+        st = dataclasses.replace(st, lk=new_lk)
+        comp_hops_ev = (comp["hops"].astype(jnp.float32),
+                        comp["taken"] & comp["success"])
+        for li in range(lcfg.slots):
+            en = comp["taken"][li]
+            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
+            res = comp["result"][li]
+            pur = comp["purpose"][li]
+            lksucc_cnt += (en & suc).astype(I32)
+            anyfail_cnt += (en & ~suc).astype(I32)
+
+            # join completion → READY (even on failure if we learned nodes;
+            # reference joins as long as the sibling table is non-empty)
+            enj = en & (pur == P_JOIN)
+            got = enj & (jnp.any(st.sib != NO_NODE) | suc)
+            joins_cnt += got.astype(I32)
+            st = self._become_ready(ctx, st, got, t0, rngs[4])
+            # join failed with nothing learned → retry via t_join
+            st = dataclasses.replace(st, t_join=jnp.where(
+                enj & ~got, t0 + jnp.int64(int(p.join_delay * NS)),
+                st.t_join))
+
+            # bucket refresh completion → clear dirty bit
+            enr = en & (pur == P_REFRESH)
+            bi = jnp.clip(comp["aux"][li], 0, p.num_buckets - 1)
+            st = dataclasses.replace(
+                st,
+                refresh_dirty=jnp.where(
+                    enr, st.refresh_dirty.at[bi].set(False),
+                    st.refresh_dirty),
+                b_used=jnp.where(enr, st.b_used.at[bi].set(t0), st.b_used))
+
+            # app lookup → app completion hook
+            ena = en & (pur == P_APP)
+            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                st.app, app_base.LookupDone(
+                    en=ena, success=ena & suc, tag=comp["aux"][li],
+                    target=comp["target"][li], results=comp["results"][li],
+                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                ctx, ob, ev, t0, node_idx))
+
+        # ------------------------------------------- bucket refresh pump ---
+        dirty_any = (st.state == READY) & jnp.any(st.refresh_dirty)
+        no_ref_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_REFRESH))
+        bi = jnp.argmax(st.refresh_dirty).astype(I32)
+        # random key with sharedPrefixLength(me, target) == bi:
+        # delta = 2^(bits-1-bi) | (rand & (2^(bits-1-bi) - 1)); target=me^delta
+        jbit = jnp.clip(spec.bits - 1 - bi, 0, spec.bits - 1)
+        top = self._pow2[jbit]
+        mask = K.sub(top, K.from_int(1, spec), spec)
+        rnd = K.random_keys(rngs[5], (), spec)
+        delta = top | (rnd & mask)
+        target = me_key ^ delta
+        seed_r, _ = self._find_node(ctx, st, me_key, node_idx, target, rmax)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_ref = dirty_any & no_ref_lk & have & (seed_r[0] != NO_NODE)
+        # no candidates at all → just clear the bit
+        clear_only = dirty_any & no_ref_lk & (seed_r[0] == NO_NODE)
+        st = dataclasses.replace(
+            st,
+            refresh_dirty=jnp.where(clear_only,
+                                    st.refresh_dirty.at[bi].set(False),
+                                    st.refresh_dirty),
+            lk=lk_mod.start(st.lk, start_ref, slot, P_REFRESH, bi, target,
+                            seed_r[:lcfg.frontier], t0, lcfg))
+
+        # ------------------------------------------------------- pump ------
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[6], lcfg,
+                                num_redundant=p.redundant_nodes)
+        st = dataclasses.replace(st, lk=new_lk)
+
+        # ------------------------------------------------------ events -----
+        events = {
+            "c:kad_joins": joins_cnt,
+            "c:lookup_success": lksucc_cnt,
+            "c:lookup_failed": anyfail_cnt,
+            "s:lookup_hops": comp_hops_ev,
+        }
+        ev.finish(events, self.app.hist_map)
+        return st, ob, events

@@ -1,0 +1,583 @@
+"""Pastry / Bamboo prefix-routing DHT as vectorized per-node logic.
+
+TPU-native rebuild of the reference BasePastry/Pastry/Bamboo family
+(src/overlay/pastry/BasePastry.{h,cc}, Pastry.{h,cc}, bamboo/Bamboo.{h,cc};
+defaults simulations/default.ini:226-267: bitsPerDigit=4,
+numberOfLeaves=16 (Bamboo 8)).  State is structure-of-arrays:
+
+  * leaf set as two ring-sorted halves [N, L/2] (clockwise successors +
+    counter-clockwise predecessors — reference PastryLeafSet keeps the
+    bigger/smaller halves);
+  * prefix routing table [N, ROWS, 2^b]: row r column c holds a node
+    sharing r digits with our key whose digit r is c
+    (PastryRoutingTable); rows are capped (ROWS*b prefix bits is far
+    beyond the populated region for any realistic N — deeper keys are
+    the leafset's job);
+  * findNode (BasePastry.cc:1100): leafset if the key is within leafset
+    range (numerically closest leaf wins), else the routing-table entry
+    for [sharedPrefixDigits, next digit], else the numerically-closest
+    known node with at-least-equal prefix (fallback);
+  * isSiblingFor: numSiblings closest of leafset ∪ self by Pastry's
+    plain numeric metric;
+  * join: iterative lookup of the own key, then a state exchange with
+    the responsible node (the reference collects PastryStateMessages
+    from every hop of the routed join, Pastry.cc:1071; here the
+    leafset arrives from the responsible node and the routing table
+    fills from exchanges + observed traffic — Bamboo's push-pull
+    convergence, Bamboo.cc localTuning/leafsetMaintenance);
+  * maintenance (Bamboo-style, used for both variants): periodic
+    leafset push-pull with a random leaf (`leafsetMaintenanceInterval`),
+    periodic random-key lookup filling routing-table rows
+    (`globalTuningInterval`); Pastry's reactive leafset repair
+    (handleFailedNode → state request to the farthest leaf) rides the
+    same exchange message;
+  * proximity neighbor selection (PNS ping-before-adopt,
+    BasePastry.cc:439-570) and the neighborhood set are TODO
+    (NeighborCache integration).
+
+Iterative routing first; the reference's semi-recursive default arrives
+with the engine's recursive routing modes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps.kbrtest import KbrTestApp
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+UMAX = jnp.uint32(0xFFFFFFFF)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+P_JOIN, P_TUNE, P_APP = 1, 2, 3
+
+
+@dataclasses.dataclass(frozen=True)
+class PastryParams:
+    """default.ini:226-267."""
+
+    bits_per_digit: int = 4       # bitsPerDigit
+    num_leaves: int = 16          # numberOfLeaves (Bamboo: 8)
+    rows: int = 16                # routing-table row cap (see module doc)
+    join_delay: float = 10.0
+    leafset_interval: float = 10.0   # Bamboo leafsetMaintenanceInterval
+    tuning_interval: float = 30.0    # Bamboo globalTuningInterval
+    rpc_timeout: float = 1.5
+
+    @property
+    def cols(self) -> int:
+        return 1 << self.bits_per_digit
+
+    @property
+    def half(self) -> int:
+        return self.num_leaves // 2
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PastryState:
+    state: jnp.ndarray      # [N] i32
+    leaf_cw: jnp.ndarray    # [N, L/2] i32 clockwise (successor side)
+    leaf_ccw: jnp.ndarray   # [N, L/2] i32 counter-clockwise
+    rt: jnp.ndarray         # [N, ROWS, COLS] i32
+    t_join: jnp.ndarray     # [N] i64
+    t_ls: jnp.ndarray       # [N] i64 leafset maintenance
+    t_gt: jnp.ndarray       # [N] i64 global tuning
+    lk: lk_mod.LookupState
+    app: object
+    app_glob: object
+
+
+class PastryLogic:
+    """Engine logic interface; Bamboo = PastryLogic(bamboo defaults)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: PastryParams = PastryParams(),
+                 lcfg: lk_mod.LookupConfig | None = None,
+                 app=None):
+        self.key_spec = spec
+        self.p = params
+        self.lcfg = lcfg or lk_mod.LookupConfig()
+        self.app = app or KbrTestApp()
+
+    # -- engine interface ---------------------------------------------------
+
+    def split(self, st: PastryState):
+        return dataclasses.replace(st, app_glob=None), st.app_glob
+
+    def merge(self, node_part: PastryState, glob):
+        return dataclasses.replace(node_part, app_glob=glob)
+
+    def post_step(self, ctx, st: PastryState, events):
+        app, glob = self.app.post_step(ctx, st.app, st.app_glob, events)
+        return dataclasses.replace(st, app=app, app_glob=glob)
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        app = self.app.stat_spec()
+        return stats_mod.StatSpec(
+            scalars=tuple(app["scalars"]) + ("lookup_hops",),
+            hists=tuple(app["hists"]),
+            counters=tuple(app["counters"]) + (
+                "pastry_joins", "lookup_success", "lookup_failed"),
+        )
+
+    def init(self, rng, n: int) -> PastryState:
+        p = self.p
+        return PastryState(
+            state=jnp.zeros((n,), I32),
+            leaf_cw=jnp.full((n, p.half), NO_NODE, I32),
+            leaf_ccw=jnp.full((n, p.half), NO_NODE, I32),
+            rt=jnp.full((n, p.rows, p.cols), NO_NODE, I32),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_ls=jnp.full((n,), T_INF, I64),
+            t_gt=jnp.full((n,), T_INF, I64),
+            lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
+                jnp.arange(n)),
+            app=self.app.init(n),
+            app_glob=self.app.glob_init(rng),
+        )
+
+    def reset(self, st: PastryState, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        glob = st.app_glob
+        st = dataclasses.replace(st, app_glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), app_glob=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, app_glob=glob)
+        jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: PastryState):
+        return st.state == READY
+
+    def next_event(self, st: PastryState):
+        joining = st.state == JOINING
+        ready = st.state == READY
+        t = jnp.where(joining, st.t_join, T_INF)
+        for timer in (st.t_ls, st.t_gt):
+            t = jnp.minimum(t, jnp.where(ready, timer, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
+                                     T_INF))
+        t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        return t
+
+    # -- internals (per-node slice) ------------------------------------------
+
+    def _half_sorted(self, ctx, me_key, node_idx, cands, clockwise: bool):
+        """L/2 ring-closest candidates on one side, sorted by distance."""
+        h = self.p.half
+        bad = (cands == NO_NODE) | (cands == node_idx) | K.dup_mask(cands)
+        ck = ctx.keys[jnp.maximum(cands, 0)]
+        me_b = jnp.broadcast_to(me_key, ck.shape)
+        d = K.sub(ck, me_b, self.key_spec) if clockwise \
+            else K.sub(me_b, ck, self.key_spec)
+        d = jnp.where(bad[:, None], UMAX, d)
+        (c_s, bad_s) = K.sort_by_distance(d, (cands, bad.astype(I32)))[1]
+        return jnp.where(bad_s[:h] != 0, NO_NODE, c_s[:h])
+
+    def _leaf_merge(self, ctx, st, me_key, node_idx, cands, en):
+        """Merge candidate slots into both leafset halves
+        (PastryLeafSet::mergeNode)."""
+        cands = jnp.where(en, cands, NO_NODE)
+        all_cw = jnp.concatenate([st.leaf_cw, cands])
+        all_ccw = jnp.concatenate([st.leaf_ccw, cands])
+        return dataclasses.replace(
+            st,
+            leaf_cw=self._half_sorted(ctx, me_key, node_idx, all_cw, True),
+            leaf_ccw=self._half_sorted(ctx, me_key, node_idx, all_ccw,
+                                       False))
+
+    def _rt_add(self, ctx, st, me_key, node_idx, cands, en):
+        """Insert candidates into empty routing-table slots
+        (PastryRoutingTable::mergeNode; no PNS yet → first one wins)."""
+        p = self.p
+        rt = st.rt
+        for i in range(cands.shape[0]):
+            c = jnp.where(en[i] & (cands[i] != node_idx), cands[i], NO_NODE)
+            ck = ctx.keys[jnp.maximum(c, 0)]
+            row = jnp.minimum(
+                K.shared_prefix_digits(me_key, ck, p.bits_per_digit,
+                                       self.key_spec), p.rows - 1)
+            col = K.digit(ck, row, p.bits_per_digit, self.key_spec)
+            empty = rt[row, col] == NO_NODE
+            do = (c != NO_NODE) & empty
+            r = jnp.where(do, row, p.rows)
+            rt = rt.at[r, col].set(c, mode="drop")
+        return dataclasses.replace(st, rt=rt)
+
+    def _learn(self, ctx, st, me_key, node_idx, cands, en):
+        st = self._leaf_merge(ctx, st, me_key, node_idx, cands, en)
+        return self._rt_add(ctx, st, me_key, node_idx, cands, en)
+
+    def _leafset_nodes(self, st, node_idx):
+        """Own state payload: self + both halves (PastryStateMessage)."""
+        return jnp.concatenate([node_idx[None], st.leaf_cw, st.leaf_ccw])
+
+    def _find_node(self, ctx, st, me_key, node_idx, key, rmax):
+        """BasePastry::findNode (BasePastry.cc:1100).
+
+        All closeness uses the reference's keyDist = bidirectional ring
+        distance (PastryStateObject::keyDist, PastryStateObject.cc:107).
+        Returns ([rmax] result slots, is_sibling bool).
+        """
+        p, spec = self.p, self.key_spec
+
+        def kdist(slots, target):
+            ck = ctx.keys[jnp.maximum(slots, 0)]
+            d = K.bidir_ring_distance(ck, jnp.broadcast_to(target, ck.shape),
+                                      spec)
+            return jnp.where((slots == NO_NODE)[:, None], UMAX, d)
+
+        ready = st.state == READY
+        me_d = K.bidir_ring_distance(me_key, key, spec)
+
+        # isClosestNode (PastryLeafSet.cc:136): neither the immediate
+        # clockwise nor counter-clockwise neighbor is closer than us
+        big, small = st.leaf_cw[0], st.leaf_ccw[0]
+        no_nbrs = (big == NO_NODE) & (small == NO_NODE)
+        big_closer = (big != NO_NODE) & K.lt(kdist(big[None], key)[0], me_d)
+        small_closer = (small != NO_NODE) & K.lt(kdist(small[None], key)[0],
+                                                 me_d)
+        is_sib = ready & (K.eq(key, me_key) | no_nbrs
+                          | (~big_closer & ~small_closer))
+
+        # getDestinationNode (PastryLeafSet.cc:106): key within the
+        # leafset span [farthest-ccw, farthest-cw] → closest leaf
+        def farthest(half):
+            n_valid = jnp.sum((half != NO_NODE).astype(I32))
+            return jnp.where(n_valid > 0, half[jnp.maximum(n_valid - 1, 0)],
+                             NO_NODE)
+
+        cw_far, ccw_far = farthest(st.leaf_cw), farthest(st.leaf_ccw)
+        span_ok = (cw_far != NO_NODE) & (ccw_far != NO_NODE)
+        in_span = span_ok & K.is_between_lr(
+            key, ctx.keys[jnp.maximum(ccw_far, 0)],
+            ctx.keys[jnp.maximum(cw_far, 0)], spec)
+        leafs = self._leafset_nodes(st, node_idx)
+        d_leafs = kdist(leafs, key)
+        (leafs_s,) = K.sort_by_distance(d_leafs, (leafs,))[1]
+        leaf_dest = leafs_s[0]
+
+        # routing table hop (PastryRoutingTable::lookupNextHop)
+        row = jnp.minimum(
+            K.shared_prefix_digits(me_key, key, p.bits_per_digit, spec),
+            p.rows - 1)
+        col = K.digit(key, row, p.bits_per_digit, spec)
+        rt_hop = st.rt[row, col]
+        rt_ok = rt_hop != NO_NODE
+
+        # 'rare case' fallback (BasePastry.cc:1132-1165 findCloserNode):
+        # any known node with >= shared prefix strictly closer by keyDist
+        known = jnp.concatenate([leafs, st.rt.reshape(-1)])
+        kk = ctx.keys[jnp.maximum(known, 0)]
+        key_b = jnp.broadcast_to(key, kk.shape)
+        dk = kdist(known, key)
+        closer = K.lt(dk, jnp.broadcast_to(me_d, dk.shape))
+        pfx = K.shared_prefix_digits(me_key, key, p.bits_per_digit, spec)
+        kpfx = K.shared_prefix_digits(kk, key_b, p.bits_per_digit, spec)
+        ok = (known != NO_NODE) & closer & (kpfx >= pfx)
+        df = jnp.where(ok[:, None], dk, UMAX)
+        (fb_s,) = K.sort_by_distance(df, (known,))[1]
+        fallback = jnp.where(jnp.any(ok), fb_s[0], NO_NODE)
+
+        # result set: sibling case → closest leafs (replica set); else hop
+        nxt = jnp.where(in_span & (leaf_dest != node_idx), leaf_dest,
+                        jnp.where(rt_ok, rt_hop, fallback))
+        res = jnp.full((rmax,), NO_NODE, I32)
+        res_sib = res.at[:leafs_s.shape[0]].set(leafs_s[:rmax])
+        res = jnp.where(is_sib, res_sib, res.at[0].set(nxt))
+        res = jnp.where(ready, res, jnp.full((rmax,), NO_NODE, I32))
+        return res, is_sib
+
+    def _handle_failed(self, ctx, st, me_key, node_idx, failed, ob, now):
+        """BasePastry::handleFailedNode + Pastry leafset repair: drop the
+        failed nodes everywhere; if a leafset half lost a member, request
+        state from the farthest remaining leaf."""
+        any_failed = jnp.any(failed != NO_NODE)
+
+        def hit(x):
+            return (x[..., None] == failed).any(-1) & (x != NO_NODE)
+
+        lost_leaf = jnp.any(hit(st.leaf_cw)) | jnp.any(hit(st.leaf_ccw))
+        leaf_cw = jnp.where(hit(st.leaf_cw), NO_NODE, st.leaf_cw)
+        leaf_ccw = jnp.where(hit(st.leaf_ccw), NO_NODE, st.leaf_ccw)
+        # re-sort each half so survivors from the other half can slide in
+        st2 = self._leaf_merge(
+            ctx, dataclasses.replace(st, leaf_cw=leaf_cw, leaf_ccw=leaf_ccw),
+            me_key, node_idx,
+            jnp.concatenate([leaf_cw, leaf_ccw]),
+            jnp.ones((2 * self.p.half,), bool))
+        st = select_tree(any_failed, st2, st)
+        st = dataclasses.replace(
+            st, rt=jnp.where(hit(st.rt), NO_NODE, st.rt))
+        # repair: ask the farthest remaining leaf for its state
+        repair_tgt = jnp.where(st.leaf_cw[-1] != NO_NODE, st.leaf_cw[-1],
+                               st.leaf_cw[0])
+        fire = any_failed & lost_leaf & (repair_tgt != NO_NODE) & (
+            st.state == READY)
+        ob.send(fire, now, repair_tgt, wire.PASTRY_STATE_CALL,
+                size_b=wire.BASE_CALL_B)
+        return st
+
+    def _become_ready(self, ctx, st, en, now, rng):
+        p = self.p
+        return dataclasses.replace(
+            st,
+            state=jnp.where(en, READY, st.state),
+            t_join=jnp.where(en, T_INF, st.t_join),
+            t_ls=jnp.where(en, now, st.t_ls),
+            t_gt=jnp.where(en, now + jnp.int64(
+                int(p.tuning_interval * NS)), st.t_gt),
+            app=self.app.on_ready(st.app, en, now, rng))
+
+    # -- the per-node step ---------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, lcfg, spec = self.p, self.lcfg, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rngs = jax.random.split(rng, 6)
+        t0 = ctx.t_start
+        t_end = ctx.t_end
+
+        def metric_fn(cand_slots, target):
+            ck = ctx.keys[jnp.maximum(cand_slots, 0)]
+            d = K.bidir_ring_distance(
+                ck, jnp.broadcast_to(target, ck.shape), spec)
+            return jnp.where((cand_slots == NO_NODE)[:, None], UMAX, d)
+
+        def pad_nodes(vec):
+            out = jnp.full((rmax,), NO_NODE, I32)
+            return out.at[:min(vec.shape[0], rmax)].set(vec[:rmax])
+
+        ev = app_base.AppEvents()
+        joins_cnt = jnp.int32(0)
+        anyfail_cnt = jnp.int32(0)
+        lksucc_cnt = jnp.int32(0)
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # learn every READY message source (observed-traffic table
+            # fill, Bamboo's passive learning).  Joining nodes must NOT
+            # enter leafsets: the reference only merges overlay members
+            # (PastryStateMessage senders); adopting a joiner would route
+            # its own-key join lookup straight back at it.
+            src_ready = ctx.ready[jnp.maximum(m.src, 0)]
+            st = select_tree(
+                v & src_ready,
+                self._learn(ctx, st, me_key, node_idx, m.src[None],
+                            jnp.ones((1,), bool)), st)
+
+            # FindNodeCall
+            en = v & (m.kind == wire.FINDNODE_CALL)
+            res, sib = self._find_node(ctx, st, me_key, node_idx, m.key,
+                                       rmax)
+            n_res = jnp.sum((res != NO_NODE).astype(I32))
+            ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
+                    a=m.a, b=m.b, c=sib.astype(I32), nodes=res,
+                    size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
+
+            # FindNodeResponse → lookup engine + learn payload
+            en = v & (m.kind == wire.FINDNODE_RES)
+            st = dataclasses.replace(st, lk=lk_mod.on_response(
+                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
+            learned = m.nodes[:lcfg.frontier]
+            st = select_tree(
+                en, self._learn(ctx, st, me_key, node_idx, learned,
+                                learned != NO_NODE), st)
+
+            # state exchange (leafset push-pull; PastryStateMessage)
+            en = v & (m.kind == wire.PASTRY_STATE_CALL) & (
+                st.state == READY)
+            ob.send(en, now, m.src, wire.PASTRY_STATE_RES,
+                    nodes=pad_nodes(self._leafset_nodes(st, node_idx)),
+                    size_b=wire.BASE_CALL_B
+                    + wire.NODEHANDLE_B * (p.num_leaves + 1))
+            en = v & (m.kind == wire.PASTRY_STATE_RES)
+            st = select_tree(
+                en, self._learn(ctx, st, me_key, node_idx,
+                                m.nodes[:rmax], m.nodes[:rmax] != NO_NODE),
+                st)
+            # joining node: first state response completes the join
+            got_state = en & (st.state == JOINING)
+            joins_cnt += got_state.astype(I32)
+            st = self._become_ready(ctx, st, got_state, now, rngs[0])
+
+            # app-owned kinds (reuse the sibling flag computed for this
+            # slot's FindNode handler — no app-kind handler above mutates
+            # the tables it read)
+            st = dataclasses.replace(st, app=self.app.on_msg(
+                st.app, m, ctx, ob, ev, sib))
+
+            # generic ping
+            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
+                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+
+        # ------------------------------------------------------- timers ----
+        # join: lookup own key, then state request to the responsible node
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1])
+        no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
+        alone_start = en_j & (boot == NO_NODE)
+        st = self._become_ready(ctx, st, alone_start, now_j, rngs[2])
+        joins_cnt += alone_start.astype(I32)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_join = en_j & (boot != NO_NODE) & no_join_lk & have
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(boot)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_join, slot, P_JOIN, 0, me_key, seed, now_j, lcfg))
+        st = dataclasses.replace(st, t_join=jnp.where(
+            en_j & ~alone_start,
+            now_j + jnp.int64(int(p.join_delay * NS)), st.t_join))
+
+        # leafset maintenance: push-pull with a random leaf (Bamboo
+        # leafsetMaintenance)
+        en_l = (st.state == READY) & (st.t_ls < t_end)
+        now_l = jnp.maximum(st.t_ls, t0)
+        leafs = jnp.concatenate([st.leaf_cw, st.leaf_ccw])
+        n_leafs = jnp.sum((leafs != NO_NODE).astype(I32))
+        pick = jax.random.randint(rngs[3], (), 0, jnp.maximum(n_leafs, 1),
+                                  dtype=I32)
+        order = jnp.argsort(jnp.where(leafs != NO_NODE, 0, 1))
+        tgt = leafs[order[jnp.minimum(pick, leafs.shape[0] - 1)]]
+        fire_l = en_l & (tgt != NO_NODE)
+        ob.send(fire_l, now_l, tgt, wire.PASTRY_STATE_CALL,
+                size_b=wire.BASE_CALL_B)
+        st = dataclasses.replace(st, t_ls=jnp.where(
+            en_l, now_l + jnp.int64(int(p.leafset_interval * NS)), st.t_ls))
+
+        # global tuning: random-key lookup fills routing rows (Bamboo
+        # globalTuning)
+        en_g = (st.state == READY) & (st.t_gt < t_end)
+        now_g = jnp.maximum(st.t_gt, t0)
+        no_tune = ~jnp.any(st.lk.active & (st.lk.purpose == P_TUNE))
+        target = K.random_keys(rngs[4], (), spec)
+        seed_g, sib_g = self._find_node(ctx, st, me_key, node_idx, target,
+                                        rmax)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_g = en_g & no_tune & have & ~sib_g & (seed_g[0] != NO_NODE)
+        st = dataclasses.replace(
+            st,
+            lk=lk_mod.start(st.lk, start_g, slot, P_TUNE, 0, target,
+                            seed_g[:lcfg.frontier], now_g, lcfg),
+            t_gt=jnp.where(en_g, now_g + jnp.int64(
+                int(p.tuning_interval * NS)), st.t_gt))
+
+        # app timer
+        en_a = (st.state == READY) & (self.app.next_event(st.app) < t_end)
+        now_a = jnp.maximum(self.app.next_event(st.app), t0)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[5], ev)
+        st = dataclasses.replace(st, app=app)
+        seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
+                                        rmax)
+        local = req.want & sib_a
+        slot, have = lk_mod.free_slot(st.lk)
+        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=local | insta_fail, success=local, tag=req.tag,
+                target=req.key,
+                results=jnp.where(local, seed_a[:lcfg.frontier], NO_NODE),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_app, slot, P_APP, req.tag, req.key,
+            seed_a[:lcfg.frontier], now_a, lcfg))
+
+        # ------------------------------------------------ lookup timeouts --
+        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+        st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes,
+                                 ob, t0)
+
+        # ------------------------------------------------- completions -----
+        new_lk, comp = lk_mod.take_completions(st.lk, t_end)
+        st = dataclasses.replace(st, lk=new_lk)
+        comp_hops_ev = (comp["hops"].astype(jnp.float32),
+                        comp["taken"] & comp["success"])
+        for li in range(lcfg.slots):
+            en = comp["taken"][li]
+            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
+            res = comp["result"][li]
+            pur = comp["purpose"][li]
+            lksucc_cnt += (en & suc).astype(I32)
+            anyfail_cnt += (en & ~suc).astype(I32)
+
+            # join lookup done → request state from the responsible node
+            enj = en & (pur == P_JOIN)
+            ob.send(enj & suc, t0, res, wire.PASTRY_STATE_CALL,
+                    size_b=wire.BASE_CALL_B)
+            # join lookup failed → retry
+            st = dataclasses.replace(st, t_join=jnp.where(
+                enj & ~suc, t0 + jnp.int64(int(p.join_delay * NS)),
+                st.t_join))
+
+            # tuning lookups: results already learned via responses
+
+            # app lookups
+            ena = en & (pur == P_APP)
+            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                st.app, app_base.LookupDone(
+                    en=ena, success=ena & suc, tag=comp["aux"][li],
+                    target=comp["target"][li], results=comp["results"][li],
+                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                ctx, ob, ev, t0, node_idx))
+
+        # ------------------------------------------------------- pump ------
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[0], lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+
+        # ------------------------------------------------------ events -----
+        events = {
+            "c:pastry_joins": joins_cnt,
+            "c:lookup_success": lksucc_cnt,
+            "c:lookup_failed": anyfail_cnt,
+            "s:lookup_hops": comp_hops_ev,
+        }
+        ev.finish(events, self.app.hist_map)
+        return st, ob, events
+
+
+def bamboo_params() -> PastryParams:
+    """Bamboo defaults (default.ini:251-267): smaller leafset, periodic
+    push maintenance (already the maintenance style here)."""
+    return PastryParams(num_leaves=8)
+
+
+class BambooLogic(PastryLogic):
+    """Bamboo (src/overlay/bamboo/Bamboo.{h,cc}): Pastry variant whose
+    maintenance is periodic push-pull instead of reactive repair — which
+    is exactly this implementation's native style (module docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: PastryParams | None = None,
+                 lcfg: lk_mod.LookupConfig | None = None,
+                 app=None):
+        super().__init__(spec, params or bamboo_params(), lcfg, app)
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        return super().stat_spec()

@@ -1,0 +1,74 @@
+"""Device-mesh sharding of the simulation state (multi-chip scale-out).
+
+The reference scales by running ONE process over all N simulated nodes
+(OMNeT++ kernel, single-threaded; SURVEY.md §2.5).  The TPU rebuild's
+scale axis is the node-slot dimension: every [N, ...] state array (and the
+[P, ...] message pool, P = pool_factor*N) is sharded over a 1-D
+`jax.sharding.Mesh` along its leading axis, and the whole tick step runs
+under `jit` with GSPMD partitioning — XLA inserts the collectives:
+
+  * the global key-table gathers (`ctx.keys[slot]`) become all-gathers of
+    the [N, KL] key table (small: 20 B/node) over ICI;
+  * the pool's sort-based inbox grouping (engine/pool.py) becomes a
+    distributed `lax.sort` (XLA's partitioned sort = local sort +
+    all-to-all merge exchange);
+  * per-node vmapped logic stays fully local to each shard (the dominant
+    FLOPs — finger scans, key arithmetic — never cross chips);
+  * scalar stats/counters are replicated and all-reduced.
+
+Multi-host (DCN) fits the same program: initialize jax.distributed and
+build the mesh over all processes' devices — jit/GSPMD handles the rest.
+No NCCL/MPI translation (reference has none anyway): ICI/DCN collectives
+are the communication backend.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices).reshape(-1), (NODE_AXIS,))
+
+
+def state_shardings(state, mesh: Mesh):
+    """NamedSharding pytree for a SimState: leading axis of every array
+    whose first dim divides evenly over the mesh is sharded; scalars and
+    ragged leaves are replicated."""
+    n_dev = mesh.devices.size
+
+    def spec(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] % n_dev == 0 and leaf.shape[0] > 0:
+            return NamedSharding(mesh, P(NODE_AXIS, *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, state)
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a SimState onto the mesh with node-axis sharding."""
+    return jax.device_put(state, state_shardings(state, mesh))
+
+
+def jit_step(sim, mesh: Mesh, donate: bool = True):
+    """jit the one-tick step with sharded in/out state.
+
+    Returns a compiled callable state -> state.  The sharding constraint is
+    placed on the argument/result; everything inside is GSPMD-partitioned.
+    """
+    example = sim.init()
+    shardings = state_shardings(example, mesh)
+    return jax.jit(sim.step, in_shardings=(shardings,),
+                   out_shardings=shardings,
+                   donate_argnums=(0,) if donate else ())

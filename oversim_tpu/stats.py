@@ -75,6 +75,8 @@ def record(stats: dict, events: dict, gate) -> dict:
             out[key] = acc.at[idx].add(add)
         elif key.startswith("c:"):
             out[key] = out[key] + jnp.sum(jnp.asarray(ev, I64)) * gate.astype(I64)
+        elif key.startswith("g:"):
+            pass  # logic-global update request, consumed by post_step
         else:
             raise KeyError(f"unknown stat class: {key}")
     return out

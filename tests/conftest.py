@@ -1,12 +1,16 @@
 """Test harness config: run everything on a virtual 8-device CPU mesh.
 
-Real TPU hardware in this environment is a single chip; multi-chip sharding
-is validated on XLA's host-platform virtual devices (same compiler path).
+Real TPU hardware in this environment is a single chip behind a tunnel;
+multi-chip sharding is validated on XLA's host-platform virtual devices
+(same compiler path).  The ambient environment force-selects the tunnel
+backend via a sitecustomize hook that does jax.config.update("jax_platforms",
+"axon,cpu") — env vars alone can't override that, so we config.update AFTER
+importing jax (last update wins) to keep unit tests local and fast.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,4 +19,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env setup)
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# persistent XLA compile cache: the sim-step graphs are large (minutes of
+# compile) and identical across test sessions
+jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,87 @@
+"""Churn-model unit tests (distributions + slot scheduling) and one
+Chord-under-churn integration run (reference: verify.ini-style scenario)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic
+
+
+def test_nochurn_schedule():
+    p = churn_mod.ChurnParams(model="none", target_num=16, init_interval=0.5)
+    st = churn_mod.init(jax.random.PRNGKey(0), p)
+    t = np.asarray(st.t_create) / 1e9
+    assert (np.diff(t) > 0).all()
+    assert abs(t[-1] - 8.0) < 3.0
+    assert (np.asarray(st.t_kill) == int(churn_mod.T_INF)).all()
+
+
+def test_lifetime_weibull_mean():
+    p = churn_mod.ChurnParams(model="lifetime", target_num=2000,
+                              lifetime_mean=100.0)
+    draws = churn_mod._draw_lifetime(jax.random.PRNGKey(1), p, (20000,))
+    assert abs(float(jnp.mean(draws)) - 100.0) < 5.0
+
+
+def test_pareto_individual_means_stretch():
+    """After the stretch correction the availability-weighted mean session
+    must equal lifetimeMean (ParetoChurn.cc:98-105)."""
+    p = churn_mod.ChurnParams(model="pareto", target_num=500,
+                              lifetime_mean=1000.0)
+    st = churn_mod.init(jax.random.PRNGKey(2), p)
+    l, d = np.asarray(st.l_mean, float), np.asarray(st.d_mean, float)
+    sum_li = (1.0 / (l + d)).sum()
+    mean_life = (l / ((l + d) * sum_li)).sum()
+    np.testing.assert_allclose(mean_life, 1000.0, rtol=1e-3)
+
+
+def test_pareto_equilibrium_population():
+    """Roughly target nodes must be alive at the end of the init phase."""
+    p = churn_mod.ChurnParams(model="pareto", target_num=400,
+                              init_interval=0.01, lifetime_mean=1000.0)
+    st = churn_mod.init(jax.random.PRNGKey(3), p)
+    fin = p.init_finished_time
+    t_c = np.asarray(st.t_create) / 1e9
+    t_k = np.asarray(st.t_kill) / 1e9
+    alive_at_fin = ((t_c <= fin) & (t_k > fin)).sum()
+    assert 0.6 * p.target_num < alive_at_fin < 1.3 * p.target_num
+
+
+def test_random_churn_ticks():
+    p = churn_mod.ChurnParams(model="random", target_num=8,
+                              init_interval=0.1,
+                              churn_change_interval=5.0,
+                              creation_probability=0.0,
+                              removal_probability=1.0)
+    st = churn_mod.init(jax.random.PRNGKey(4), p)
+    alive = jnp.zeros((p.num_slots,), bool).at[:8].set(True)
+    # drive three ticks: each must schedule one kill
+    kills = 0
+    t = st.t_tick
+    for i in range(3):
+        st, created, killed = churn_mod.step(
+            st, p, alive, t, t + jnp.int64(1), jax.random.PRNGKey(10 + i))
+        alive = (alive | created) & ~killed
+        t = st.t_tick
+    # killed nodes scheduled inside the stepped windows
+    assert int(jnp.sum(~alive[:8])) >= 1
+
+
+def test_chord_under_churn_stays_consistent():
+    """Chord + LifetimeChurn: deliveries keep flowing, wrong-node rate is
+    tiny (reference KBRTestApp tolerates churn-window misses)."""
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=12,
+                               init_interval=0.5, lifetime_mean=200.0)
+    ep = sim_mod.EngineParams(window=0.05, transition_time=20.0)
+    s = sim_mod.Simulation(ChordLogic(), cp, engine_params=ep)
+    st = s.init(seed=5)
+    st = s.run_until(st, 400.0, chunk=512)
+    out = s.summary(st)
+    assert out["kbr_sent"] > 30
+    ratio = out["kbr_delivered"] / max(out["kbr_sent"], 1)
+    assert ratio > 0.7
+    assert out["_engine"]["pool_overflow"] == 0

@@ -1,0 +1,76 @@
+"""Statistical parity checks vs the reference's expected behavior.
+
+The reference's golden tests are event-hash fingerprints (verify.ini,
+SURVEY.md §4) — impossible to reproduce without the OMNeT++ RNG streams.
+The rebuild's equivalent is distribution-level: Chord iterative lookups
+must visit ~O(log N) nodes (0.5*log2(N) expected fingers + successor
+walk), delivery must be ~100% without churn, and latencies must sit in
+the SimpleUnderlay delay envelope.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic
+
+
+N = 64
+
+
+@pytest.fixture(scope="module")
+def chord64():
+    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=20.0)))
+    cp = churn_mod.ChurnParams(model="none", target_num=N,
+                               init_interval=0.2)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=150.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=42)
+    st = s.run_until(st, 600.0, chunk=512)
+    return s, st
+
+
+def test_delivery_ratio(chord64):
+    s, st = chord64
+    out = s.summary(st)
+    assert out["kbr_sent"] > 200
+    ratio = out["kbr_delivered"] / out["kbr_sent"]
+    assert ratio > 0.98
+    assert out["kbr_wrong_node"] == 0
+
+
+def test_hopcount_scales_logarithmically(chord64):
+    """Chord iterative lookup: expected ~0.5*log2(N) finger hops (+1
+    delivery hop).  For N=64: ~3-4 mean; fail far outside the band."""
+    s, st = chord64
+    out = s.summary(st)
+    mean = out["kbr_hopcount"]["mean"]
+    expected = 0.5 * math.log2(N) + 1
+    assert 0.4 * expected < mean < 1.9 * expected, mean
+    assert out["kbr_hopcount"]["max"] <= 16
+
+
+def test_latency_envelope(chord64):
+    """Per-hop latency = SimpleUnderlay delay (coord distance 0.001 s/unit
+    in a 150x150 field + tx delays + jitter): mean one-hop must be tens of
+    ms, total lookup latency under a second."""
+    s, st = chord64
+    out = s.summary(st)
+    lat = out["kbr_latency_s"]
+    assert 0.005 < lat["mean"] < 1.5
+    assert lat["max"] < 10.0
+
+
+def test_ring_is_globally_consistent(chord64):
+    _, st = chord64
+    from oversim_tpu.core import keys as K
+    keys_int = [K.to_int(k) for k in np.asarray(st.node_keys)]
+    order = sorted(range(N), key=lambda i: keys_int[i])
+    succ = np.asarray(st.logic.succ)
+    bad = sum(1 for pos, i in enumerate(order)
+              if succ[i, 0] != order[(pos + 1) % N])
+    assert bad == 0, f"{bad}/{N} successor pointers wrong"

@@ -1,0 +1,54 @@
+"""End-to-end Pastry/Bamboo slice: leafset formation + KBR delivery."""
+
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.pastry import BambooLogic, PastryLogic, READY
+
+
+@pytest.fixture(scope="module", params=["pastry", "bamboo"])
+def pastry_run(request):
+    logic = PastryLogic() if request.param == "pastry" else BambooLogic()
+    cp = churn_mod.ChurnParams(model="none", target_num=8, init_interval=1.0)
+    ep = sim_mod.EngineParams(window=0.010, transition_time=30.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=17)
+    st = s.run_until(st, 300.0, chunk=512)
+    return s, st
+
+
+def test_all_ready(pastry_run):
+    _, st = pastry_run
+    assert np.asarray(st.alive).sum() == 8
+    assert (np.asarray(st.logic.state) == READY).all()
+
+
+def test_leafsets_are_ring_neighbors(pastry_run):
+    """8 nodes, leafset >= 8: every node must know all others, and
+    leaf_cw[0] must be the ring successor."""
+    _, st = pastry_run
+    keys_int = [K.to_int(k) for k in np.asarray(st.node_keys)]
+    order = sorted(range(8), key=lambda i: keys_int[i])
+    cw = np.asarray(st.logic.leaf_cw)
+    for pos, i in enumerate(order):
+        assert cw[i, 0] == order[(pos + 1) % 8], f"node {i} cw successor"
+
+
+def test_deliveries(pastry_run):
+    s, st = pastry_run
+    out = s.summary(st)
+    assert out["kbr_sent"] > 20
+    assert out["kbr_delivered"] >= out["kbr_sent"] - 2
+    assert out["kbr_delivered"] <= out["kbr_sent"]
+    assert out["kbr_wrong_node"] == 0
+    assert out["kbr_hopcount"]["max"] <= 3
+
+
+def test_no_engine_losses(pastry_run):
+    s, st = pastry_run
+    eng = s.summary(st)["_engine"]
+    assert eng["pool_overflow"] == 0
+    assert eng["outbox_overflow"] == 0
