@@ -254,8 +254,10 @@ class DhtApp:
             # costs nothing in the delay model, SimpleUDP.cc:322)
             # ns-precise expiry rides the stamp field — replica and truth
             # map must share the exact same deadline
+            # b carries the op nonce; responders echo it so stragglers
+            # from a timed-out op can't ack a newer op's quorum
             ob.send(send, now, tgt, wire.DHT_PUT_CALL, key=done.target,
-                    a=app.op_val,
+                    a=app.op_val, b=app.op_seq,
                     stamp=app.op_t0 + jnp.int64(int(self.p.test_ttl * NS)),
                     size_b=wire.BASE_CALL_B + 20 + 8)
             nrep += send.astype(I32)
@@ -265,7 +267,8 @@ class DhtApp:
         # GET: DHTGetCall to the closest sibling
         is_get = en & suc & (app.op == OP_GET)
         ob.send(is_get, now, done.results[0], wire.DHT_GET_CALL,
-                key=done.target, size_b=wire.BASE_CALL_B + 20)
+                key=done.target, b=app.op_seq,
+                size_b=wire.BASE_CALL_B + 20)
         return app
 
     # -- inbound messages ----------------------------------------------------
@@ -299,11 +302,14 @@ class DhtApp:
         expire = m.stamp
         app = self._store(app, en, m.key, m.a, expire)
         ev.count("dht_stored", en)
-        ob.send(en, now, m.src, wire.DHT_PUT_RES, key=m.key,
+        ob.send(en, now, m.src, wire.DHT_PUT_RES, key=m.key, b=m.b,
                 size_b=wire.BASE_CALL_B)
 
-        # DHTPutResponse → ack counting; full quorum = success
-        en = m.valid & (m.kind == wire.DHT_PUT_RES) & (app.op == OP_PUT)
+        # DHTPutResponse → ack counting; full quorum = success.  The op
+        # nonce echoed in b rejects straggler acks from a timed-out op
+        # (the reference ties CAPI responses to RPC nonces)
+        en = (m.valid & (m.kind == wire.DHT_PUT_RES) & (app.op == OP_PUT)
+              & (m.b == app.op_seq))
         acks = app.op_acks + en.astype(I32)
         complete = en & (acks >= app.op_pending) & (app.op_pending > 0)
         ev.count("dht_put_success", complete)
@@ -327,12 +333,17 @@ class DhtApp:
                & (app.s_val != NO_VAL) & (app.s_expire > now))
         found = jnp.any(hit)
         val = jnp.where(found, app.s_val[jnp.argmax(hit)], NO_VAL)
-        ob.send(en, now, m.src, wire.DHT_GET_RES, key=m.key, a=val,
+        ob.send(en, now, m.src, wire.DHT_GET_RES, key=m.key, a=val, b=m.b,
                 size_b=wire.BASE_CALL_B + 8)
 
         # DHTGetResponse → validate vs the CURRENT truth (the reference
-        # reads GlobalDhtTestMap at response time, DHTTestApp.cc:121-182)
-        en = m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
+        # reads GlobalDhtTestMap at response time, DHTTestApp.cc:121-182).
+        # Nonce + key match guard against stale responses completing a
+        # newer GET with a mismatched value
+        op_key = ctx.glob.keys[jnp.clip(app.op_g, 0,
+                                        ctx.glob.val.shape[0] - 1)]
+        en = (m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
+              & (m.b == app.op_seq) & jnp.all(m.key == op_key))
         expect = ctx.glob.val[jnp.clip(app.op_g, 0,
                                        ctx.glob.val.shape[0] - 1)]
         good = en & (m.a == expect) & (m.a != NO_VAL)

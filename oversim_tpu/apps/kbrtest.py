@@ -105,9 +105,12 @@ class KbrTestApp:
         suc = done.success & (done.results[0] != NO_NODE)
         ev.count("kbr_lookup_failed", en & ~suc)
         res = done.results[0]
-        # final hop: payload to the sibling (sendToKey final direct hop)
+        # final hop: payload to the sibling (sendToKey final direct hop).
+        # hops on the wire = total overlay hops including this one, so
+        # iterative (lookup hops + final hop) and recursive (per-hop
+        # increments) deliveries record identically.
         ob.send(en & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
-                key=done.target, hops=done.hops,
+                key=done.target, hops=done.hops + 1,
                 c=ctx.measuring.astype(I32), stamp=done.t0,
                 size_b=self.p.test_msg_bytes)
         # lookup ended on ourselves → local delivery
@@ -127,7 +130,7 @@ class KbrTestApp:
         good = en & is_sib & (m.c != 0)
         ev.count("kbr_delivered", good)
         ev.count("kbr_wrong_node", en & ~is_sib & (m.c != 0))
-        ev.value("kbr_hopcount", m.hops + 1, good)
+        ev.value("kbr_hopcount", m.hops, good)
         ev.value("kbr_latency_s",
                  (m.t_deliver - m.stamp).astype(jnp.float32) / NS, good)
         return app

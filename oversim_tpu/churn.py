@@ -154,7 +154,7 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         fin = p.init_finished_time
         dmean = p.deadtime_mean if p.deadtime_mean is not None \
             else p.lifetime_mean
-        ra, rb, rc, rd, re, rf = jax.random.split(rng, 6)
+        ra, rb, rc, rd, re, rf, rg = jax.random.split(rng, 7)
         l_i = _shifted_pareto(ra, 3.0, p.lifetime_mean, (n,))
         d_i = _shifted_pareto(rb, 3.0, dmean, (n,))
         avail = l_i / (l_i + d_i)
@@ -169,8 +169,11 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         participating = alive_rank <= tgt
         # (if fewer than tgt come up alive — vanishingly unlikely with 3x
         # slots — the surplus dead slots simply all participate)
-        sum_li = jnp.sum(1.0 / (l_i + d_i))
-        mean_life = jnp.sum(l_i / ((l_i + d_i) * sum_li))
+        # stretch normalization over exactly the participating population
+        # (ParetoChurn.cc normalizes over the drawn slots, not the 3x pool)
+        sum_li = jnp.sum(jnp.where(participating, 1.0 / (l_i + d_i), 0.0))
+        mean_life = jnp.sum(
+            jnp.where(participating, l_i / ((l_i + d_i) * sum_li), 0.0))
         stretch = p.lifetime_mean / mean_life
         l_i = l_i * stretch
         d_i = d_i * stretch
@@ -181,7 +184,7 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         res_d = _shifted_pareto(rf, 2.0, d_i, (n,))
         t_create = jnp.where(is_init_alive, stagger, fin + res_d)
         first_life = jnp.where(is_init_alive, fin - stagger + res_l,
-                               _shifted_pareto(re, 3.0, l_i, (n,)))
+                               _shifted_pareto(rg, 3.0, l_i, (n,)))
         t_kill = jnp.maximum(t_create + first_life - p.graceful_leave_delay,
                              t_create)
         t_create = jnp.where(participating, t_create, T_INF / NS)

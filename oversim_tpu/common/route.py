@@ -1,0 +1,246 @@
+"""Recursive KBR routing: per-hop forwarding state machine.
+
+TPU-native rebuild of the reference's generic recursive routing loop
+(BaseOverlay::sendToKey SEMI_RECURSIVE branch, BaseOverlay.cc:1441-1581 +
+sendRouteMessage :1107; RoutingType enum CommonMessages.msg:130-141).
+Semantics implemented:
+
+  * a routed message hops node-to-node; each hop runs the overlay's local
+    findNode (recNumRedundantNodes=3 candidates, default.ini:386) and
+    forwards to the first candidate that survives loop detection —
+    not in visitedHops, not the last hop, not the source node
+    (BaseOverlay.cc:1500-1521);
+  * no usable candidate → the message is dropped and counted
+    (BaseOverlay.cc:1524-1542 "No useful nextHop found");
+  * hopCount is carried on the wire and bounded (hopCountMax drop,
+    BaseOverlay.cc:1465-1489);
+  * optional per-hop acknowledgement (routeMsgAcks, wrapped NextHopCall
+    in the reference, BaseOverlay.cc:1107-1147): the forwarding node
+    keeps the message in a bounded slot table until the next hop ACKs;
+    on timeout the next hop is reported failed (handleFailedNode) and
+    the message is re-routed to an alternative candidate
+    (internalHandleRpcTimeout :1697-1729), up to ``max_retries`` times.
+
+Wire mapping (engine/pool.py message fields): kind=KBR_ROUTE carries
+destKey in ``key``, the encapsulated message kind in ``d``
+(BaseRouteMessage encapsulates the payload), the payload scalars in
+``a/b/c/stamp/size_b``, the route hop count in ``hops``, the visited-hop
+list in ``nodes``, and the per-hop ACK nonce in ``nonce`` (0 = no ACK
+requested).  At the responsible node the payload is decapsulated by
+re-dispatching the message view with kind := d.
+
+All functions operate on a single node's slice (vmapped by the engine),
+mirroring common/lookup.py's structure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.common import wire
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NO_NODE = jnp.int32(-1)
+T_INF = jnp.int64(2**62)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteConfig:
+    """Static knobs (reference BaseOverlay params)."""
+
+    slots: int = 4              # Q — in-flight ACK-pending msgs per node
+    max_retries: int = 2        # reroutes after a hop timeout
+    hop_max: int = 32           # hopCountMax equivalent (drop bound)
+    ack_timeout_ns: int = 1_500_000_000   # rpcUdpTimeout (NextHopCall)
+    route_acks: bool = True     # routeMsgAcks (default.ini:245 for pastry)
+    overhead_b: int = 28        # BaseRouteMessage header (destKey+visited)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RouteState:
+    """One node's Q pending-ACK route slots ([N, Q, ...] at rest)."""
+
+    active: jnp.ndarray    # [Q] bool
+    gen: jnp.ndarray       # [Q] i32
+    dst: jnp.ndarray       # [Q] i32 — awaiting ACK from
+    t_to: jnp.ndarray      # [Q] i64
+    retries: jnp.ndarray   # [Q] i32
+    key: jnp.ndarray       # [Q, KL] u32 — destKey
+    inner: jnp.ndarray     # [Q] i32 — encapsulated kind
+    a: jnp.ndarray         # [Q] i32
+    b: jnp.ndarray         # [Q] i32
+    c: jnp.ndarray         # [Q] i32
+    hops: jnp.ndarray      # [Q] i32 — hop count already on the wire copy
+    stamp: jnp.ndarray     # [Q] i64
+    size_b: jnp.ndarray    # [Q] i32
+    visited: jnp.ndarray   # [Q, V] i32 — visitedHops of the sent copy
+
+
+def init(cfg: RouteConfig, kl: int, visited_cap: int) -> RouteState:
+    q = cfg.slots
+    return RouteState(
+        active=jnp.zeros((q,), bool),
+        gen=jnp.zeros((q,), I32),
+        dst=jnp.full((q,), NO_NODE, I32),
+        t_to=jnp.full((q,), T_INF, I64),
+        retries=jnp.zeros((q,), I32),
+        key=jnp.zeros((q, kl), U32),
+        inner=jnp.zeros((q,), I32),
+        a=jnp.zeros((q,), I32),
+        b=jnp.zeros((q,), I32),
+        c=jnp.zeros((q,), I32),
+        hops=jnp.zeros((q,), I32),
+        stamp=jnp.zeros((q,), I64),
+        size_b=jnp.zeros((q,), I32),
+        visited=jnp.full((q, visited_cap), NO_NODE, I32),
+    )
+
+
+def pick_next_hop(cands, visited, last_hop, src_node, self_idx, is_sib):
+    """Loop-detection candidate scan (BaseOverlay.cc:1500-1521).
+
+    ``cands`` [C] candidate slots in preference order; returns
+    (next_hop, found bool).  A candidate is rejected if it is the last
+    hop (and not us), already visited, the source node (and we aren't),
+    or ourselves while not sibling.
+    """
+    in_visited = (cands[:, None] == visited[None, :]).any(-1)
+    bad = ((cands == NO_NODE)
+           | ((cands == last_hop) & (cands != self_idx))
+           | in_visited
+           | ((cands == src_node) & (self_idx != src_node))
+           | ((cands == self_idx) & ~is_sib))
+    ok = ~bad
+    found = jnp.any(ok)
+    nxt = cands[jnp.argmax(ok)]
+    return jnp.where(found, nxt, NO_NODE), found
+
+
+def _route_nonce(slot, gen, q: int):
+    """Nonzero ACK nonce encoding (slot, gen)."""
+    return 1 + slot + q * (gen & jnp.int32(0x003FFFFF))
+
+
+def forward(rt: RouteState, ob, en, now, next_hop, *, key, inner, a, b, c,
+            hops, stamp, size_b, visited, cfg: RouteConfig):
+    """Send one route hop; when ACKs are on, also park a copy in a free
+    slot for reroute-on-timeout (sendRouteMessage + NextHopCall wrap).
+
+    ``visited`` is the [V] visitedHops INCLUDING ourselves (the caller
+    appends self before forwarding — recordRoute semantics).
+    Returns rt'.  If no slot is free the message is sent un-ACKed (the
+    reference's RPC table is unbounded; losing the reroute option is the
+    bounded-memory tradeoff, never the message itself).
+    """
+    q = rt.active.shape[0]
+    if not cfg.route_acks:
+        ob.send(en, now, next_hop, wire.KBR_ROUTE, key=key, nonce=0,
+                hops=hops, a=a, b=b, c=c, d=inner, nodes=visited,
+                stamp=stamp, size_b=size_b + cfg.overhead_b)
+        return rt
+
+    free = ~rt.active
+    slot = jnp.argmax(free).astype(I32)
+    have = jnp.any(free)
+    use = en & have
+    gen = rt.gen[jnp.minimum(slot, q - 1)] + 1
+    nonce = jnp.where(use, _route_nonce(slot, gen, q), 0)
+    ob.send(en, now, next_hop, wire.KBR_ROUTE, key=key, nonce=nonce,
+            hops=hops, a=a, b=b, c=c, d=inner, nodes=visited,
+            stamp=stamp, size_b=size_b + cfg.overhead_b)
+    sl = jnp.where(use, slot, q)  # OOB drop
+    return dataclasses.replace(
+        rt,
+        active=rt.active.at[sl].set(True, mode="drop"),
+        gen=rt.gen.at[sl].set(gen, mode="drop"),
+        dst=rt.dst.at[sl].set(next_hop, mode="drop"),
+        t_to=rt.t_to.at[sl].set(now + cfg.ack_timeout_ns, mode="drop"),
+        retries=rt.retries.at[sl].set(0, mode="drop"),
+        key=rt.key.at[sl].set(key, mode="drop"),
+        inner=rt.inner.at[sl].set(jnp.asarray(inner, I32), mode="drop"),
+        a=rt.a.at[sl].set(jnp.asarray(a, I32), mode="drop"),
+        b=rt.b.at[sl].set(jnp.asarray(b, I32), mode="drop"),
+        c=rt.c.at[sl].set(jnp.asarray(c, I32), mode="drop"),
+        hops=rt.hops.at[sl].set(jnp.asarray(hops, I32), mode="drop"),
+        stamp=rt.stamp.at[sl].set(jnp.asarray(stamp, I64), mode="drop"),
+        size_b=rt.size_b.at[sl].set(jnp.asarray(size_b, I32), mode="drop"),
+        visited=rt.visited.at[sl].set(visited[:rt.visited.shape[1]],
+                                      mode="drop"))
+
+
+def on_ack(rt: RouteState, m):
+    """Consume a KBR_ROUTE_ACK (NextHopResponse): free the matched slot."""
+    q = rt.active.shape[0]
+    slot = (m.nonce - 1) % q
+    gen = (m.nonce - 1) // q
+    ok = (m.valid & (m.nonce > 0) & rt.active[slot]
+          & ((rt.gen[slot] & jnp.int32(0x003FFFFF)) == gen)
+          & (rt.dst[slot] == m.src))
+    sl = jnp.where(ok, slot, q)
+    return dataclasses.replace(
+        rt,
+        active=rt.active.at[sl].set(False, mode="drop"),
+        t_to=rt.t_to.at[sl].set(T_INF, mode="drop"))
+
+
+def on_timeouts(rt: RouteState, t_end, cfg: RouteConfig):
+    """Expire pending ACKs due before ``t_end``.
+
+    Returns (rt', failed [Q] i32, retry [Q] bool): ``failed`` lists the
+    unresponsive next hops (→ overlay handleFailedNode), ``retry`` marks
+    slots the caller must re-route (pick a new candidate from its CURRENT
+    tables — the failed hop was just dropped from them — and call
+    ``reforward``) or abandon via ``drop_slots``.
+    """
+    expired = rt.active & (rt.t_to < t_end)
+    failed = jnp.where(expired, rt.dst, NO_NODE)
+    can_retry = expired & (rt.retries < cfg.max_retries)
+    give_up = expired & ~can_retry
+    return dataclasses.replace(
+        rt,
+        active=rt.active & ~give_up,
+        t_to=jnp.where(expired, T_INF, rt.t_to),
+        dst=jnp.where(expired, NO_NODE, rt.dst),
+        retries=rt.retries + expired.astype(I32),
+    ), failed, can_retry
+
+
+def reforward(rt: RouteState, ob, slot: int, en, now, next_hop,
+              cfg: RouteConfig):
+    """Re-send slot ``slot``'s parked message to a new next hop (reroute
+    after hop failure).  ``en`` false or next_hop==NO_NODE → caller uses
+    ``drop_slot``."""
+    q = rt.active.shape[0]
+    en = en & (next_hop != NO_NODE)
+    gen = rt.gen[slot] + 1
+    nonce = jnp.where(en, _route_nonce(jnp.int32(slot), gen, q), 0)
+    ob.send(en, now, next_hop, wire.KBR_ROUTE, key=rt.key[slot],
+            nonce=nonce, hops=rt.hops[slot], a=rt.a[slot], b=rt.b[slot],
+            c=rt.c[slot], d=rt.inner[slot], nodes=rt.visited[slot],
+            stamp=rt.stamp[slot],
+            size_b=rt.size_b[slot] + cfg.overhead_b)
+    sl = jnp.where(en, jnp.int32(slot), q)
+    return dataclasses.replace(
+        rt,
+        gen=rt.gen.at[sl].set(gen, mode="drop"),
+        dst=rt.dst.at[sl].set(next_hop, mode="drop"),
+        t_to=rt.t_to.at[sl].set(now + cfg.ack_timeout_ns, mode="drop"))
+
+
+def drop_slot(rt: RouteState, slot: int, en):
+    q = rt.active.shape[0]
+    sl = jnp.where(en, jnp.int32(slot), q)
+    return dataclasses.replace(
+        rt,
+        active=rt.active.at[sl].set(False, mode="drop"),
+        t_to=rt.t_to.at[sl].set(T_INF, mode="drop"))
+
+
+def next_event(rt: RouteState):
+    return jnp.min(jnp.where(rt.active, rt.t_to, T_INF))

@@ -20,6 +20,10 @@ PING_CALL = 3           # PingCall (liveness probe, BaseRpc::pingNode)
 PING_RES = 4
 FAILEDNODE_CALL = 5     # FailedNodeCall (IterativeLookup.cc:1025)
 FAILEDNODE_RES = 6
+KBR_ROUTE = 7           # BaseRouteMessage: recursive per-hop forwarding
+                        # (destKey, visitedHops, hopCount; encapsulated
+                        # payload kind rides in d — common/route.py)
+KBR_ROUTE_ACK = 8       # NextHopCall/Response per-hop ACK (routeMsgAcks)
 
 # --- Chord protocol kinds (src/overlay/chord/ChordMessage.msg) ---
 CHORD_JOIN_CALL = 10

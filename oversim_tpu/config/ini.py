@@ -143,13 +143,27 @@ class IniFile:
     def _load_file(self, path: Path):
         self._parse(path.read_text(), path.parent)
 
+    @staticmethod
+    def _strip_comment(raw_line: str) -> str:
+        """Drop a '#' comment, but only outside double-quoted strings
+        (quoted values may legitimately contain '#')."""
+        in_quote = False
+        for i, ch in enumerate(raw_line):
+            if ch == '"':
+                in_quote = not in_quote
+            elif ch == "#" and not in_quote:
+                return raw_line[:i]
+        return raw_line
+
     def _parse(self, text: str, base_dir: Path):
         current = "General"
         for raw_line in text.splitlines():
-            line = raw_line.split("#", 1)[0].strip()
+            line = self._strip_comment(raw_line).strip()
             if not line:
                 continue
-            if line.startswith("include"):
+            # whole-word match: keys like 'includeTraffic = x' are plain
+            # assignments, not include directives
+            if re.match(r"^include\s", line):
                 inc = line.split(None, 1)[1].strip()
                 self._load_file(base_dir / inc)
                 continue

@@ -567,10 +567,17 @@ class ChordLogic:
         st = dataclasses.replace(st, app=app)
         nxt_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key)
         # local responsibility → immediate completion, hopCount 0
-        # (sendToKey with local sibling → direct deliver)
+        # (sendToKey with local sibling → direct deliver).  The result set
+        # is the full sibling set — self + successor list — matching the
+        # responder-side FINDNODE_RES payload (Chord::findNode sibling
+        # case, Chord.cc:548-560), so numReplica consumers (DHT puts) get
+        # the whole replica set for locally-owned keys too.
         local = req.want & sib_a
-        res_local = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(
-            node_idx)
+        res_local = jnp.concatenate([node_idx[None], st.succ])[
+            :lcfg.frontier]
+        if res_local.shape[0] < lcfg.frontier:
+            res_local = jnp.concatenate([res_local, jnp.full(
+                (lcfg.frontier - res_local.shape[0],), NO_NODE, I32)])
         slot, have = lk_mod.free_slot(st.lk)
         start_app = req.want & ~sib_a & have & (nxt_a != NO_NODE)
         # could not even start (no slot / empty local findNode) → failed

@@ -27,10 +27,21 @@ State per node (structure-of-arrays):
     TTL; any node whose key matches answers the originator directly;
     success ratio/hop count are recorded at the originator.
 
+Search semantics follow Gia::processSearchMessage (Gia.cc:1147-1161):
+a query is answered when the key is in the node's OWN key list *or any
+neighbor's* key list (GIA one-hop replication — every node indexes its
+neighbors' keys via periodic KeyListMessages, Gia.cc:395-410).  Here each
+node shares exactly its node key, so the neighbor key index is the
+``ctx.keys`` gather over the neighbor slots.  A query that cannot be
+forwarded for lack of a token is NOT dropped: it is re-queued to self
+with a token-wait delay (reference GiaMessageBookkeeping + tokenWaitTime)
+and only dropped after ``token_wait_max`` requeues.
+
 Simplifications vs the reference (documented): neighbor candidates are
 drawn via the bootstrap oracle instead of PICK-neighbor random walks;
-per-query visited-node bookkeeping (GiaMessageBookkeeping) is replaced by
-the TTL bound plus don't-send-back; one outstanding search per node.
+per-query visited-node bookkeeping (GiaMessageBookkeeping reverse paths)
+is replaced by the TTL bound plus don't-send-back; one outstanding search
+per node.
 """
 
 from __future__ import annotations
@@ -69,6 +80,9 @@ class GiaParams:
     max_responses: int = 1        # maxResponses
     search_timeout: float = 15.0
     join_delay: float = 5.0
+    token_wait: float = 1.0       # tokenWaitTime — requeue delay when no
+                                  # token edge is available
+    token_wait_max: int = 5       # requeues before the query is dropped
 
 
 @jax.tree_util.register_dataclass
@@ -170,16 +184,16 @@ class GiaLogic:
         return jnp.sum((st.nbr != NO_NODE).astype(I32))
 
     def _satisfaction(self, st):
-        """levelOfSatisfaction (Gia.cc): Σ cap_j / deg_j(≈own view) /cap_i.
-
-        The reference divides each neighbor's capacity by ITS degree; the
-        neighbor's degree is not carried on the wire here, so its own
-        advertised capacity serves normalized by our degree — the
-        qualitative adaptation signal (grow while undersatisfied) is
-        preserved."""
-        deg = jnp.maximum(self._deg(st), 1)
+        """Gia::calculateLevelOfSatisfaction (Gia.cc:648-666): the mean
+        neighbor capacity over own capacity, clamped — 0.0 below
+        minNeighbors, 1.0 when >1 or at maxNeighbors."""
+        deg = self._deg(st)
         total = jnp.sum(jnp.where(st.nbr != NO_NODE, st.nbr_cap, 0.0))
-        return total / (st.capacity * deg.astype(F32))
+        los = total / (st.capacity * jnp.maximum(deg, 1).astype(F32))
+        los = jnp.where(deg < self.p.min_neighbors, 0.0, los)
+        los = jnp.where((los > 1.0) | (deg >= self.p.max_neighbors), 1.0,
+                        los)
+        return los
 
     def _nbr_add(self, st, peer, cap, en):
         """Insert into a free slot; returns (st, accepted, dropped_slot)."""
@@ -292,23 +306,40 @@ class GiaLogic:
                 jnp.minimum(st.tokens[jnp.minimum(col, st.nbr.shape[0] - 1)]
                             + 1, p.max_tokens), mode="drop"))
 
-            # search query walk (Gia::handleSearchMessage): answer if our
-            # key matches, else forward along a token edge
+            # search query walk (Gia::processSearchMessage, Gia.cc:1147):
+            # answer if the key is ours OR any neighbor's (one-hop
+            # replication over the neighbor key index), else forward along
+            # a token edge.  No token → requeue to self after tokenWaitTime
+            # (GiaMessageBookkeeping), up to token_wait_max times.
+            # Wire fields: a=originator, b=seq, c=prev-hop+1 (requeue
+            # carry), d=token-wait count.
             en = v & (m.kind == wire.GIA_QUERY) & (st.state == READY)
-            hit = K.eq(m.key, me_key)
+            nbr_keys = ctx.keys[jnp.maximum(st.nbr, 0)]
+            hit_nbr = jnp.any((st.nbr != NO_NODE)
+                              & K.eq(jnp.broadcast_to(m.key, nbr_keys.shape),
+                                     nbr_keys))
+            hit = K.eq(m.key, me_key) | hit_nbr
             ob.send(en & hit, now, m.a, wire.GIA_QUERY_RES, key=m.key,
                     b=m.b, hops=m.hops, stamp=m.stamp,
                     size_b=wire.BASE_CALL_B + 20)
+            prev_hop = jnp.where(m.c > 0, m.c - 1, m.src)
             fwd = en & ~hit & (m.hops < p.search_ttl)
             tgt, col, has = self._forward_target(st, rngs[1 + (r % 4)],
-                                                 m.src)
+                                                 prev_hop)
             ob.send(fwd & has, now, tgt, wire.GIA_QUERY, key=m.key,
                     a=m.a, b=m.b, hops=m.hops + 1, stamp=m.stamp,
                     size_b=wire.BASE_CALL_B + 20 + 8)
             col = jnp.where(fwd & has, col, st.nbr.shape[0])
             st = dataclasses.replace(st, tokens=st.tokens.at[col].add(
                 -1, mode="drop"))
-            drop_cnt += (en & ~hit & ~(fwd & has)).astype(I32)
+            # token starvation: park the query on ourselves for a
+            # tokenWaitTime and retry (drop only after token_wait_max)
+            requeue = fwd & ~has & (m.d < p.token_wait_max)
+            ob.send(requeue, now + jnp.int64(int(p.token_wait * NS)),
+                    node_idx, wire.GIA_QUERY, key=m.key, a=m.a, b=m.b,
+                    c=prev_hop + 1, d=m.d + 1, hops=m.hops, stamp=m.stamp,
+                    size_b=wire.BASE_CALL_B + 20 + 8)
+            drop_cnt += (en & ~hit & ~(fwd & has) & ~requeue).astype(I32)
 
             # search response at the originator
             en = v & (m.kind == wire.GIA_QUERY_RES) & st.s_active & (

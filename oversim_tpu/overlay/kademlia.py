@@ -199,9 +199,10 @@ class KademliaLogic:
     def _sib_merge(self, ctx, me_key, node_idx, sib, cands, cand_ok):
         """Merge candidate slots into the sibling table.
 
-        Returns (new_sib [S], displaced i32): the one node pushed out of a
-        previously-full table (NO_NODE if none) — reference routingAdd
-        moves it into its bucket (Kademlia.cc:613 area).
+        Returns (new_sib [S], displaced [S] i32): every node pushed out of
+        a previously-full table (NO_NODE padded) — reference routingAdd
+        moves verified ex-siblings into their buckets (Kademlia.cc:613
+        area); a batch merge can displace several at once.
         """
         s = self.p.s
         c = jnp.concatenate([sib, jnp.where(cand_ok, cands, NO_NODE)])
@@ -214,9 +215,7 @@ class KademliaLogic:
         # displaced: previously a sibling, no longer one
         was = sib != NO_NODE
         still = jnp.any(sib[:, None] == new_sib[None, :], axis=1)
-        disp_mask = was & ~still
-        disp = jnp.where(jnp.any(disp_mask), sib[jnp.argmax(disp_mask)],
-                         NO_NODE)
+        disp = jnp.where(was & ~still, sib, NO_NODE)
         return new_sib, disp
 
     def _bucket_add(self, ctx, st, me_key, cand, alive, now):
@@ -267,8 +266,11 @@ class KademliaLogic:
         """Full routingAdd for one heard-from node (Kademlia.cc:432)."""
         en = (cand != NO_NODE) & (cand != node_idx)
         cand = jnp.where(en, cand, NO_NODE)
-        new_sib, disp = self._sib_merge(
+        new_sib, disp_vec = self._sib_merge(
             ctx, me_key, node_idx, st.sib, cand[None], en[None])
+        # a single added candidate displaces at most one sibling
+        disp = jnp.where(jnp.any(disp_vec != NO_NODE),
+                         disp_vec[jnp.argmax(disp_vec != NO_NODE)], NO_NODE)
         became_sib = jnp.any(new_sib == cand) & en
         st = dataclasses.replace(st, sib=jnp.where(en, new_sib, st.sib))
         # bucket candidate: the displaced ex-sibling, or the node itself if
@@ -281,10 +283,13 @@ class KademliaLogic:
     def _learn_batch(self, ctx, st, me_key, node_idx, cands, ok, now):
         """Unverified batch learn (FindNodeResponse payload,
         Kademlia.cc:1412): sibling merge + free-slot bucket inserts."""
-        new_sib, disp = self._sib_merge(ctx, me_key, node_idx, st.sib,
-                                        cands, ok)
+        new_sib, disp_vec = self._sib_merge(ctx, me_key, node_idx, st.sib,
+                                            cands, ok)
         st = dataclasses.replace(st, sib=new_sib)
-        st = self._bucket_add(ctx, st, me_key, disp, False, now)
+        # every displaced ex-sibling was a verified contact: move it into
+        # its bucket with the full alive policy (reference routingAdd)
+        for i in range(disp_vec.shape[0]):
+            st = self._bucket_add(ctx, st, me_key, disp_vec[i], True, now)
         # free-slot-only bucket insert for each learned node not in siblings
         in_sib = jnp.any(cands[:, None] == new_sib[None, :], axis=1)
         todo = ok & ~in_sib & (cands != NO_NODE) & (cands != node_idx)
@@ -470,9 +475,17 @@ class KademliaLogic:
         st = dataclasses.replace(st, app=app)
         seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
                                         rmax)
+        # local responsibility → full sibling set (top-s of self ∪
+        # siblings by XOR distance to the key), matching the responder-side
+        # FINDNODE_RES payload so numReplica consumers get the replica set
         local = req.want & sib_a
-        res_local = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(
-            node_idx)
+        loc_cands = jnp.concatenate([node_idx[None], st.sib])
+        loc_d = self._xor_to(ctx, loc_cands, req.key)
+        (loc_s,) = K.sort_by_distance(loc_d, (loc_cands,))[1]
+        res_local = loc_s[:lcfg.frontier]
+        if res_local.shape[0] < lcfg.frontier:
+            res_local = jnp.concatenate([res_local, jnp.full(
+                (lcfg.frontier - res_local.shape[0],), NO_NODE, I32)])
         slot, have = lk_mod.free_slot(st.lk)
         start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
         insta_fail = req.want & ~sib_a & ~start_app

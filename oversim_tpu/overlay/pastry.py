@@ -35,8 +35,12 @@ numberOfLeaves=16 (Bamboo 8)).  State is structure-of-arrays:
     BasePastry.cc:439-570) and the neighborhood set are TODO
     (NeighborCache integration).
 
-Iterative routing first; the reference's semi-recursive default arrives
-with the engine's recursive routing modes.
+Routing mode defaults to SEMI_RECURSIVE with per-hop ACKs — the
+reference's Pastry configuration (default.ini:245-246 routeMsgAcks=true,
+routingType="semi-recursive"): application payloads hop node-to-node via
+common/route.py (findNode → loop-detect → forward, NextHop ACK, reroute
+on hop failure), while join/maintenance lookups stay iterative.
+``routing_mode="iterative"`` restores lookup-then-direct-hop routing.
 """
 
 from __future__ import annotations
@@ -50,6 +54,7 @@ from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import route as rt_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -78,6 +83,10 @@ class PastryParams:
     leafset_interval: float = 10.0   # Bamboo leafsetMaintenanceInterval
     tuning_interval: float = 30.0    # Bamboo globalTuningInterval
     rpc_timeout: float = 1.5
+    # reference default.ini:245-246: semi-recursive with per-hop ACKs
+    routing_mode: str = "semi-recursive"   # or "iterative"
+    route_acks: bool = True       # routeMsgAcks
+    rec_redundant: int = 4        # recNumRedundantNodes (default.ini:386: 3)
 
     @property
     def cols(self) -> int:
@@ -99,6 +108,7 @@ class PastryState:
     t_ls: jnp.ndarray       # [N] i64 leafset maintenance
     t_gt: jnp.ndarray       # [N] i64 global tuning
     lk: lk_mod.LookupState
+    rr: rt_mod.RouteState   # [N, Q, ...] pending-ACK recursive routes
     app: object
     app_glob: object
 
@@ -113,6 +123,7 @@ class PastryLogic:
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg or lk_mod.LookupConfig()
+        self.rcfg = rt_mod.RouteConfig(route_acks=params.route_acks)
         self.app = app or KbrTestApp()
 
     # -- engine interface ---------------------------------------------------
@@ -133,7 +144,8 @@ class PastryLogic:
             scalars=tuple(app["scalars"]) + ("lookup_hops",),
             hists=tuple(app["hists"]),
             counters=tuple(app["counters"]) + (
-                "pastry_joins", "lookup_success", "lookup_failed"),
+                "pastry_joins", "lookup_success", "lookup_failed",
+                "route_dropped"),
         )
 
     def init(self, rng, n: int) -> PastryState:
@@ -148,6 +160,8 @@ class PastryLogic:
             t_gt=jnp.full((n,), T_INF, I64),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
+            rr=jax.vmap(lambda _: rt_mod.init(
+                self.rcfg, self.key_spec.lanes, 16))(jnp.arange(n)),
             app=self.app.init(n),
             app_glob=self.app.glob_init(rng),
         )
@@ -177,6 +191,7 @@ class PastryLogic:
         t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
                                      T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
 
     # -- internals (per-node slice) ------------------------------------------
@@ -305,7 +320,16 @@ class PastryLogic:
         res_sib = res.at[:leafs_s.shape[0]].set(leafs_s[:rmax])
         res = jnp.where(is_sib, res_sib, res.at[0].set(nxt))
         res = jnp.where(ready, res, jnp.full((rmax,), NO_NODE, I32))
-        return res, is_sib
+
+        # redundant next-hop candidates for recursive forwarding, in
+        # preference order (recNumRedundantNodes, default.ini:386): the
+        # primary hop (self when responsible), then the keyDist-sorted
+        # closer-known fallbacks for loop avoidance/reroute
+        cands = jnp.concatenate(
+            [jnp.where(is_sib, node_idx, nxt)[None],
+             fb_s[:max(p.rec_redundant - 1, 0)]])
+        cands = jnp.where(ready, cands, NO_NODE)
+        return res, is_sib, cands
 
     def _handle_failed(self, ctx, st, me_key, node_idx, failed, ob, now):
         """BasePastry::handleFailedNode + Pastry leafset repair: drop the
@@ -372,6 +396,7 @@ class PastryLogic:
         joins_cnt = jnp.int32(0)
         anyfail_cnt = jnp.int32(0)
         lksucc_cnt = jnp.int32(0)
+        routedrop_cnt = jnp.int32(0)
 
         # ------------------------------------------------------- inbox -----
         for r in range(msgs.valid.shape[0]):
@@ -390,10 +415,52 @@ class PastryLogic:
                 self._learn(ctx, st, me_key, node_idx, m.src[None],
                             jnp.ones((1,), bool)), st)
 
+            # local findNode on this slot's key — shared by the FindNode
+            # RPC server, the recursive forwarding pre-pass, and the app
+            # delivery sibling check below
+            res, sib, cands = self._find_node(ctx, st, me_key, node_idx,
+                                              m.key, rmax)
+
+            # per-hop ACK bookkeeping (NextHopResponse)
+            st = dataclasses.replace(st, rr=rt_mod.on_ack(
+                st.rr, dataclasses.replace(
+                    m, valid=v & (m.kind == wire.KBR_ROUTE_ACK))))
+
+            # recursive route pre-pass (sendToKey SEMI_RECURSIVE hop,
+            # BaseOverlay.cc:1441-1581): ACK the last hop, then either
+            # decapsulate (responsible) or forward to the first candidate
+            # surviving loop detection.  visitedHops ride m.nodes; the
+            # originator is visited[0].
+            en_rt = v & (m.kind == wire.KBR_ROUTE) & (st.state == READY)
+            ob.send(en_rt & (m.nonce > 0), now, m.src, wire.KBR_ROUTE_ACK,
+                    nonce=m.nonce, size_b=wire.BASE_CALL_B)
+            deliver = en_rt & sib
+            nxt_rt, found_rt = rt_mod.pick_next_hop(
+                cands, m.nodes, m.src, m.nodes[0], node_idx, sib)
+            fwd = en_rt & ~sib & found_rt & (m.hops < self.rcfg.hop_max)
+            vis_n = jnp.sum((m.nodes != NO_NODE).astype(I32))
+            visited2 = m.nodes.at[jnp.minimum(vis_n, rmax - 1)].set(
+                jnp.where(fwd, node_idx, m.nodes[jnp.minimum(
+                    vis_n, rmax - 1)]))
+            st = dataclasses.replace(st, rr=rt_mod.forward(
+                st.rr, ob, fwd, now, nxt_rt, key=m.key, inner=m.d,
+                a=m.a, b=m.b, c=m.c, hops=m.hops + 1, stamp=m.stamp,
+                size_b=m.size_b - self.rcfg.overhead_b, visited=visited2,
+                cfg=self.rcfg))
+            routedrop_cnt += (en_rt & ~sib & ~fwd).astype(I32)
+            # decapsulate at the responsible node: the payload kind takes
+            # over and src becomes the originator, so the handlers below
+            # (incl. FindNodeCall for recursive lookups and app kinds)
+            # consume it as if it arrived directly
+            m = dataclasses.replace(
+                m,
+                kind=jnp.where(deliver, m.d, m.kind),
+                src=jnp.where(deliver, m.nodes[0], m.src),
+                valid=v & (~en_rt | deliver))
+            v = m.valid
+
             # FindNodeCall
             en = v & (m.kind == wire.FINDNODE_CALL)
-            res, sib = self._find_node(ctx, st, me_key, node_idx, m.key,
-                                       rmax)
             n_res = jnp.sum((res != NO_NODE).astype(I32))
             ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
                     a=m.a, b=m.b, c=sib.astype(I32), nodes=res,
@@ -475,8 +542,8 @@ class PastryLogic:
         now_g = jnp.maximum(st.t_gt, t0)
         no_tune = ~jnp.any(st.lk.active & (st.lk.purpose == P_TUNE))
         target = K.random_keys(rngs[4], (), spec)
-        seed_g, sib_g = self._find_node(ctx, st, me_key, node_idx, target,
-                                        rmax)
+        seed_g, sib_g, _ = self._find_node(ctx, st, me_key, node_idx,
+                                           target, rmax)
         slot, have = lk_mod.free_slot(st.lk)
         start_g = en_g & no_tune & have & ~sib_g & (seed_g[0] != NO_NODE)
         st = dataclasses.replace(
@@ -491,28 +558,76 @@ class PastryLogic:
         now_a = jnp.maximum(self.app.next_event(st.app), t0)
         app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[5], ev)
         st = dataclasses.replace(st, app=app)
-        seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
-                                        rmax)
+        seed_a, sib_a, cands_a = self._find_node(ctx, st, me_key, node_idx,
+                                                 req.key, rmax)
         local = req.want & sib_a
-        slot, have = lk_mod.free_slot(st.lk)
-        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
-        insta_fail = req.want & ~sib_a & ~start_app
         st = dataclasses.replace(st, app=self.app.on_lookup_done(
             st.app, app_base.LookupDone(
-                en=local | insta_fail, success=local, tag=req.tag,
+                en=local, success=local, tag=req.tag,
                 target=req.key,
                 results=jnp.where(local, seed_a[:lcfg.frontier], NO_NODE),
                 hops=jnp.int32(0), t0=now_a),
             ctx, ob, ev, now_a, node_idx))
-        st = dataclasses.replace(st, lk=lk_mod.start(
-            st.lk, start_app, slot, P_APP, req.tag, req.key,
-            seed_a[:lcfg.frontier], now_a, lcfg))
+        if self.p.routing_mode == "semi-recursive":
+            # route the test payload itself (sendToKey at the originator:
+            # same hop logic, visited=[self], hops=1 on the first wire
+            # copy).  KBRTestApp's payload fields: c=measuring, b=tag.
+            vis0 = jnp.full((rmax,), NO_NODE, I32).at[0].set(node_idx)
+            nxt0, found0 = rt_mod.pick_next_hop(
+                cands_a, jnp.full((rmax,), NO_NODE, I32), NO_NODE,
+                node_idx, node_idx, sib_a)
+            fire0 = req.want & ~sib_a & found0
+            st = dataclasses.replace(st, rr=rt_mod.forward(
+                st.rr, ob, fire0, now_a, nxt0, key=req.key,
+                inner=jnp.int32(wire.APP_ONEWAY), a=jnp.int32(0),
+                b=req.tag, c=ctx.measuring.astype(I32), hops=jnp.int32(1),
+                stamp=now_a, size_b=jnp.int32(100), visited=vis0,
+                cfg=self.rcfg))
+            routedrop_cnt += (req.want & ~sib_a & ~found0).astype(I32)
+        else:
+            slot, have = lk_mod.free_slot(st.lk)
+            start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+            insta_fail = req.want & ~sib_a & ~start_app
+            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                st.app, app_base.LookupDone(
+                    en=insta_fail, success=jnp.bool_(False), tag=req.tag,
+                    target=req.key,
+                    results=jnp.full((lcfg.frontier,), NO_NODE, I32),
+                    hops=jnp.int32(0), t0=now_a),
+                ctx, ob, ev, now_a, node_idx))
+            st = dataclasses.replace(st, lk=lk_mod.start(
+                st.lk, start_app, slot, P_APP, req.tag, req.key,
+                seed_a[:lcfg.frontier], now_a, lcfg))
 
         # ------------------------------------------------ lookup timeouts --
         new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
-        st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes,
-                                 ob, t0)
+        # route-hop ACK timeouts: unresponsive next hops are failures too
+        new_rr, rt_failed, rt_retry = rt_mod.on_timeouts(st.rr, t_end,
+                                                         self.rcfg)
+        st = dataclasses.replace(st, rr=new_rr)
+        st = self._handle_failed(
+            ctx, st, me_key, node_idx,
+            jnp.concatenate([failed_nodes, rt_failed]), ob, t0)
+
+        # reroute parked messages around the failed hop (the hop was just
+        # dropped from all tables by _handle_failed, so a fresh findNode
+        # yields the alternative; internalHandleRpcTimeout :1697-1729)
+        for qi in range(self.rcfg.slots):
+            en_q = rt_retry[qi]
+            _, sib_q, cands_q = self._find_node(
+                ctx, st, me_key, node_idx, st.rr.key[qi], rmax)
+            nxt_q, found_q = rt_mod.pick_next_hop(
+                cands_q, st.rr.visited[qi], NO_NODE,
+                st.rr.visited[qi, 0], node_idx, sib_q)
+            # became responsible ourselves meanwhile → self-forward
+            # delivers (decap) next tick
+            st = dataclasses.replace(st, rr=rt_mod.reforward(
+                st.rr, ob, qi, en_q & found_q, t0, nxt_q, self.rcfg))
+            give_up = en_q & ~found_q
+            st = dataclasses.replace(
+                st, rr=rt_mod.drop_slot(st.rr, qi, give_up))
+            routedrop_cnt += give_up.astype(I32)
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
@@ -556,6 +671,7 @@ class PastryLogic:
             "c:pastry_joins": joins_cnt,
             "c:lookup_success": lksucc_cnt,
             "c:lookup_failed": anyfail_cnt,
+            "c:route_dropped": routedrop_cnt,
             "s:lookup_hops": comp_hops_ev,
         }
         ev.finish(events, self.app.hist_map)

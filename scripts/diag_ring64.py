@@ -1,0 +1,83 @@
+"""Diagnose Chord N=64 ring non-convergence (VERDICT round-1 item 1).
+
+Reproduces tests/test_parity.py::chord64 exactly (seed 42) and dumps the
+ring structure at 600s, then runs on to 1200s to distinguish "slow
+convergence" from "stuck fixed point".
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np  # noqa: E402
+
+from oversim_tpu import churn as churn_mod  # noqa: E402
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams  # noqa: E402
+from oversim_tpu.core import keys as K  # noqa: E402
+from oversim_tpu.engine import sim as sim_mod  # noqa: E402
+from oversim_tpu.overlay.chord import ChordLogic  # noqa: E402
+
+N = 64
+
+
+def analyze(st, label):
+    keys_int = [K.to_int(k) for k in np.asarray(st.node_keys)]
+    order = sorted(range(N), key=lambda i: keys_int[i])
+    pos = {i: p for p, i in enumerate(order)}
+    succ = np.asarray(st.logic.succ)
+    pred = np.asarray(st.logic.pred)
+    state = np.asarray(st.logic.state)
+    stab_op = np.asarray(st.logic.stab_op)
+    bad = []
+    for p, i in enumerate(order):
+        true_succ = order[(p + 1) % N]
+        if succ[i, 0] != true_succ:
+            bad.append((p, i, true_succ))
+    print(f"=== {label}: {len(bad)}/{N} wrong succ pointers ===")
+    print("states:", np.bincount(state, minlength=3),
+          "stab_op:", np.bincount(stab_op, minlength=3))
+    for p, i, ts in bad[:40]:
+        s0 = succ[i, 0]
+        skip = (pos[s0] - p) % N if s0 >= 0 else -1
+        # who does the true successor think its pred is?
+        tp = pred[ts]
+        tp_pos = pos[tp] if tp >= 0 else -1
+        print(f"pos={p:3d} node={i:3d} succ0={s0:3d}(pos+{skip}) "
+              f"true={ts:3d} true.pred={tp:3d}(pos={tp_pos}) "
+              f"my.pred={pred[i]:3d} succrow={succ[i].tolist()}")
+    # pred correctness too
+    badp = sum(1 for p, i in enumerate(order)
+               if pred[i] != order[(p - 1) % N])
+    print(f"pred wrong: {badp}/{N}")
+    return len(bad)
+
+
+def main():
+    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=20.0)))
+    cp = churn_mod.ChurnParams(model="none", target_num=N, init_interval=0.2)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=150.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=42)
+    st = s.run_until(st, 600.0, chunk=512)
+    analyze(st, "t=600s")
+    st = s.run_until(st, 1200.0, chunk=512)
+    analyze(st, "t=1200s")
+    st = s.run_until(st, 2400.0, chunk=512)
+    analyze(st, "t=2400s")
+
+
+if __name__ == "__main__":
+    main()
