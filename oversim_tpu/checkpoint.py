@@ -1,0 +1,63 @@
+"""Checkpoint / resume: snapshot the full simulation state.
+
+The reference has NO simulation-state snapshotting — only per-peer
+context survival across rejoins (BaseOverlay.cc:823-831 restoreContext;
+SURVEY.md §5 "Checkpoint/resume").  The TPU rebuild's state is one pytree
+of device arrays (engine/sim.py SimState), so a checkpoint is a flat
+array dump and resume is exact: a restored run continues bit-identically
+(same RNG key, same pool contents, same timers).
+
+Format: one ``.npz`` with the pytree leaves in flatten order plus a
+structure fingerprint.  Restoring requires a structurally identical
+state (same Simulation configuration — logic type, N, engine params);
+the fingerprint check turns mismatches into clear errors instead of
+silent corruption.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT = "oversim-tpu-ckpt-v1"
+
+
+def _fingerprint(leaves) -> str:
+    sig = ";".join(f"{tuple(x.shape)}:{x.dtype}" for x in leaves)
+    return hashlib.sha1(sig.encode()).hexdigest()
+
+
+def save(path: str, state) -> None:
+    """Write ``state`` (any pytree of arrays, e.g. SimState) to ``path``."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    np.savez_compressed(
+        path, __format__=np.asarray(FORMAT),
+        __fingerprint__=np.asarray(_fingerprint(leaves)), **arrays)
+
+
+def load(path: str, example):
+    """Restore a checkpoint into the structure of ``example``.
+
+    ``example`` is a state with the same configuration (typically
+    ``sim.init()``); its values are discarded, only the pytree structure
+    and array shapes/dtypes are used.
+    """
+    data = np.load(path, allow_pickle=False)
+    if str(data["__format__"]) != FORMAT:
+        raise ValueError(f"not an oversim-tpu checkpoint: {path}")
+    leaves, treedef = jax.tree.flatten(example)
+    want = _fingerprint(leaves)
+    got = str(data["__fingerprint__"])
+    if want != got:
+        raise ValueError(
+            "checkpoint structure mismatch (different Simulation "
+            f"configuration): checkpoint {got[:12]} vs example {want[:12]}")
+    new = []
+    for i, ex in enumerate(leaves):
+        arr = data[f"leaf{i}"]
+        new.append(jnp.asarray(arr, dtype=ex.dtype))
+    return jax.tree.unflatten(treedef, new)

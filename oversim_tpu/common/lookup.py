@@ -71,6 +71,12 @@ class LookupConfig:
     retries: int = 0        # lookupRetries... cut: fail directly
     rpc_timeout_ns: int = RPC_TIMEOUT_NS
     deadline_ns: int = LOOKUP_TIMEOUT_NS
+    # opaque per-lookup extension words threaded through every FindNode
+    # round trip (reference: message-attached state like Koorde's
+    # KoordeFindNodeExtMessage routeKey/step, Koorde.cc findDeBruijnHop).
+    # A call carries the ext in nodes[:EW]; the responder returns an
+    # updated ext in nodes[rmax-EW:] of the response.
+    ext_words: int = 0
 
 
 @jax.tree_util.register_dataclass
@@ -99,6 +105,7 @@ class LookupState:
                               # FindNodeResponse payload; DHT replica puts
                               # need numReplica siblings, DHT.cc:504)
     t_done: jnp.ndarray       # [L] i64 — completion time (next_event wake)
+    ext: jnp.ndarray          # [L, EW] i32 — opaque per-lookup extension
 
 
 def init(cfg: LookupConfig, kl: int) -> LookupState:
@@ -123,6 +130,7 @@ def init(cfg: LookupConfig, kl: int) -> LookupState:
         result=jnp.full((l,), NO_NODE, I32),
         results=jnp.full((l, f), NO_NODE, I32),
         t_done=jnp.full((l,), T_INF, I64),
+        ext=jnp.zeros((l, cfg.ext_words), I32),
     )
 
 
@@ -137,7 +145,7 @@ def num_free(lk: LookupState):
 
 
 def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
-          now, cfg: LookupConfig) -> LookupState:
+          now, cfg: LookupConfig, ext=None) -> LookupState:
     """Occupy ``slot`` with a new lookup (no RPC fired yet — ``pump`` does).
 
     ``seed_nodes``: [F] i32 candidate slots from the owner's local
@@ -173,6 +181,9 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
         results=lk.results.at[slot].set(
             jnp.full((f,), NO_NODE, I32), mode="drop"),
         t_done=lk.t_done.at[slot].set(T_INF, mode="drop"),
+        ext=lk.ext.at[slot].set(
+            jnp.zeros((cfg.ext_words,), I32) if ext is None else ext,
+            mode="drop"),
     )
 
 
@@ -254,6 +265,11 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         lk,
         frontier=lk.frontier.at[slot_upd].set(new_frontier, mode="drop"),
         fr_flags=lk.fr_flags.at[slot_upd].set(new_flags, mode="drop"))
+    ew = cfg.ext_words
+    if ew:
+        # responder-updated extension rides the response tail
+        lk = dataclasses.replace(lk, ext=lk.ext.at[slot_upd].set(
+            msg.nodes[-ew:], mode="drop"))
     return lk
 
 
@@ -336,7 +352,8 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
             fire[li], now, cand[li], wire.FINDNODE_CALL,
             key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
             c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
-            size_b=wire.findnode_call_b())
+            nodes=lk.ext[li] if cfg.ext_words else None,
+            size_b=wire.findnode_call_b() + 4 * cfg.ext_words)
     return lk, fire
 
 

@@ -226,3 +226,44 @@ class IniFile:
 
     def configs(self):
         return [s for s in self.sections if s != "General"]
+
+    def with_overrides(self, config: str, pairs: dict[str, object]) -> str:
+        """Create a derived config section holding ``pairs`` as highest-
+        priority assignments; returns its name.  Used to pin one variant
+        of a parameter study."""
+        name = config
+        i = 0
+        while name in self.sections:
+            i += 1
+            name = f"{config}#{i}"
+        self.sections[name] = list(pairs.items())
+        self.extends[name] = config
+        return name
+
+    def expand_study_runs(self, config: str = "General"):
+        """Expand ``${...}`` parameter studies into the cartesian product
+        of run variants (OMNeT++ run expansion, thesis.ini:16).
+
+        Yields (label, config_name) pairs; each config_name is a derived
+        section pinning one combination under the study's original
+        pattern key.  With no studies, yields the plain config once.
+        """
+        import itertools
+
+        entries: list[tuple[str, Study]] = []
+        seen = set()
+        for section in self._chain(config):
+            for pattern, value in self.sections[section]:
+                if isinstance(value, Study):
+                    key = value.name or pattern
+                    if key not in seen:
+                        seen.add(key)
+                        entries.append((pattern, value))
+        if not entries:
+            yield "", config
+            return
+        for combo in itertools.product(*(s.values for _, s in entries)):
+            label = ",".join(f"{s.name or p}={v}"
+                             for (p, s), v in zip(entries, combo))
+            pairs = {p: v for (p, _), v in zip(entries, combo)}
+            yield label, self.with_overrides(config, pairs)

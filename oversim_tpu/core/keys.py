@@ -229,6 +229,71 @@ def pow2_table(spec: KeySpec = DEFAULT_SPEC) -> jnp.ndarray:
     return jnp.stack([from_int(1 << i, spec) for i in range(spec.bits)])
 
 
+def shl_const(key, c: int, spec: KeySpec = DEFAULT_SPEC):
+    """Logical left shift by a STATIC bit count (reference OverlayKey
+    operator<<; Koorde digit-shift routing)."""
+    if c == 0:
+        return mask_to_width(key, spec)
+    kl = spec.lanes
+    lane_sh, bit_sh = c // LANE_BITS, c % LANE_BITS
+    out = []
+    for i in range(kl):
+        src = i + lane_sh
+        lo = key[..., src] if src < kl else jnp.zeros_like(key[..., 0])
+        if bit_sh:
+            nxt = key[..., src + 1] if src + 1 < kl else jnp.zeros_like(
+                key[..., 0])
+            lo = (lo << jnp.uint32(bit_sh)) | (
+                nxt >> jnp.uint32(LANE_BITS - bit_sh))
+        out.append(lo)
+    return mask_to_width(jnp.stack(out, axis=-1), spec)
+
+
+def shr_const(key, c: int, spec: KeySpec = DEFAULT_SPEC):
+    """Logical right shift by a STATIC bit count (counts from the
+    significant width: the unused high bits of lane 0 stay zero)."""
+    if c == 0:
+        return mask_to_width(key, spec)
+    kl = spec.lanes
+    key = mask_to_width(key, spec)
+    lane_sh, bit_sh = c // LANE_BITS, c % LANE_BITS
+    out = []
+    for i in range(kl):
+        src = i - lane_sh
+        lo = key[..., src] if src >= 0 else jnp.zeros_like(key[..., 0])
+        if bit_sh:
+            prv = key[..., src - 1] if src - 1 >= 0 else jnp.zeros_like(
+                key[..., 0])
+            lo = (lo >> jnp.uint32(bit_sh)) | (
+                prv << jnp.uint32(LANE_BITS - bit_sh))
+        out.append(lo)
+    return jnp.stack(out, axis=-1)
+
+
+def _barrel(key, n, spec: KeySpec, const_fn):
+    """Dynamic shift by traced ``n`` via a barrel of static shifts."""
+    n = jnp.asarray(n, jnp.int32)
+    out = key
+    p = 0
+    while (1 << p) < spec.bits:
+        amt = 1 << p
+        bit = ((n >> p) & 1) != 0
+        out = jnp.where(bit[..., None], const_fn(out, amt, spec), out)
+        p += 1
+    # shifts >= bits clear everything
+    return jnp.where((n >= spec.bits)[..., None], jnp.zeros_like(out), out)
+
+
+def shl_dyn(key, n, spec: KeySpec = DEFAULT_SPEC):
+    """Left shift by a TRACED amount (Koorde findStartKey)."""
+    return _barrel(key, n, spec, shl_const)
+
+
+def shr_dyn(key, n, spec: KeySpec = DEFAULT_SPEC):
+    """Right shift by a TRACED amount (Koorde findStartKey)."""
+    return _barrel(key, n, spec, shr_const)
+
+
 # ---------------------------------------------------------------------------
 # metrics
 # ---------------------------------------------------------------------------

@@ -237,6 +237,23 @@ class ChordLogic:
         nxt = jnp.where(ready, nxt, NO_NODE)
         return nxt, is_sib
 
+    def _respond_find(self, ctx, st, me_key, node_idx, m, rmax, pad_nodes):
+        """FindNode RPC server payload: ([rmax] result slots, sibling
+        flag).  Overridable hop-choice hook (Koorde)."""
+        nxt, sib = self._find_node(ctx, st, me_key, node_idx, m.key)
+        sib_set = pad_nodes(jnp.concatenate([node_idx[None], st.succ]))
+        return jnp.where(
+            sib, sib_set,
+            jnp.full((rmax,), NO_NODE, I32).at[0].set(nxt)), sib
+
+    def _extra_timers(self, ctx, st, ob, me_key, node_idx, t0, t_end, rng):
+        """Subclass timer hook (Koorde de Bruijn stabilization)."""
+        return st
+
+    def _on_completion(self, ctx, st, ob, li, comp, en, suc, res, t0):
+        """Subclass lookup-purpose dispatch hook (per completion slot)."""
+        return st
+
     def _succ_sorted(self, ctx, me_key, node_idx, cands):
         """Ring-distance-sorted unique successor list from candidate slots
         (ChordSuccessorList semantics: excludes self, sorted by clockwise
@@ -332,7 +349,8 @@ class ChordLogic:
 
         def pad_nodes(vec):
             out = jnp.full((rmax,), NO_NODE, I32)
-            return out.at[:vec.shape[0]].set(vec[:rmax])
+            k = min(vec.shape[0], rmax)
+            return out.at[:k].set(vec[:k])
 
         def metric_fn(cand_slots, target):
             ck = ctx.keys[jnp.maximum(cand_slots, 0)]
@@ -355,12 +373,12 @@ class ChordLogic:
             # sibling set — ourselves followed by our successor list
             # (Chord::findNode returns siblings for isSiblingFor keys,
             # Chord.cc:548-560) — so callers wanting numSiblings replicas
-            # (DHT puts) get the full replica set.
+            # (DHT puts) get the full replica set.  Subclasses (Koorde)
+            # override _respond_find for their own hop choice + lookup
+            # extension handling.
             en = v & (m.kind == wire.FINDNODE_CALL)
-            nxt, sib = self._find_node(ctx, st, me_key, node_idx, m.key)
-            sib_set = pad_nodes(jnp.concatenate([node_idx[None], st.succ]))
-            res_nodes = jnp.where(
-                sib, sib_set, jnp.full((rmax,), NO_NODE, I32).at[0].set(nxt))
+            res_nodes, sib = self._respond_find(ctx, st, me_key, node_idx,
+                                                m, rmax, pad_nodes)
             n_res = jnp.sum((res_nodes != NO_NODE).astype(I32))
             ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
                     a=m.a, b=m.b, c=sib.astype(I32), nodes=res_nodes,
@@ -545,6 +563,10 @@ class ChordLogic:
                             + jnp.int64(int(p.fixfingers_delay * NS)),
                             st.t_fix))
 
+        # subclass periodic protocols (Koorde de Bruijn timer)
+        st = self._extra_timers(ctx, st, ob, me_key, node_idx, t0, t_end,
+                                rngs[5])
+
         # predecessor check (handleCheckPredecessorTimerExpired)
         en_c = (st.state == READY) & (st.t_cp < t_end)
         now_c = jnp.maximum(st.t_cp, t0)
@@ -657,6 +679,10 @@ class ChordLogic:
                     target=comp["target"][li], results=comp["results"][li],
                     hops=comp["hops"][li], t0=comp["t0"][li]),
                 ctx, ob, ev, t0, node_idx))
+
+            # subclass purposes (Koorde de Bruijn resolution)
+            st = self._on_completion(ctx, st, ob, li, comp, en, suc, res,
+                                     t0)
 
         # -------------------------------------------- finger repair pump ---
         dirty_any = (st.state == READY) & jnp.any(st.finger_dirty)

@@ -1,0 +1,376 @@
+"""Koorde de Bruijn DHT — extends Chord with digit-shift routing.
+
+TPU-native rebuild of the reference Koorde
+(src/overlay/koorde/Koorde.{h,cc}, `class Koorde : public Chord`,
+Koorde.h:50; params default.ini:268-277: stabilizeDelay 10s,
+successorListSize 16, deBruijnDelay 30s, deBruijnListSize 16,
+shiftingBits 4).  Koorde reuses the whole Chord machinery — ring
+join/stabilize/notify, successor lists, predecessor pings — and replaces
+finger routing with a de Bruijn graph walk:
+
+  * every node maintains a **de Bruijn pointer**: the node responsible
+    for (own key << shiftingBits, nudged back by half a successor span),
+    plus that node's successors as a backup list
+    (handleDeBruijnTimerExpired Koorde.cc:163-229; resolved here via an
+    iterative lookup — the engine equivalent of the routed DeBruijnCall);
+  * a lookup carries mutable routing state with the MESSAGE — the
+    imaginary de Bruijn ``routeKey`` and the bit ``step``
+    (KoordeFindNodeExtMessage; Koorde.cc findDeBruijnHop) — mapped onto
+    the lookup engine's opaque ext words (common/lookup.py ext_words =
+    key lanes + 1; calls carry it in nodes[:EW], responses return the
+    updated ext in the nodes tail);
+  * at each hop (Koorde::findNode, Koorde.cc:293-358): keys in
+    (pred, me] are ours, keys in (me, succ] go to the successor;
+    otherwise the walk shifts ``shiftingBits`` destination bits into the
+    route key and forwards to the de Bruijn pointer (or the closest
+    route-key predecessor in the de Bruijn / successor lists —
+    useOtherLookup/useSucList optimizations, both on).
+
+Deviations (documented): the reference's tail recursion when
+findDeBruijnHop returns the node itself (Koorde.cc:340-346) is unrolled
+``SELF_HOPS`` times and then falls back to the ring successor — bounded
+control flow, identical termination, marginally more ring hops in tiny
+overlays.  The de Bruijn backup list is filled from the resolution
+lookup's sibling set (≤ lookup frontier wide) rather than a full
+DeBruijnResponse successor copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.core import keys as K
+from oversim_tpu.overlay.chord import (ChordLogic, ChordParams, ChordState,
+                                       READY, NO_NODE, T_INF)
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+
+P_DEBRUIJN = 7          # lookup purpose tag (chord uses 1-3)
+SELF_HOPS = 3           # unrolled self-recursion bound (module doc)
+
+
+@dataclasses.dataclass(frozen=True)
+class KoordeParams(ChordParams):
+    """default.ini:268-277."""
+
+    stabilize_delay: float = 10.0
+    succ_size: int = 16
+    # the reference stubs out Chord's fixfingers for Koorde (Koorde.cc
+    # handleFixFingersTimerExpired dummy) — park the timer
+    fixfingers_delay: float = 1e9
+    de_bruijn_delay: float = 30.0
+    de_bruijn_size: int = 16
+    shifting_bits: int = 4
+    use_other_lookup: bool = True
+    use_suc_list: bool = True
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KoordeState(ChordState):
+    db_node: jnp.ndarray   # [N] i32 — de Bruijn pointer
+    db_list: jnp.ndarray   # [N, DL] i32 — its successors (backup)
+    t_db: jnp.ndarray      # [N] i64 — de Bruijn timer
+
+
+class KoordeLogic(ChordLogic):
+    """Chord with de Bruijn routing (engine interface unchanged)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: KoordeParams = KoordeParams(),
+                 lcfg: lk_mod.LookupConfig | None = None,
+                 app=None):
+        lcfg = lcfg or lk_mod.LookupConfig(ext_words=spec.lanes + 1)
+        if lcfg.ext_words != spec.lanes + 1:
+            raise ValueError("Koorde needs ext_words == key lanes + 1")
+        super().__init__(spec, params, lcfg, app)
+
+    def init(self, rng, n: int) -> KoordeState:
+        base = super().init(rng, n)
+        kw = {f.name: getattr(base, f.name)
+              for f in dataclasses.fields(base)}
+        return KoordeState(
+            **kw,
+            db_node=jnp.full((n,), NO_NODE, I32),
+            db_list=jnp.full((n, self.p.de_bruijn_size), NO_NODE, I32),
+            t_db=jnp.full((n,), T_INF, I64))
+
+    def next_event(self, st: KoordeState):
+        t = super().next_event(st)
+        return jnp.minimum(t, jnp.where(st.state == READY, st.t_db, T_INF))
+
+    def _become_ready(self, ctx, st, en, now, rng):
+        st = super()._become_ready(ctx, st, en, now, rng)
+        return dataclasses.replace(st, t_db=jnp.where(en, now, st.t_db))
+
+    def _handle_failed(self, ctx, st, me_key, node_idx, failed, now):
+        """Chord repair + de Bruijn pointer/list repair
+        (Koorde::handleFailedNode Koorde.cc:129-160: promote the first
+        backup when the pointer dies, compact the list)."""
+        st = super()._handle_failed(ctx, st, me_key, node_idx, failed, now)
+        any_failed = jnp.any(failed != NO_NODE)
+        db_hit = (st.db_node[..., None] == failed).any(-1) & (
+            st.db_node != NO_NODE)
+        lhit = (st.db_list[..., None] == failed).any(-1) & (
+            st.db_list != NO_NODE)
+        # compact the backup list (drop failed entries, keep order)
+        order = jnp.argsort(jnp.where(lhit, 1, 0), stable=True)
+        compacted = jnp.where(lhit, NO_NODE, st.db_list)[order]
+        new_db = jnp.where(db_hit, compacted[0], st.db_node)
+        compacted = jnp.where(
+            db_hit, jnp.roll(compacted, -1).at[-1].set(NO_NODE), compacted)
+        return dataclasses.replace(
+            st,
+            db_node=jnp.where(any_failed, new_db, st.db_node),
+            db_list=jnp.where(any_failed, compacted, st.db_list))
+
+    # -- de Bruijn timer (handleDeBruijnTimerExpired, Koorde.cc:163) ------
+
+    def _extra_timers(self, ctx, st, ob, me_key, node_idx, t0, t_end, rng):
+        p, spec, lcfg = self.p, self.key_spec, self.lcfg
+        en = (st.state == READY) & (st.t_db < t_end)
+        now = jnp.maximum(st.t_db, t0)
+
+        s0 = st.succ[0]
+        s0k = ctx.keys[jnp.maximum(s0, 0)]
+        has_succ = s0 != NO_NODE
+        # lookup key = (me << s) - (succ[S/2] - me): a little before the
+        # exact de Bruijn key for failure redundancy (Koorde.cc:165-173)
+        lk_key = K.shl_const(me_key, p.shifting_bits, spec)
+        n_succ = jnp.sum((st.succ != NO_NODE).astype(I32))
+        mid = st.succ[jnp.clip(n_succ // 2, 0, st.succ.shape[0] - 1)]
+        midk = ctx.keys[jnp.maximum(mid, 0)]
+        lk_key = jnp.where(has_succ,
+                           K.sub(lk_key, K.sub(midk, me_key, spec), spec),
+                           lk_key)
+
+        pred_ok = st.pred != NO_NODE
+        pk = ctx.keys[jnp.maximum(st.pred, 0)]
+        dl = p.de_bruijn_size
+
+        def pad_dl(vec):
+            out = jnp.full((dl,), NO_NODE, I32)
+            return out.at[:min(vec.shape[0], dl)].set(vec[:dl])
+
+        # case 1: we are responsible → db = self, list = successors
+        own = en & (~has_succ | K.is_between_r(lk_key, me_key, s0k, spec))
+        lst1 = pad_dl(st.succ)
+        # case 2: predecessor is responsible → db = pred, list = self+succ
+        pre = en & ~own & pred_ok & K.is_between_r(lk_key, pk, me_key, spec)
+        lst2 = pad_dl(jnp.concatenate([node_idx[None], st.succ]))
+
+        st = dataclasses.replace(
+            st,
+            db_node=jnp.where(own, node_idx,
+                              jnp.where(pre, st.pred, st.db_node)),
+            db_list=jnp.where(own, lst1, jnp.where(pre, lst2, st.db_list)))
+
+        # case 3: resolve by lookup (the engine form of the routed
+        # DeBruijnCall, Koorde.cc:205-211)
+        need_lk = en & ~own & ~pre
+        no_db_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_DEBRUIJN))
+        slot, have = lk_mod.free_slot(st.lk)
+        nxt, sib = self._find_node(ctx, st, me_key, node_idx, lk_key)
+        start = need_lk & no_db_lk & have & ~sib & (nxt != NO_NODE)
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(nxt)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start, slot, P_DEBRUIJN, 0, lk_key, seed, now, lcfg))
+
+        return dataclasses.replace(st, t_db=jnp.where(
+            en, now + jnp.int64(int(p.de_bruijn_delay * NS)), st.t_db))
+
+    def _on_completion(self, ctx, st, ob, li, comp, en, suc, res, t0):
+        """De Bruijn resolution finished: pointer = closest sibling,
+        backups = the rest of the returned sibling set."""
+        enr = en & (comp["purpose"][li] == P_DEBRUIJN) & suc
+        results = comp["results"][li]
+        dl = self.p.de_bruijn_size
+        lst = results[1:]
+        if lst.shape[0] < dl:
+            lst = jnp.concatenate(
+                [lst, jnp.full((dl - lst.shape[0],), NO_NODE, I32)])
+        return dataclasses.replace(
+            st,
+            db_node=jnp.where(enr, results[0], st.db_node),
+            db_list=jnp.where(enr, lst[:dl], st.db_list))
+
+    # -- routing (Koorde::findNode + findDeBruijnHop) ---------------------
+
+    def _walk_pred(self, ctx, lst, key):
+        """Closest clockwise predecessor of ``key`` in a node list
+        (walkSuccessorList/walkDeBruijnList, Koorde.cc:379-409): entry
+        minimizing (key - entry) ring distance; NO_NODE if list empty."""
+        spec = self.key_spec
+        ek = ctx.keys[jnp.maximum(lst, 0)]
+        d = K.sub(jnp.broadcast_to(key, ek.shape), ek, spec)
+        d = jnp.where((lst == NO_NODE)[:, None], jnp.uint32(0xFFFFFFFF), d)
+        (srt,) = K.sort_by_distance(d, (lst,))[1]
+        return jnp.where(jnp.any(lst != NO_NODE), srt[0], NO_NODE)
+
+    def _find_start_key(self, me_key, s0k, key):
+        """findStartKey (Koorde.cc): imaginary start key within
+        (me, succ] aligned to the shifting-bit grid → (route_key, step).
+        """
+        spec, s = self.key_spec, self.p.shifting_bits
+        diff = K.sub(s0k, me_key, spec)
+        nbits = jnp.maximum(K.log2_floor(diff, spec), 0)
+        # largest nbits' <= nbits with (bits - nbits') % s == 0
+        nbits = jnp.maximum(nbits - jnp.mod(nbits - spec.bits, s), 0)
+        step = nbits + 1
+        new_start = K.shl_dyn(K.shr_dyn(me_key, nbits, spec), nbits, spec)
+        tmp_dest = K.shr_dyn(key, spec.bits - nbits, spec)
+        new_key = K.add(tmp_dest, new_start, spec)
+        ok1 = K.is_between_r(new_key, me_key, s0k, spec)
+        bump = self._pow2[jnp.clip(nbits, 0, spec.bits - 1)]
+        rk = jnp.where(ok1, new_key, K.add(new_key, bump, spec))
+        # degenerate single-node interval: route key = me
+        rk = jnp.where(K.eq(diff, jnp.zeros_like(diff)), me_key, rk)
+        return rk, step
+
+    def _db_hop(self, ctx, st, me_key, node_idx, key, route_key, step):
+        """One findDeBruijnHop evaluation (Koorde.cc findDeBruijnHop).
+
+        Returns (hop, route_key', step')."""
+        p, spec, s = self.p, self.key_spec, self.p.shifting_bits
+        s0 = st.succ[0]
+        s0k = ctx.keys[jnp.maximum(s0, 0)]
+        no_db = st.db_node == NO_NODE
+        dbk = ctx.keys[jnp.maximum(st.db_node, 0)]
+        db0 = st.db_list[0]
+        db0k = ctx.keys[jnp.maximum(db0, 0)]
+
+        in_resp = K.is_between_r(route_key, me_key, s0k, spec)
+
+        # shift the next s destination bits into the route key (reference
+        # uses LSB-indexed positions bits-step, bits-step-1, ...)
+        add_val = jnp.int32(0)
+        for i in range(s):
+            pos = spec.bits - step - i
+            bit = jnp.where(pos >= 0,
+                            K.bit(key, jnp.maximum(pos, 0), spec), 0)
+            add_val = (add_val << 1) | bit.astype(I32)
+        add_key = jnp.zeros((spec.lanes,), U32).at[-1].set(
+            add_val.astype(U32))
+        rk_shift = K.add(K.shl_const(route_key, s, spec), add_key, spec)
+
+        # in our responsibility → advance and jump along the de Bruijn edge
+        walk_db = self._walk_pred(ctx, st.db_list, rk_shift)
+        db_direct = (db0 != NO_NODE) & K.is_between_r(rk_shift, dbk, db0k,
+                                                      spec)
+        hop_db = jnp.where(db_direct | (db0 == NO_NODE), st.db_node,
+                           jnp.where(walk_db != NO_NODE, walk_db,
+                                     st.db_node))
+        if p.use_suc_list:
+            hop_nodb = self._walk_pred(ctx, st.succ, rk_shift)
+            hop_nodb = jnp.where(hop_nodb == NO_NODE, s0, hop_nodb)
+        else:
+            hop_nodb = s0
+        hop_in = jnp.where(no_db, hop_nodb, hop_db)
+
+        # outside our responsibility → ring-walk toward the route key
+        # (breakLookup path; optionally prefer the de Bruijn pointer)
+        walk_s = self._walk_pred(ctx, st.succ, route_key)
+        hop_out = jnp.where(walk_s != NO_NODE, walk_s, s0)
+        if p.use_suc_list:
+            better_db = ~no_db & K.is_between(
+                dbk, ctx.keys[jnp.maximum(hop_out, 0)], route_key, spec)
+            hop_out = jnp.where(better_db, st.db_node, hop_out)
+
+        hop = jnp.where(in_resp, hop_in, hop_out)
+        rk_out = jnp.where(in_resp, rk_shift, route_key)
+        step_out = jnp.where(in_resp, step + s, step)
+        return hop, rk_out, step_out
+
+    def _respond_find(self, ctx, st, me_key, node_idx, m, rmax, pad_nodes):
+        """Koorde::findNode (Koorde.cc:293-358) with the lookup ext
+        (routeKey, step) unpacked from the call and the updated ext
+        repacked into the response tail (lookup.py ext layout)."""
+        p, spec, lcfg = self.p, self.key_spec, self.lcfg
+        ew = lcfg.ext_words
+        key = m.key
+        ready = st.state == READY
+
+        ext_in = m.nodes[:ew]
+        route_key_in = jax.lax.bitcast_convert_type(
+            ext_in[:spec.lanes], U32)
+        step_in = ext_in[spec.lanes]
+
+        pred_ok = st.pred != NO_NODE
+        pk = ctx.keys[jnp.maximum(st.pred, 0)]
+        s0 = st.succ[0]
+        s0k = ctx.keys[jnp.maximum(s0, 0)]
+        has_succ = s0 != NO_NODE
+        alone = ~pred_ok & ~has_succ
+
+        is_sib = ready & (alone
+                          | (~pred_ok & K.eq(key, me_key))
+                          | (pred_ok & K.is_between_r(key, pk, me_key,
+                                                      spec)))
+        succ_case = ready & has_succ & ~is_sib & K.is_between_r(
+            key, me_key, s0k, spec)
+
+        # useOtherLookup (Koorde.cc:299-306): if a successor other than
+        # the farthest already precedes the key, ring-walk it directly
+        n_succ = jnp.sum((st.succ != NO_NODE).astype(I32))
+        far = st.succ[jnp.clip(n_succ - 1, 0, st.succ.shape[0] - 1)]
+        walk = self._walk_pred(ctx, st.succ, key)
+        other_ok = jnp.bool_(p.use_other_lookup) & (walk != NO_NODE) & (
+            walk != far)
+
+        # lazy route-key initialization (findDeBruijnHop init path); with
+        # no de Bruijn pointer yet the hop is the plain successor and the
+        # ext stays unset (breakLookup, Koorde.cc:296-301)
+        need_init = step_in == 0
+        no_db = st.db_node == NO_NODE
+        rk0, step0 = self._find_start_key(me_key, s0k, key)
+        rk_cur = jnp.where(need_init, rk0, route_key_in)
+        step_cur = jnp.where(need_init, step0, step_in)
+
+        # de Bruijn walk with the self-recursion unrolled (module doc)
+        hop = s0
+        rk_fin, step_fin = rk_cur, step_cur
+        done = jnp.bool_(False)
+        for _ in range(SELF_HOPS):
+            h, rk2, st2 = self._db_hop(ctx, st, me_key, node_idx, key,
+                                       rk_cur, step_cur)
+            stop_now = ~done & (h != node_idx)
+            hop = jnp.where(stop_now, h, hop)
+            rk_fin = jnp.where(stop_now, rk2, rk_fin)
+            step_fin = jnp.where(stop_now, st2, step_fin)
+            done = done | stop_now
+            rk_cur = jnp.where(done, rk_cur, rk2)
+            step_cur = jnp.where(done, step_cur, st2)
+        # still self after the unroll → ring successor with the advanced
+        # route key (bounded fallback; reference recurses)
+        rk_fin = jnp.where(done, rk_fin, rk_cur)
+        step_fin = jnp.where(done, step_fin, step_cur)
+
+        db_path = ready & ~is_sib & ~succ_case & ~other_ok & ~(
+            need_init & no_db)
+        nxt = jnp.where(
+            is_sib, node_idx,
+            jnp.where(succ_case, s0,
+                      jnp.where(other_ok, walk,
+                                jnp.where(need_init & no_db, s0, hop))))
+        nxt = jnp.where(ready, nxt, NO_NODE)
+
+        # response payload: sibling set when responsible, else the hop
+        # with the updated ext in the tail; ext passes through untouched
+        # on every non-de-Bruijn path
+        sib_set = pad_nodes(jnp.concatenate([node_idx[None], st.succ]))
+        res = jnp.where(
+            is_sib, sib_set,
+            jnp.full((rmax,), NO_NODE, I32).at[0].set(nxt))
+        ext_key = jnp.where(db_path, rk_fin, route_key_in)
+        ext_step = jnp.where(db_path, step_fin, step_in)
+        ext_out = jnp.concatenate(
+            [jax.lax.bitcast_convert_type(ext_key, I32), ext_step[None]])
+        res = jnp.where(is_sib, res, res.at[rmax - ew:].set(ext_out))
+        return res, is_sib

@@ -72,3 +72,19 @@ def jit_step(sim, mesh: Mesh, donate: bool = True):
     return jax.jit(sim.step, in_shardings=(shardings,),
                    out_shardings=shardings,
                    donate_argnums=(0,) if donate else ())
+
+
+def jit_run(sim, mesh: Mesh, n_ticks: int, donate: bool = True):
+    """jit a ``lax.scan`` of n_ticks sharded steps (one dispatch for the
+    whole run — the multi-chip equivalent of Simulation.run_chunk)."""
+    example = sim.init()
+    shardings = state_shardings(example, mesh)
+
+    def run(s):
+        def body(carry, _):
+            return sim.step(carry), None
+        s, _ = jax.lax.scan(body, s, None, length=n_ticks)
+        return s
+
+    return jax.jit(run, in_shardings=(shardings,), out_shardings=shardings,
+                   donate_argnums=(0,) if donate else ())

@@ -1,0 +1,63 @@
+"""Koorde end-to-end slice: ring + de Bruijn pointers + KBR delivery."""
+
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.koorde import KoordeLogic, KoordeParams, READY
+
+
+N = 16
+
+
+@pytest.fixture(scope="module")
+def koorde_run():
+    logic = KoordeLogic(app=KbrTestApp(KbrTestParams(test_interval=20.0)))
+    cp = churn_mod.ChurnParams(model="none", target_num=N,
+                               init_interval=0.5)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=80.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=11)
+    st = s.run_until(st, 400.0, chunk=512)
+    return s, st
+
+
+def test_ring_forms(koorde_run):
+    _, st = koorde_run
+    assert (np.asarray(st.logic.state) == READY).all()
+    keys_int = [K.to_int(k) for k in np.asarray(st.node_keys)]
+    order = sorted(range(N), key=lambda i: keys_int[i])
+    succ = np.asarray(st.logic.succ)
+    bad = sum(1 for pos, i in enumerate(order)
+              if succ[i, 0] != order[(pos + 1) % N])
+    assert bad == 0, f"{bad}/{N} successor pointers wrong"
+
+
+def test_de_bruijn_pointers_resolve(koorde_run):
+    """Every READY node must have resolved its de Bruijn pointer to the
+    node responsible for (key << shiftingBits) - half a successor span
+    — at minimum, the pointer must be set and alive."""
+    _, st = koorde_run
+    db = np.asarray(st.logic.db_node)
+    assert (db >= 0).all(), f"unresolved de Bruijn pointers: {db}"
+
+
+def test_deliveries(koorde_run):
+    s, st = koorde_run
+    out = s.summary(st)
+    assert out["kbr_sent"] > 50
+    ratio = out["kbr_delivered"] / out["kbr_sent"]
+    assert ratio > 0.97, out
+    assert out["kbr_wrong_node"] == 0
+    # de Bruijn walks are bounded by bits/shiftingBits + ring tail
+    assert out["kbr_hopcount"]["max"] <= 12
+
+
+def test_no_engine_losses(koorde_run):
+    s, st = koorde_run
+    eng = s.summary(st)["_engine"]
+    assert eng["pool_overflow"] == 0
+    assert eng["outbox_overflow"] == 0
