@@ -30,11 +30,17 @@ import time
 PROBE_TIMEOUT_S = 240  # tunnel init + first trivial compile
 
 
-def _probe_platform() -> str:
-    """Decide which jax platform to use before jax is imported."""
+def _probe_platform() -> str | None:
+    """Decide the jax_platforms override before jax is imported.
+
+    None = leave the ambient selection alone (the sitecustomize hook
+    forces the TPU-tunnel plugin "axon"); the device then REPORTS
+    platform "tpu" but must never be requested by that name — requesting
+    "tpu" looks for a native libtpu and fails (round-2 failure mode).
+    Only a failed/hung probe pins "cpu"."""
     env = os.environ.get("OVERSIM_BENCH_PLATFORM")
     if env:
-        return env
+        return None if env in ("axon", "default") else env
     code = ("import jax; d = jax.devices()[0]; "
             "import jax.numpy as jnp; jnp.zeros(()).block_until_ready(); "
             "print(d.platform)")
@@ -43,7 +49,7 @@ def _probe_platform() -> str:
                            timeout=PROBE_TIMEOUT_S, capture_output=True,
                            text=True)
         if r.returncode == 0 and r.stdout.strip():
-            return r.stdout.strip().splitlines()[-1]
+            return None                      # ambient backend works
         sys.stderr.write(
             "bench: backend probe failed rc=%d\nstderr tail:\n%s\n"
             % (r.returncode, r.stderr[-2000:]))
@@ -62,8 +68,10 @@ jax.config.update("jax_enable_x64", True)
 # sim-step graphs compile slowly; cache persistently across invocations
 jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-# last update wins over the sitecustomize hook's forced "axon,cpu"
-jax.config.update("jax_platforms", _PLATFORM)
+# last update wins over the sitecustomize hook's forced "axon,cpu";
+# None keeps the ambient (tunnel) selection
+if _PLATFORM is not None:
+    jax.config.update("jax_platforms", _PLATFORM)
 
 from oversim_tpu import churn as churn_mod  # noqa: E402
 from oversim_tpu.apps import kbrtest  # noqa: E402
@@ -130,7 +138,7 @@ def main():
     except Exception:
         import traceback
         traceback.print_exc()
-        if _PLATFORM != "cpu":
+        if _PLATFORM is None:
             # tunnel backend died mid-run: retry once on CPU so the
             # driver still records a number
             sys.stderr.write("bench: retrying on cpu backend\n")

@@ -1,0 +1,147 @@
+/* tracescan — native trace-file scanner for oversim_tpu.
+ *
+ * Native equivalent of the reference's GlobalTraceManager file front-end
+ * (src/common/GlobalTraceManager.{h,cc}: mmap-based chunked reader,
+ * 32-page chunks, GlobalTraceManager.h:57), rebuilt as a host-side C
+ * library: the whole trace is mmapped and scanned in one pass into
+ * flat arrays the Python layer turns into engine schedules
+ * (oversim_tpu/trace.py).  Million-line traces (1M-node driver configs)
+ * parse at memory bandwidth instead of Python-string speed.
+ *
+ * Line format (simulations/dht.trace):
+ *   <time> <nodeID> JOIN | LEAVE | PUT <k> <v> | GET <k>
+ *   <time> 0 CONNECT_NODETYPES <a> <b> | DISCONNECT_NODETYPES <a> <b>
+ *
+ * API (ctypes, see oversim_tpu/native.py):
+ *   int ts_scan(const char *path, TsEvent **out, long *n_out);
+ *     returns 0 on success; caller frees with ts_free.  String args are
+ *     returned as offsets into the mmapped copy held alive until
+ *     ts_free.
+ */
+
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+typedef struct {
+    double time;
+    int32_t node;
+    int32_t cmd;        /* 0 JOIN 1 LEAVE 2 PUT 3 GET 4 CONNECT 5 DISCONNECT */
+    int64_t arg0_off;   /* offset of first arg token (-1 none) */
+    int32_t arg0_len;
+    int64_t arg1_off;   /* offset of second arg token (-1 none) */
+    int32_t arg1_len;
+} TsEvent;
+
+typedef struct {
+    TsEvent *events;
+    long n;
+    char *buf;          /* private copy of the file (token storage) */
+    long buf_len;
+} TsResult;
+
+static int cmd_code(const char *tok, int len) {
+    if (len == 4 && !memcmp(tok, "JOIN", 4)) return 0;
+    if (len == 5 && !memcmp(tok, "LEAVE", 5)) return 1;
+    if (len == 3 && !memcmp(tok, "PUT", 3)) return 2;
+    if (len == 3 && !memcmp(tok, "GET", 3)) return 3;
+    if (len == 17 && !memcmp(tok, "CONNECT_NODETYPES", 17)) return 4;
+    if (len == 20 && !memcmp(tok, "DISCONNECT_NODETYPES", 20)) return 5;
+    return -1;
+}
+
+/* scan one whitespace-separated token in [p, end); returns new p */
+static const char *tok(const char *p, const char *end,
+                       const char **t, int *tl) {
+    while (p < end && (*p == ' ' || *p == '\t')) p++;
+    *t = p;
+    while (p < end && *p != ' ' && *p != '\t' && *p != '\n' && *p != '\r')
+        p++;
+    *tl = (int)(p - *t);
+    return p;
+}
+
+long ts_free(TsResult *r) {
+    if (!r) return 0;
+    free(r->events);
+    free(r->buf);
+    free(r);
+    return 0;
+}
+
+TsResult *ts_scan(const char *path) {
+    int fd = open(path, O_RDONLY);
+    if (fd < 0) return NULL;
+    struct stat st;
+    if (fstat(fd, &st) != 0) { close(fd); return NULL; }
+    long len = (long)st.st_size;
+    TsResult *r = calloc(1, sizeof(TsResult));
+    if (!r) { close(fd); return NULL; }
+    r->buf = malloc(len + 1);
+    if (!r->buf) { free(r); close(fd); return NULL; }
+    /* mmap for the scan (the reference reads 32-page chunks; one map is
+       simpler and equally streaming-friendly), memcpy into the result
+       buffer so returned token offsets stay valid after munmap */
+    if (len > 0) {
+        void *m = mmap(NULL, len, PROT_READ, MAP_PRIVATE, fd, 0);
+        if (m == MAP_FAILED) { free(r->buf); free(r); close(fd); return NULL; }
+        memcpy(r->buf, m, len);
+        munmap(m, len);
+    }
+    r->buf[len] = '\0';
+    r->buf_len = len;
+    close(fd);
+
+    long cap = 1024;
+    r->events = malloc(cap * sizeof(TsEvent));
+    if (!r->events) { ts_free(r); return NULL; }
+
+    const char *p = r->buf, *end = r->buf + len;
+    while (p < end) {
+        const char *line_end = memchr(p, '\n', end - p);
+        if (!line_end) line_end = end;
+        const char *t; int tl;
+        const char *q = tok(p, line_end, &t, &tl);
+        if (tl > 0 && *t != '#') {
+            char tmp[64];
+            TsEvent ev;
+            ev.arg0_off = ev.arg1_off = -1;
+            ev.arg0_len = ev.arg1_len = 0;
+            int ok = 1;
+            if (tl >= (int)sizeof(tmp)) ok = 0;
+            if (ok) { memcpy(tmp, t, tl); tmp[tl] = 0; ev.time = atof(tmp); }
+            q = tok(q, line_end, &t, &tl);
+            if (ok && tl > 0 && tl < (int)sizeof(tmp)) {
+                memcpy(tmp, t, tl); tmp[tl] = 0; ev.node = atoi(tmp);
+            } else ok = 0;
+            q = tok(q, line_end, &t, &tl);
+            if (ok) { ev.cmd = cmd_code(t, tl); if (ev.cmd < 0) ok = 0; }
+            if (ok) {
+                q = tok(q, line_end, &t, &tl);
+                if (tl > 0) { ev.arg0_off = t - r->buf; ev.arg0_len = tl; }
+                q = tok(q, line_end, &t, &tl);
+                if (tl > 0) { ev.arg1_off = t - r->buf; ev.arg1_len = tl; }
+            }
+            if (ok) {
+                if (r->n == cap) {
+                    cap *= 2;
+                    TsEvent *ne = realloc(r->events, cap * sizeof(TsEvent));
+                    if (!ne) { ts_free(r); return NULL; }
+                    r->events = ne;
+                }
+                r->events[r->n++] = ev;
+            }
+        }
+        p = line_end + 1;
+    }
+    return r;
+}
+
+/* accessors for ctypes (avoid struct-layout coupling) */
+long ts_count(TsResult *r) { return r ? r->n : -1; }
+const char *ts_buf(TsResult *r) { return r ? r->buf : NULL; }
+TsEvent *ts_events(TsResult *r) { return r ? r->events : NULL; }

@@ -14,13 +14,18 @@ the overlay logic drives from inside its vmapped per-node step:
   on_ready(state, en, now, rng) -> state    # overlay became READY
   on_stop(state, en) -> state               # node left / lost READY
   next_event(state) -> [N] i64              # earliest app timer
-  on_timer(state_n, en, ctx, now, rng) -> (state_n, LookupReq)
+  on_timer(state_n, en, ctx, now, rng, ev, node_idx)
+      -> (state_n, LookupReq)
       # fire app timers due in the window; optionally request ONE lookup
   on_lookup_done(state_n, done, ctx, ob, ev, now, node_idx) -> state_n
       # a requested lookup finished; ``done`` is a LookupDone; the app
       # emits follow-up messages (payload hop, DHT puts/gets) via ``ob``
   on_msg(state_n, m, ctx, ob, ev, is_sib) -> state_n
       # one inbound message of an app-owned kind (wire.py kind >= 30)
+  on_leave(state_n, en, ctx, ob, ev, now, node_idx, handover) -> state_n
+      # graceful-leave grace window (ctx.graceful; reference
+      # NF_OVERLAY_NODE_GRACEFUL_LEAVE): hand state over to ``handover``
+      # (the overlay's succession candidate) before the final kill
 
 All hooks are pure functions over one node's slice (vmapped), except
 ``init/glob_init/post_step/on_ready/on_stop/next_event`` which see full
@@ -58,6 +63,18 @@ class LookupDone:
     results: jnp.ndarray     # [R] i32 sibling slots (NO_NODE padded)
     hops: jnp.ndarray        # i32
     t0: jnp.ndarray          # i64 lookup start time
+
+
+def leave_protocol(app_obj, app_state, ctx, ob, ev, t0, node_idx,
+                   handover, ready):
+    """Per-tick graceful-leave sequence shared by every overlay step:
+    graceful leavers hand data to ``handover`` (on_leave), and every
+    leaver parks its app timers (on_stop — the reference's
+    BaseApp::handleNodeLeaveNotification cancels the periodic tests)."""
+    app_state = app_obj.on_leave(
+        app_state, ctx.graceful[node_idx] & ready, ctx, ob, ev, t0,
+        node_idx, handover)
+    return app_obj.on_stop(app_state, ctx.leaving[node_idx] & ready)
 
 
 class AppEvents:

@@ -21,11 +21,16 @@ TPU-native rebuild of the reference stack (SURVEY.md §2.4/§3.4):
     the reference's DHTTestApp stores into GlobalDhtTestMap (on
     DHTputCAPIResponse, DHTTestApp.cc:163-182).
 
+GET quorum: numGetRequests parallel DHTGetCalls whose responses are
+majority-voted with ratioIdentical (DHT.cc:620-648).  Graceful-leave
+handover pushes stored records to the overlay succession candidate
+during the grace window (on_leave; reference GRACEFUL_LEAVE
+notification + DHT maintenance puts).
+
 Simplifications vs the reference (documented): one outstanding DHT
-operation per node (the reference allows several concurrent CAPI calls);
-GET quorum is first-response (numGetRequests=1) rather than
-ratioIdentical voting over 4 parallel gets; no ownership handover puts
-on churn yet (update() maintenance TODO).
+operation per node (the reference allows several concurrent CAPI
+calls); the update()-driven maintenance puts on every sibling-set
+change are approximated by the graceful-leave handover only.
 """
 
 from __future__ import annotations
@@ -55,6 +60,8 @@ class DhtParams:
     """default.ini:67-77 + tier2 dhtTestApp namespace."""
 
     num_replica: int = 4          # numReplica
+    num_get_requests: int = 4     # numGetRequests, default.ini:68
+    ratio_identical: float = 0.5  # ratioIdentical, default.ini:69
     test_interval: float = 60.0   # dhtTestApp.testInterval
     test_ttl: float = 300.0       # dhtTestApp.testTtl
     storage_slots: int = 32       # per-node DHTDataStorage capacity
@@ -74,6 +81,13 @@ class DhtState:
     # test driver
     t_test: jnp.ndarray    # [N] i64
     seq: jnp.ndarray       # [N] i32
+    # trace-driven command queues (empty [N, 0] arrays when not tracing)
+    tr_t: jnp.ndarray      # [N, Q] i64 command times
+    tr_kind: jnp.ndarray   # [N, Q] i32 (1=PUT, 2=GET)
+    tr_key: jnp.ndarray    # [N, Q, KL] u32
+    tr_val: jnp.ndarray    # [N, Q] i32
+    tr_g: jnp.ndarray      # [N, Q] i32 truth-pool slot
+    tr_cur: jnp.ndarray    # [N] i32 queue cursor
     # one outstanding operation
     op: jnp.ndarray        # [N] i32 OP_*
     op_seq: jnp.ndarray    # [N] i32 — op nonce (stale-completion guard)
@@ -82,6 +96,7 @@ class DhtState:
     op_expect: jnp.ndarray  # [N] i32 truth value for pending GET
     op_pending: jnp.ndarray  # [N] i32 replica responses awaited
     op_acks: jnp.ndarray   # [N] i32
+    op_votes: jnp.ndarray  # [N, Q] i32 — GET quorum response values
     op_to: jnp.ndarray     # [N] i64 op timeout
     op_t0: jnp.ndarray     # [N] i64 op start (latency stat)
     # staged truth commit, folded into DhtGlobal by post_step
@@ -101,12 +116,20 @@ class DhtGlobal:
 
 
 class DhtApp:
-    """Tier app (interface: apps/base.py)."""
+    """Tier app (interface: apps/base.py).
+
+    With ``trace`` set (a trace.TraceWorkload), the random PUT/GET test
+    driver is replaced by the trace's per-node command queues (reference
+    DHTTestApp::handleTraceMessage, DHTTestApp.cc:247-287, driven by
+    GlobalTraceManager) and the truth-map key pool is the trace's
+    distinct keys."""
 
     def __init__(self, params: DhtParams = DhtParams(),
-                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC,
+                 trace=None):
         self.p = params
         self.spec = spec
+        self.trace = trace
 
     def stat_spec(self):
         return dict(
@@ -120,12 +143,30 @@ class DhtApp:
     def init(self, n: int) -> DhtState:
         p, kl = self.p, self.spec.lanes
         d = p.storage_slots
+        if self.trace is not None:
+            if self.trace.t.shape[0] != n:
+                raise ValueError("trace workload slot count != num nodes")
+            tr_t = jnp.asarray(
+                jnp.where(jnp.isinf(jnp.asarray(self.trace.t)),
+                          T_INF, jnp.asarray(self.trace.t) * NS), I64)
+            tr_kind = jnp.asarray(self.trace.kind, I32)
+            tr_key = jnp.asarray(self.trace.key, U32)
+            tr_val = jnp.asarray(self.trace.value, I32)
+            tr_g = jnp.asarray(self.trace.g, I32)
+        else:
+            tr_t = jnp.full((n, 0), T_INF, I64)
+            tr_kind = jnp.zeros((n, 0), I32)
+            tr_key = jnp.zeros((n, 0, kl), U32)
+            tr_val = jnp.zeros((n, 0), I32)
+            tr_g = jnp.zeros((n, 0), I32)
         return DhtState(
             s_key=jnp.zeros((n, d, kl), U32),
             s_val=jnp.full((n, d), NO_VAL, I32),
             s_expire=jnp.zeros((n, d), I64),
             t_test=jnp.full((n,), T_INF, I64),
             seq=jnp.zeros((n,), I32),
+            tr_t=tr_t, tr_kind=tr_kind, tr_key=tr_key, tr_val=tr_val,
+            tr_g=tr_g, tr_cur=jnp.zeros((n,), I32),
             op=jnp.zeros((n,), I32),
             op_seq=jnp.zeros((n,), I32),
             op_g=jnp.zeros((n,), I32),
@@ -133,6 +174,7 @@ class DhtApp:
             op_expect=jnp.full((n,), NO_VAL, I32),
             op_pending=jnp.zeros((n,), I32),
             op_acks=jnp.zeros((n,), I32),
+            op_votes=jnp.full((n, p.num_get_requests), NO_VAL - 1, I32),
             op_to=jnp.full((n,), T_INF, I64),
             op_t0=jnp.zeros((n,), I64),
             commit_g=jnp.full((n,), -1, I32),
@@ -141,6 +183,12 @@ class DhtApp:
         )
 
     def glob_init(self, rng) -> DhtGlobal:
+        if self.trace is not None:
+            pool = jnp.asarray(self.trace.key_pool, U32)
+            return DhtGlobal(
+                keys=pool,
+                val=jnp.full((pool.shape[0],), NO_VAL, I32),
+                expire=jnp.zeros((pool.shape[0],), I64))
         g = self.p.num_test_keys
         return DhtGlobal(
             keys=keys_mod.random_keys(rng, (g,), self.spec),
@@ -164,6 +212,13 @@ class DhtApp:
         return state, glob
 
     def on_ready(self, app, en, now, rng):
+        if self.trace is not None:
+            # trace commands fire at absolute times: expose the next
+            # queued command as the app timer
+            q = jnp.clip(app.tr_cur, 0, max(app.tr_t.shape[-1] - 1, 0))
+            nxt = app.tr_t[q] if app.tr_t.shape[-1] else T_INF
+            return dataclasses.replace(
+                app, t_test=jnp.where(en, nxt, app.t_test))
         off = jax.random.uniform(rng, (), minval=0.0,
                                  maxval=self.p.test_interval)
         t = now + (off * NS).astype(I64)
@@ -181,7 +236,7 @@ class DhtApp:
 
     # -- timers --------------------------------------------------------------
 
-    def on_timer(self, app, en, ctx, now, rng, ev):
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
         p = self.p
         glob: DhtGlobal = ctx.glob
         g_n = glob.val.shape[0]
@@ -193,6 +248,46 @@ class DhtApp:
             app,
             op=jnp.where(to, OP_NONE, app.op),
             op_to=jnp.where(to, T_INF, app.op_to))
+
+        if self.trace is not None:
+            # trace-driven commands (DHTTestApp::handleTraceMessage)
+            qn = app.tr_t.shape[-1]
+            q = jnp.clip(app.tr_cur, 0, max(qn - 1, 0))
+            due = en & (app.t_test < ctx.t_end) & (app.tr_cur < qn)
+            fire = due & (app.op == OP_NONE)
+            # a due command blocked by an in-flight op must still advance
+            # the timer (retry shortly) or the event horizon pins
+            # simulated time on it and the tick loop spins
+            blocked = due & ~fire
+            do_put = fire & (app.tr_kind[q] == 1)
+            do_get = fire & (app.tr_kind[q] == 2)
+            ev.count("dht_put_attempts", do_put)
+            ev.count("dht_get_attempts", do_get)
+            key = app.tr_key[q]
+            val = app.tr_val[q]
+            g = app.tr_g[q]
+            cur2 = app.tr_cur + fire.astype(I32)
+            q2 = jnp.clip(cur2, 0, max(qn - 1, 0))
+            nxt_t = jnp.where(cur2 < qn, app.tr_t[q2], T_INF)
+            nxt_t = jnp.where(blocked, now + NS, nxt_t)   # retry in 1s
+            app = dataclasses.replace(
+                app,
+                tr_cur=cur2,
+                t_test=jnp.where(due, nxt_t, app.t_test),
+                seq=app.seq + fire.astype(I32),
+                op=jnp.where(do_put, OP_PUT,
+                             jnp.where(do_get, OP_GET, app.op)),
+                op_seq=jnp.where(fire, app.seq, app.op_seq),
+                op_g=jnp.where(fire, g, app.op_g),
+                op_val=jnp.where(do_put, val, app.op_val),
+                op_expect=jnp.where(do_get, glob.val[g], app.op_expect),
+                op_pending=jnp.where(fire, 0, app.op_pending),
+                op_acks=jnp.where(fire, 0, app.op_acks),
+                op_to=jnp.where(fire, now + jnp.int64(
+                    int(p.op_timeout * NS)), app.op_to),
+                op_t0=jnp.where(fire, now, app.op_t0))
+            return app, base.LookupReq(want=do_put | do_get, key=key,
+                                       tag=app.op_seq)
 
         # periodic test: alternate PUT / GET (DHTTestApp::handleTimerEvent
         # issues a put or get per tick of its own timers; we alternate on
@@ -264,11 +359,23 @@ class DhtApp:
         app = dataclasses.replace(
             app, op_pending=jnp.where(is_put, nrep, app.op_pending))
 
-        # GET: DHTGetCall to the closest sibling
+        # GET: DHTGetCall to numGetRequests siblings — the responses are
+        # quorum-voted with ratioIdentical (DHT.cc:262,636; default.ini:
+        # numGetRequests=4, ratioIdentical=0.5)
         is_get = en & suc & (app.op == OP_GET)
-        ob.send(is_get, now, done.results[0], wire.DHT_GET_CALL,
-                key=done.target, b=app.op_seq,
-                size_b=wire.BASE_CALL_B + 20)
+        nget = jnp.int32(0)
+        for i in range(min(p.num_get_requests, done.results.shape[0])):
+            tgt = done.results[i]
+            send = is_get & (tgt != NO_NODE)
+            ob.send(send, now, tgt, wire.DHT_GET_CALL,
+                    key=done.target, b=app.op_seq,
+                    size_b=wire.BASE_CALL_B + 20)
+            nget += send.astype(I32)
+        app = dataclasses.replace(
+            app,
+            op_pending=jnp.where(is_get, nget, app.op_pending),
+            op_acks=jnp.where(is_get, 0, app.op_acks),
+            op_votes=jnp.where(is_get, NO_VAL - 1, app.op_votes))
         return app
 
     # -- inbound messages ----------------------------------------------------
@@ -292,6 +399,28 @@ class DhtApp:
             s_key=app.s_key.at[col].set(key, mode="drop"),
             s_val=app.s_val.at[col].set(val, mode="drop"),
             s_expire=app.s_expire.at[col].set(expire, mode="drop"))
+
+    def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
+        """Graceful-leave data handover: push stored records to the
+        overlay's succession candidate before dying (the reference's
+        NF_OVERLAY_NODE_GRACEFUL_LEAVE → overlay handover + DHT
+        maintenance puts, Kademlia.cc:964 / DHT update()).  Paced at
+        two records per tick through the grace window; pushed records
+        are cleared locally (the node is about to die anyway)."""
+        en = en & (handover != NO_NODE) & (handover != node_idx)
+        valid = app.s_val != NO_VAL
+        for _ in range(2):
+            has = en & jnp.any(valid)
+            col = jnp.argmax(valid).astype(I32)
+            ob.send(has, now, handover, wire.DHT_PUT_CALL,
+                    key=app.s_key[col], a=app.s_val[col], b=jnp.int32(-1),
+                    stamp=app.s_expire[col],
+                    size_b=wire.BASE_CALL_B + 20 + 8)
+            ccol = jnp.where(has, col, app.s_val.shape[0])
+            app = dataclasses.replace(
+                app, s_val=app.s_val.at[ccol].set(NO_VAL, mode="drop"))
+            valid = valid.at[ccol].set(False, mode="drop")
+        return app
 
     def on_msg(self, app, m, ctx, ob, ev, is_sib):
         p = self.p
@@ -336,26 +465,47 @@ class DhtApp:
         ob.send(en, now, m.src, wire.DHT_GET_RES, key=m.key, a=val, b=m.b,
                 size_b=wire.BASE_CALL_B + 8)
 
-        # DHTGetResponse → validate vs the CURRENT truth (the reference
+        # DHTGetResponse → quorum vote, then validate the winning value
+        # vs the CURRENT truth (the reference hashes the responses and
+        # requires a ratioIdentical majority, DHT.cc:620-648; DHTTestApp
         # reads GlobalDhtTestMap at response time, DHTTestApp.cc:121-182).
         # Nonce + key match guard against stale responses completing a
         # newer GET with a mismatched value
+        q = p.num_get_requests
         op_key = ctx.glob.keys[jnp.clip(app.op_g, 0,
                                         ctx.glob.val.shape[0] - 1)]
         en = (m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
               & (m.b == app.op_seq) & jnp.all(m.key == op_key))
+        slot = jnp.where(en, jnp.clip(app.op_acks, 0, q - 1), q)
+        votes = app.op_votes.at[slot].set(m.a, mode="drop")
+        n_acks = app.op_acks + en.astype(I32)
+        filled = jnp.arange(q) < n_acks
+        counts = jnp.sum((votes[:, None] == votes[None, :])
+                         & filled[None, :], axis=1)
+        counts = jnp.where(filled, counts, 0)
+        need = jnp.ceil(p.ratio_identical
+                        * app.op_pending.astype(jnp.float32)).astype(I32)
+        need = jnp.maximum(need, 1)
+        win = en & jnp.any(counts >= need)
+        winner = votes[jnp.argmax(counts)]
+        exhausted = en & ~win & (n_acks >= app.op_pending)
+        complete = win | exhausted
         expect = ctx.glob.val[jnp.clip(app.op_g, 0,
                                        ctx.glob.val.shape[0] - 1)]
-        good = en & (m.a == expect) & (m.a != NO_VAL)
+        good = win & (winner == expect) & (winner != NO_VAL)
         ev.count("dht_get_success", good)
-        ev.count("dht_get_wrong", en & (m.a != expect) & (m.a != NO_VAL))
-        ev.count("dht_get_notfound", en & (m.a == NO_VAL))
+        ev.count("dht_get_wrong",
+                 (win & (winner != expect) & (winner != NO_VAL))
+                 | exhausted)
+        ev.count("dht_get_notfound", win & (winner == NO_VAL))
         ev.value("dht_get_latency_s",
                  (now - app.op_t0).astype(jnp.float32) / NS, good)
         app = dataclasses.replace(
             app,
-            op=jnp.where(en, OP_NONE, app.op),
-            op_to=jnp.where(en, T_INF, app.op_to))
+            op_votes=votes,
+            op_acks=n_acks,
+            op=jnp.where(complete, OP_NONE, app.op),
+            op_to=jnp.where(complete, T_INF, app.op_to))
         return app
 
     @property

@@ -1,0 +1,144 @@
+"""TierDummy + MyApplication — tier filler and the tutorial app.
+
+Rebuilds of src/applications/tierdummy/ (a no-op tier pass-through used
+to fill unused tier slots) and src/applications/myapplication/ (the
+website tutorial's minimal app: a periodic timer that routes one test
+message — a pared-down KBRTestApp).
+
+`TierDummyApp` satisfies the tier-app interface (apps/base.py) with no
+state, no timers, and no messages — plug it into any overlay logic when
+no workload is wanted.  `MyApp` is the tutorial shape: one timer, one
+routed message to a random key, one delivery counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class _Empty:
+    zero: jnp.ndarray    # [N] placeholder (pytrees need one leaf)
+
+
+class TierDummyApp:
+    """No-op tier filler (src/applications/tierdummy, 61 LoC)."""
+
+    def stat_spec(self):
+        return dict(scalars=(), hists=(), counters=())
+
+    def init(self, n: int) -> _Empty:
+        return _Empty(zero=jnp.zeros((n,), I32))
+
+    def glob_init(self, rng):
+        return None
+
+    def post_step(self, ctx, state, glob, events):
+        return state, glob
+
+    def on_ready(self, app, en, now, rng):
+        return app
+
+    def on_stop(self, app, en):
+        return app
+
+    def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
+        return app
+
+    def next_event(self, app):
+        return jnp.full(app.zero.shape, T_INF, I64)
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        return app, base.LookupReq(
+            want=jnp.bool_(False),
+            key=jnp.zeros((keys_mod.DEFAULT_SPEC.lanes,), jnp.uint32),
+            tag=jnp.int32(0))
+
+    def on_lookup_done(self, app, done, ctx, ob, ev, now, node_idx):
+        return app
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        return app
+
+    @property
+    def hist_map(self):
+        return {}
+
+
+@dataclasses.dataclass(frozen=True)
+class MyAppParams:
+    interval: float = 60.0       # sendPeriod (tutorial)
+    msg_bytes: int = 100
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MyAppState:
+    t_send: jnp.ndarray   # [N] i64
+
+
+class MyApp(TierDummyApp):
+    """The tutorial application (src/applications/myapplication): send a
+    message to a random key every ``interval``; count deliveries."""
+
+    def __init__(self, params: MyAppParams = MyAppParams(),
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+        self.p = params
+        self.spec = spec
+
+    def stat_spec(self):
+        return dict(scalars=(), hists=(),
+                    counters=("myapp_sent", "myapp_delivered"))
+
+    def init(self, n: int) -> MyAppState:
+        return MyAppState(t_send=jnp.full((n,), T_INF, I64))
+
+    def on_ready(self, app, en, now, rng):
+        off = (jax.random.uniform(rng, ()) * self.p.interval * NS
+               ).astype(I64)
+        return dataclasses.replace(
+            app, t_send=jnp.where(en, now + off, app.t_send))
+
+    def on_stop(self, app, en):
+        return dataclasses.replace(
+            app, t_send=jnp.where(en, T_INF, app.t_send))
+
+    def next_event(self, app):
+        return app.t_send
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        fire = en & (app.t_send < ctx.t_end)
+        key = keys_mod.random_keys(rng, (), self.spec)
+        ev.count("myapp_sent", fire & ctx.measuring)
+        app = dataclasses.replace(app, t_send=jnp.where(
+            fire, now + jnp.int64(int(self.p.interval * NS)), app.t_send))
+        return app, base.LookupReq(want=fire, key=key, tag=jnp.int32(0))
+
+    def on_lookup_done(self, app, done, ctx, ob, ev, now, node_idx):
+        suc = done.en & done.success & (done.results[0] != NO_NODE)
+        ob.send(suc & (done.results[0] != node_idx), now, done.results[0],
+                wire.APP_ONEWAY, key=done.target, hops=done.hops + 1,
+                c=ctx.measuring.astype(I32), stamp=done.t0,
+                size_b=self.p.msg_bytes)
+        ev.count("myapp_delivered",
+                 suc & (done.results[0] == node_idx) & ctx.measuring)
+        return app
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        en = m.valid & (m.kind == wire.APP_ONEWAY) & (m.c != 0) & is_sib
+        ev.count("myapp_delivered", en)
+        return app

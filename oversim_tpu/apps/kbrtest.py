@@ -1,17 +1,31 @@
 """KBRTestApp — the reference's benchmark workload, vectorized.
 
-Rebuild of src/applications/kbrtestapp/KBRTestApp.{h,cc}: each node
-periodically (testMsgInterval=60s, default.ini:38) routes a one-way test
-message to a key drawn from a random live node's nodeId
-(lookupNodeIds=true, default.ini:40; KBRTestApp::createDestKey).  The
-receiving node checks it is actually responsible for the key and records
-delivery, hop count and latency; wrong-node deliveries count as failures
-(KBRTestApp.cc:252-292).  Delivery ratio = delivered/sent is THE headline
-KPI (GlobalStatistics sentKBRTestAppMessages/deliveredKBRTestAppMessages,
-GlobalStatistics.h:79-80).
+Rebuild of src/applications/kbrtestapp/KBRTestApp.{h,cc}: three periodic
+tests (KBRTestApp.cc:131-216), each drawing its destination key from a
+random live node's nodeId (lookupNodeIds=true, default.ini:40;
+KBRTestApp::createDestKey):
 
-Implements the tier-app interface of apps/base.py; the RPC and lookup
-test modes (kbrRpcTest/kbrLookupTest, off by default) are TODO.
+  * **one-way test** (testMsgInterval=60s, default.ini:38): route a test
+    payload to the key; the receiver checks it is actually responsible
+    and records delivery, hop count and latency; wrong-node deliveries
+    count as failures (KBRTestApp.cc:252-292).  Delivery ratio =
+    delivered/sent is THE headline KPI (GlobalStatistics
+    sentKBRTestAppMessages/deliveredKBRTestAppMessages,
+    GlobalStatistics.h:79-80);
+  * **routed-RPC test** (kbrRpcTest): KbrTestCall routed to the key, the
+    responsible node responds directly; success ratio + RTT recorded
+    (handleRpcResponse KBRTestApp.cc:237-292).  An unanswered call is
+    failed when the next RPC fires (single outstanding call per node);
+  * **lookup test** (kbrLookupTest): resolve the key to its sibling set
+    and validate against the global oracle — since the key IS a live
+    node's nodeId, the lookup succeeds iff the first returned sibling is
+    that (still-alive) node (handleLookupResponse KBRTestApp.cc:331+,
+    lookupNodeIds oracle check).
+
+Engine mapping (documented deviation): the reference runs three
+independent timers with the same interval; here one timer round-robins
+the enabled modes at interval/len(modes), preserving each mode's rate
+while keeping the one-lookup-per-timer app interface (apps/base.py).
 """
 
 from __future__ import annotations
@@ -30,19 +44,41 @@ NS = 1_000_000_000
 T_INF = jnp.int64(2**62)
 NO_NODE = jnp.int32(-1)
 
+# test modes (tag low bits)
+M_ONEWAY, M_RPC, M_LOOKUP = 0, 1, 2
+
 
 @dataclasses.dataclass(frozen=True)
 class KbrTestParams:
     test_interval: float = 60.0     # testMsgInterval, default.ini:38
     test_msg_bytes: int = 100       # testMsgSize, default.ini:37
     hop_hist_bins: int = 16
+    oneway_test: bool = True        # kbrOneWayTest
+    rpc_test: bool = False          # kbrRpcTest
+    lookup_test: bool = False       # kbrLookupTest
+    rpc_timeout: float = 10.0       # rpcKeyTimeout, default.ini:485
+
+    @property
+    def modes(self) -> tuple:
+        out = []
+        if self.oneway_test:
+            out.append(M_ONEWAY)
+        if self.rpc_test:
+            out.append(M_RPC)
+        if self.lookup_test:
+            out.append(M_LOOKUP)
+        return tuple(out) or (M_ONEWAY,)
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KbrTestState:
-    t_test: jnp.ndarray   # [N] i64 — next one-way test
+    t_test: jnp.ndarray   # [N] i64 — next test fire
     seq: jnp.ndarray      # [N] i32 — sequence number
+    rpc_dst: jnp.ndarray  # [N] i32 — outstanding routed-RPC responder
+    rpc_to: jnp.ndarray   # [N] i64 — its timeout
+    rpc_t0: jnp.ndarray   # [N] i64 — its start (RTT base)
+    rpc_nonce: jnp.ndarray  # [N] i32 — call nonce (stale-response guard)
 
 
 class KbrTestApp:
@@ -53,14 +89,22 @@ class KbrTestApp:
 
     def stat_spec(self):
         return dict(
-            scalars=("kbr_hopcount", "kbr_latency_s"),
+            scalars=("kbr_hopcount", "kbr_latency_s", "kbr_rpc_rtt_s",
+                     "kbr_lookup_latency_s"),
             hists=(("kbr_hop_hist", self.p.hop_hist_bins),),
             counters=("kbr_sent", "kbr_delivered", "kbr_wrong_node",
-                      "kbr_lookup_failed"))
+                      "kbr_lookup_failed", "kbr_rpc_sent",
+                      "kbr_rpc_success", "kbr_rpc_failed",
+                      "kbr_lookups_sent", "kbr_lookup_success",
+                      "kbr_lookup_wrong"))
 
     def init(self, n: int) -> KbrTestState:
         return KbrTestState(t_test=jnp.full((n,), T_INF, I64),
-                            seq=jnp.zeros((n,), I32))
+                            seq=jnp.zeros((n,), I32),
+                            rpc_dst=jnp.full((n,), NO_NODE, I32),
+                            rpc_to=jnp.full((n,), T_INF, I64),
+                            rpc_t0=jnp.zeros((n,), I64),
+                            rpc_nonce=jnp.full((n,), -1, I32))
 
     def glob_init(self, rng):
         return None
@@ -78,49 +122,105 @@ class KbrTestApp:
                                    t_test=jnp.where(en, t, app.t_test))
 
     def on_stop(self, app, en):
-        return dataclasses.replace(app,
-                                   t_test=jnp.where(en, T_INF, app.t_test))
+        return dataclasses.replace(
+            app,
+            t_test=jnp.where(en, T_INF, app.t_test),
+            rpc_dst=jnp.where(en, NO_NODE, app.rpc_dst),
+            rpc_to=jnp.where(en, T_INF, app.rpc_to))
 
     def next_event(self, app):
-        return app.t_test
+        return jnp.minimum(app.t_test, app.rpc_to)
 
-    def on_timer(self, app, en, ctx, now, rng, ev):
-        """Fire the periodic one-way test: request a route to a key drawn
-        from a random live node (createDestKey, lookupNodeIds=true)."""
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        """Fire the periodic test; round-robin the enabled modes."""
+        modes = self.p.modes
+        # outstanding routed RPC timed out → failed (KBRTestApp counts
+        # RPC timeouts as failures, handleRpcTimeout)
+        rpc_dead = en & (app.rpc_to < ctx.t_end)
+        ev.count("kbr_rpc_failed", rpc_dead)
+        app = dataclasses.replace(
+            app,
+            rpc_dst=jnp.where(rpc_dead, NO_NODE, app.rpc_dst),
+            rpc_to=jnp.where(rpc_dead, T_INF, app.rpc_to))
+
         en = en & (app.t_test < ctx.t_end)
+        mode_idx = app.seq % len(modes)
+        mode = jnp.asarray(modes, I32)[mode_idx]
         dest = ctx.sample_ready(rng)
         dest_key = ctx.keys[jnp.maximum(dest, 0)]
         want = en & (dest != NO_NODE)
-        ev.count("kbr_sent", want)
+        ev.count("kbr_sent", want & (mode == M_ONEWAY))
+        ev.count("kbr_rpc_sent", want & (mode == M_RPC))
+        ev.count("kbr_lookups_sent", want & (mode == M_LOOKUP))
+        interval_ns = jnp.int64(
+            int(self.p.test_interval / len(modes) * NS))
         app2 = dataclasses.replace(
             app,
-            t_test=jnp.where(en, now + jnp.int64(
-                int(self.p.test_interval * NS)), app.t_test),
+            t_test=jnp.where(en, now + interval_ns, app.t_test),
             seq=app.seq + en.astype(I32))
-        return app2, base.LookupReq(want=want, key=dest_key, tag=app.seq)
+        return app2, base.LookupReq(want=want, key=dest_key,
+                                    tag=app.seq * 4 + mode)
 
     def on_lookup_done(self, app, done: base.LookupDone, ctx, ob, ev, now,
                        node_idx):
         en = done.en
+        mode = done.tag % 4
         suc = done.success & (done.results[0] != NO_NODE)
-        ev.count("kbr_lookup_failed", en & ~suc)
         res = done.results[0]
-        # final hop: payload to the sibling (sendToKey final direct hop).
-        # hops on the wire = total overlay hops including this one, so
-        # iterative (lookup hops + final hop) and recursive (per-hop
+
+        # ---- one-way: final payload hop to the sibling -----------------
+        en_1 = en & (mode == M_ONEWAY)
+        ev.count("kbr_lookup_failed", en_1 & ~suc)
+        # hops on the wire = total overlay hops including this final one,
+        # so iterative (lookup hops + final hop) and recursive (per-hop
         # increments) deliveries record identically.
-        ob.send(en & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
+        ob.send(en_1 & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
                 key=done.target, hops=done.hops + 1,
                 c=ctx.measuring.astype(I32), stamp=done.t0,
                 size_b=self.p.test_msg_bytes)
         # lookup ended on ourselves → local delivery
-        self_del = en & suc & (res == node_idx)
+        self_del = en_1 & suc & (res == node_idx)
         ev.count("kbr_delivered", self_del & ctx.measuring)
-        ev.value("kbr_hopcount", done.hops,
-                 self_del & ctx.measuring)
+        ev.value("kbr_hopcount", done.hops, self_del & ctx.measuring)
         ev.value("kbr_latency_s",
                  (now - done.t0).astype(jnp.float32) / NS,
                  self_del & ctx.measuring)
+
+        # ---- routed RPC: KbrTestCall to the responsible node -----------
+        en_r = en & (mode == M_RPC)
+        ev.count("kbr_rpc_failed", en_r & ~suc)
+        fire_r = en_r & suc & (res != node_idx)
+        ob.send(fire_r, now, res, wire.APP_RPC_CALL, key=done.target,
+                a=done.tag, stamp=done.t0, size_b=self.p.test_msg_bytes)
+        # resolved to ourselves → trivially successful zero-RTT call
+        self_r = en_r & suc & (res == node_idx)
+        ev.count("kbr_rpc_success", self_r & ctx.measuring)
+        app = dataclasses.replace(
+            app,
+            rpc_dst=jnp.where(fire_r, res, app.rpc_dst),
+            rpc_to=jnp.where(fire_r, now + jnp.int64(
+                int(self.p.rpc_timeout * NS)), app.rpc_to),
+            rpc_t0=jnp.where(fire_r, done.t0, app.rpc_t0),
+            rpc_nonce=jnp.where(fire_r, done.tag, app.rpc_nonce))
+
+        # ---- lookup test: oracle validation ----------------------------
+        # the target IS a live node's key, so the first sibling must be
+        # exactly that node (KBRTestApp lookupNodeIds oracle check)
+        en_l = en & (mode == M_LOOKUP)
+        resk = ctx.keys[jnp.maximum(res, 0)]
+        target_alive = ctx.alive[jnp.maximum(res, 0)]
+        right = suc & jnp.all(resk == done.target) & target_alive
+        ev.count("kbr_lookup_success", en_l & right & ctx.measuring)
+        ev.count("kbr_lookup_wrong", en_l & suc & ~right & ctx.measuring)
+        ev.count("kbr_lookup_failed", en_l & ~suc)
+        ev.value("kbr_lookup_latency_s",
+                 (now - done.t0).astype(jnp.float32) / NS,
+                 en_l & right & ctx.measuring)
+        return app
+
+    def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
+        """No state to hand over; leaving nodes just stop testing (the
+        engine stops firing app timers during the grace window)."""
         return app
 
     def on_msg(self, app, m, ctx, ob, ev, is_sib):
@@ -133,6 +233,25 @@ class KbrTestApp:
         ev.value("kbr_hopcount", m.hops, good)
         ev.value("kbr_latency_s",
                  (m.t_deliver - m.stamp).astype(jnp.float32) / NS, good)
+
+        # routed-RPC server: reply directly (KbrTestCall → Response)
+        en = m.valid & (m.kind == wire.APP_RPC_CALL)
+        ob.send(en, m.t_deliver, m.src, wire.APP_RPC_RES, key=m.key,
+                a=m.a, stamp=m.stamp, size_b=wire.BASE_CALL_B)
+
+        # routed-RPC client: RTT + success.  The echoed nonce (a) rejects
+        # a straggler response from a previously timed-out call to the
+        # same responder (BaseRpc nonce matching, BaseRpc.cc:293)
+        en = m.valid & (m.kind == wire.APP_RPC_RES) & (
+            m.src == app.rpc_dst) & (m.a == app.rpc_nonce)
+        ev.count("kbr_rpc_success", en & ctx.measuring)
+        ev.value("kbr_rpc_rtt_s",
+                 (m.t_deliver - m.stamp).astype(jnp.float32) / NS,
+                 en & ctx.measuring)
+        app = dataclasses.replace(
+            app,
+            rpc_dst=jnp.where(en, NO_NODE, app.rpc_dst),
+            rpc_to=jnp.where(en, T_INF, app.rpc_to))
         return app
 
     @property

@@ -1,0 +1,92 @@
+"""Movement generators for game workloads, vectorized.
+
+Rebuild of the reference SimpleGameClient movement family
+(src/applications/simplegameclient/MovementGenerator.{h,cc} +
+RandomRoaming.cc, HotspotRoaming.cc, TraverseRoaming.cc,
+GreatGathering.cc; selected by ``movementGenerator``, default.ini game
+client namespace).  Every generator advances [N, 2] positions by
+``speed``·dt toward a per-node waypoint and redraws the waypoint when
+reached:
+
+  * randomRoaming — uniform waypoints in the field;
+  * hotspotRoaming — waypoints biased into a hotspot disc (nodes flock);
+  * traverseRoaming — waypoints on the field corners (long crossings);
+  * greatGathering — everyone converges on the field center.
+
+Used by the game overlays (Vast/Quon/NTree/PubSubMMOG) and SimMud: the
+same positions feed AOI neighborhoods / region subscriptions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+F32 = jnp.float32
+
+GEN_RANDOM, GEN_HOTSPOT, GEN_TRAVERSE, GEN_GATHER = 0, 1, 2, 3
+
+GENERATORS = {
+    "randomRoaming": GEN_RANDOM,
+    "hotspotRoaming": GEN_HOTSPOT,
+    "traverseRoaming": GEN_TRAVERSE,
+    "greatGathering": GEN_GATHER,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MoveParams:
+    generator: str = "randomRoaming"
+    field: float = 1000.0         # areaDimension
+    speed: float = 5.0            # movementSpeed (units/s)
+    hotspot_radius: float = 100.0
+
+
+def init_positions(rng, n: int, p: MoveParams):
+    """(pos [N,2], waypoint [N,2]) uniform in the field."""
+    r1, r2 = jax.random.split(rng)
+    pos = jax.random.uniform(r1, (n, 2), F32, 0.0, p.field)
+    return pos, draw_waypoints(r2, pos, p)
+
+
+def draw_waypoints(rng, pos, p: MoveParams):
+    """Per-generator waypoint draw (shape-agnostic: works on a [N, 2]
+    batch or a single [2] position inside a vmapped handler)."""
+    batch = pos.shape[:-1]
+    g = GENERATORS[p.generator]
+    if g == GEN_RANDOM:
+        return jax.random.uniform(rng, pos.shape, F32, 0.0, p.field)
+    if g == GEN_HOTSPOT:
+        # a fixed hotspot at 1/4-field; waypoints inside its disc
+        r1, r2 = jax.random.split(rng)
+        center = jnp.asarray([p.field / 4, p.field / 4], F32)
+        ang = jax.random.uniform(r1, batch, F32, 0.0, 2 * jnp.pi)
+        rad = jnp.sqrt(jax.random.uniform(r2, batch, F32)) \
+            * p.hotspot_radius
+        return center + jnp.stack(
+            [rad * jnp.cos(ang), rad * jnp.sin(ang)], axis=-1)
+    if g == GEN_TRAVERSE:
+        corner = jax.random.randint(rng, batch, 0, 4)
+        cx = jnp.where((corner == 1) | (corner == 3), p.field, 0.0)
+        cy = jnp.where(corner >= 2, p.field, 0.0)
+        return jnp.stack([cx, cy], axis=-1).astype(F32)
+    if g == GEN_GATHER:
+        return jnp.broadcast_to(
+            jnp.asarray([p.field / 2, p.field / 2], F32), pos.shape)
+    raise ValueError(p.generator)
+
+
+def step(pos, wp, dt_s, rng, p: MoveParams):
+    """Advance toward the waypoint; redraw reached waypoints.
+
+    All-[N] form (callers slice per node if needed)."""
+    d = wp - pos
+    dist = jnp.sqrt(jnp.sum(d * d, axis=-1, keepdims=True))
+    stepv = p.speed * dt_s
+    reach = dist[..., 0] <= stepv
+    unit = d / jnp.maximum(dist, 1e-6)
+    new_pos = jnp.where(reach[..., None], wp, pos + unit * stepv)
+    new_wp = jnp.where(reach[..., None], draw_waypoints(rng, pos, p), wp)
+    return new_pos, new_wp

@@ -1,0 +1,317 @@
+"""NTree — server-less quadtree game overlay, vectorized.
+
+Rebuild of the reference NTree (src/overlay/ntree/NTree.{h,cc}: the game
+world is a quadtree of groups; a group divides when its membership
+exceeds maxChildren and collapses when it shrinks (handleDivideCall,
+NTree.h:124-137); game events route to the responsible tree nodes which
+disseminate to the region's members).
+
+Engine mapping (documented): the reference's self-organized tree-node
+ownership is replaced by **rendezvous hashing over the KBR overlay
+underneath** — the leader of quadtree cell c is the node responsible
+for hash(c) (the engine's generic responsibility oracle), so NTree runs
+as a tier app on any KBR logic.  The quadtree DYNAMICS are preserved:
+
+  * every player registers with the leader of its current cell at its
+    current depth, refreshing periodically (soft state);
+  * a leader whose cell exceeds ``max_children`` members answers with
+    DIVIDE — members descend one level (deeper cell, new leader), the
+    reference's group division;
+  * a leader seeing ≤ ``collapse_below`` members at depth > 0 answers
+    COLLAPSE — members ascend one level (group collapse);
+  * game events go to the cell leader, which fans them out to the
+    registered members (event dissemination through the tree level).
+
+Stats: registrations, divides, collapses, events sent/delivered — the
+reference's group-size/latency KPIs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.apps import movement as move_mod
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+# wire kinds (NTree family: 120+)
+NT_JOIN = 120       # register at cell leader: a=cell id, b=depth
+NT_JOIN_ACK = 121   # b=1 → DIVIDE (descend), b=2 → COLLAPSE (ascend)
+NT_EVENT = 122      # game event to leader: a=cell id, stamp=t0
+NT_EVENT_FWD = 123  # leader → member fan-out
+
+M_REG, M_EVENT = 0, 1
+
+
+@dataclasses.dataclass(frozen=True)
+class NTreeParams:
+    max_depth: int = 3            # static quadtree depth bound
+    max_children: int = 5         # divide threshold (maxChildren)
+    collapse_below: int = 2       # collapse threshold
+    member_slots: int = 8         # per-led-cell member table
+    led_cells: int = 4            # cells one node can lead
+    move_interval: float = 5.0
+    refresh: float = 10.0         # registration refresh
+    event_interval: float = 10.0
+    move: move_mod.MoveParams = move_mod.MoveParams(field=1000.0, speed=20.0)
+
+    @property
+    def num_cells(self) -> int:
+        # 1 + 4 + 16 + ... = (4^(L+1) - 1) / 3
+        return (4 ** (self.max_depth + 1) - 1) // 3
+
+
+def cell_of(pos, depth: int, p: NTreeParams):
+    """Quadtree cell id for a position at static ``depth`` (row-major per
+    level, levels packed: offset(l) = (4^l - 1)/3)."""
+    side = 1 << depth                         # cells per axis = 2^depth
+    cw = p.move.field / side
+    cx = jnp.clip((pos[..., 0] / cw).astype(I32), 0, side - 1)
+    cy = jnp.clip((pos[..., 1] / cw).astype(I32), 0, side - 1)
+    return ((4 ** depth) - 1) // 3 + cx * side + cy
+
+
+def cell_of_dyn(pos, depth, p: NTreeParams):
+    """Traced-depth variant (depth is an i32 array)."""
+    side = (jnp.int32(1) << depth).astype(I32)
+    cw = p.move.field / side.astype(jnp.float32)
+    cx = jnp.clip((pos[..., 0] / cw).astype(I32), 0, side - 1)
+    cy = jnp.clip((pos[..., 1] / cw).astype(I32), 0, side - 1)
+    offset = (((jnp.int32(1) << (2 * depth)) - 1) // 3).astype(I32)
+    return offset + cx * side + cy
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NTreeState:
+    pos: jnp.ndarray       # [N, 2]
+    wp: jnp.ndarray        # [N, 2]
+    depth: jnp.ndarray     # [N] i32 current subscription depth
+    cell: jnp.ndarray      # [N] i32 registered cell (-1 none)
+    # leader-side: led cells + their member tables
+    led_cell: jnp.ndarray  # [N, C] i32 cell id (-1 free)
+    led_mem: jnp.ndarray   # [N, C, M] i32
+    led_seen: jnp.ndarray  # [N, C, M] i64
+    t_move: jnp.ndarray    # [N] i64
+    t_reg: jnp.ndarray     # [N] i64
+    t_evt: jnp.ndarray     # [N] i64
+    seq: jnp.ndarray       # [N] i32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NTreeGlobal:
+    cell_keys: jnp.ndarray   # [num_cells, KL] u32 rendezvous keys
+
+
+class NTreeApp:
+    """Tier app (interface: apps/base.py docstring)."""
+
+    def __init__(self, params: NTreeParams = NTreeParams(),
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+        self.p = params
+        self.spec = spec
+
+    def stat_spec(self):
+        return dict(
+            scalars=("ntree_event_latency_s", "ntree_group_size"),
+            hists=(),
+            counters=("ntree_registers", "ntree_divides",
+                      "ntree_collapses", "ntree_events",
+                      "ntree_event_delivered", "ntree_lookup_failed"))
+
+    def init(self, n: int) -> NTreeState:
+        p = self.p
+        pos, wp = move_mod.init_positions(jax.random.PRNGKey(131), n,
+                                          p.move)
+        return NTreeState(
+            pos=pos, wp=wp,
+            depth=jnp.zeros((n,), I32),
+            cell=jnp.full((n,), -1, I32),
+            led_cell=jnp.full((n, p.led_cells), -1, I32),
+            led_mem=jnp.full((n, p.led_cells, p.member_slots), NO_NODE,
+                             I32),
+            led_seen=jnp.zeros((n, p.led_cells, p.member_slots), I64),
+            t_move=jnp.full((n,), T_INF, I64),
+            t_reg=jnp.full((n,), T_INF, I64),
+            t_evt=jnp.full((n,), T_INF, I64),
+            seq=jnp.zeros((n,), I32))
+
+    def glob_init(self, rng) -> NTreeGlobal:
+        return NTreeGlobal(cell_keys=keys_mod.random_keys(
+            rng, (self.p.num_cells,), self.spec))
+
+    def post_step(self, ctx, state, glob, events):
+        return state, glob
+
+    def on_ready(self, app, en, now, rng):
+        off = (jax.random.uniform(rng, ())
+               * self.p.event_interval * NS).astype(I64)
+        return dataclasses.replace(
+            app,
+            t_move=jnp.where(en, now + jnp.int64(
+                int(self.p.move_interval * NS)), app.t_move),
+            t_reg=jnp.where(en, now, app.t_reg),
+            t_evt=jnp.where(en, now + off, app.t_evt))
+
+    def on_stop(self, app, en):
+        return dataclasses.replace(
+            app,
+            t_move=jnp.where(en, T_INF, app.t_move),
+            t_reg=jnp.where(en, T_INF, app.t_reg),
+            t_evt=jnp.where(en, T_INF, app.t_evt))
+
+    def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
+        return app    # tree state is soft (refresh-rebuilt)
+
+    def next_event(self, app):
+        return jnp.minimum(app.t_move,
+                           jnp.minimum(app.t_reg, app.t_evt))
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        p = self.p
+        glob: NTreeGlobal = ctx.glob
+
+        # movement
+        mv = en & (app.t_move < ctx.t_end)
+        r_mv, _ = jax.random.split(rng)
+        npos, nwp = move_mod.step(app.pos, app.wp,
+                                  jnp.float32(p.move_interval), r_mv,
+                                  p.move)
+        app = dataclasses.replace(
+            app,
+            pos=jnp.where(mv, npos, app.pos),
+            wp=jnp.where(mv, nwp, app.wp),
+            t_move=jnp.where(mv, now + jnp.int64(
+                int(p.move_interval * NS)), app.t_move))
+
+        # registration refresh / event — one lookup per fire
+        reg_hit = en & (app.t_reg < ctx.t_end)
+        evt_hit = en & (app.t_evt < ctx.t_end)
+        reg_due = reg_hit
+        evt_due = evt_hit & ~reg_due
+        my_cell = cell_of_dyn(app.pos, app.depth, p)
+        tgt_cell = jnp.clip(my_cell, 0, p.num_cells - 1)
+        key = glob.cell_keys[tgt_cell]
+        ev.count("ntree_registers", reg_due)
+        ev.count("ntree_events", evt_due & ctx.measuring)
+        app = dataclasses.replace(
+            app,
+            t_reg=jnp.where(reg_hit, now + jnp.int64(
+                int(p.refresh * NS)), app.t_reg),
+            t_evt=jnp.where(evt_hit, now + jnp.int64(
+                int(p.event_interval * NS)), app.t_evt),
+            seq=app.seq + (reg_due | evt_due).astype(I32))
+        mode = jnp.where(reg_due, M_REG, M_EVENT)
+        return app, base.LookupReq(want=reg_due | evt_due, key=key,
+                                   tag=tgt_cell * 4 + mode)
+
+    def on_lookup_done(self, app, done, ctx, ob, ev, now, node_idx):
+        p = self.p
+        en = done.en
+        mode = done.tag % 4
+        cell = done.tag // 4
+        suc = done.success & (done.results[0] != NO_NODE)
+        ev.count("ntree_lookup_failed", en & ~suc)
+        leader = done.results[0]
+        ob.send(en & suc & (mode == M_REG), now, leader, NT_JOIN,
+                a=cell, b=app.depth, size_b=24)
+        ob.send(en & suc & (mode == M_EVENT), now, leader, NT_EVENT,
+                a=cell, stamp=now, size_b=64)
+        return app
+
+    def _led_row(self, app, cell):
+        """(row index for this cell, have_row) in the led-cell table."""
+        hit = app.led_cell == cell
+        have = jnp.any(hit)
+        free = app.led_cell < 0
+        row = jnp.where(have, jnp.argmax(hit),
+                        jnp.argmax(free)).astype(I32)
+        return row, have | jnp.any(free)
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        p = self.p
+        now = m.t_deliver
+
+        # member registration at the leader (NTree join/divide logic)
+        en = m.valid & (m.kind == NT_JOIN)
+        row, ok = self._led_row(app, m.a)
+        row_ok = en & ok
+        mem = app.led_mem[row]
+        seen = app.led_seen[row]
+        # refresh or insert member (LRU slot on overflow)
+        mh = mem == m.src
+        col = jnp.where(jnp.any(mh), jnp.argmax(mh),
+                        jnp.argmin(seen)).astype(I32)
+        wrow = jnp.where(row_ok, row, p.led_cells)
+        app = dataclasses.replace(
+            app,
+            led_cell=app.led_cell.at[wrow].set(m.a, mode="drop"),
+            led_mem=app.led_mem.at[wrow, col].set(m.src, mode="drop"),
+            led_seen=app.led_seen.at[wrow, col].set(now, mode="drop"))
+        # census after insert (count fresh members)
+        mem2 = app.led_mem[jnp.clip(row, 0, p.led_cells - 1)]
+        seen2 = app.led_seen[jnp.clip(row, 0, p.led_cells - 1)]
+        fresh = (mem2 != NO_NODE) & (
+            seen2 + jnp.int64(int(3 * p.refresh * NS)) > now)
+        n_mem = jnp.sum(fresh.astype(I32))
+        ev.value("ntree_group_size", n_mem.astype(jnp.float32),
+                 row_ok & ctx.measuring)
+        # divide when too big and not at max depth; collapse when
+        # too small and below the root
+        divide = row_ok & (n_mem > p.max_children) & (
+            m.b < p.max_depth)
+        collapse = row_ok & ~divide & (n_mem <= p.collapse_below) & (
+            m.b > 0)
+        ev.count("ntree_divides", divide)
+        ev.count("ntree_collapses", collapse)
+        code = jnp.where(divide, 1, jnp.where(collapse, 2, 0))
+        ob.send(row_ok, now, m.src, NT_JOIN_ACK, a=m.a, b=code,
+                size_b=16)
+
+        # registration answer at the member
+        en = m.valid & (m.kind == NT_JOIN_ACK)
+        descend = en & (m.b == 1)
+        ascend = en & (m.b == 2)
+        app = dataclasses.replace(
+            app,
+            cell=jnp.where(en, m.a, app.cell),
+            depth=jnp.clip(app.depth + descend.astype(I32)
+                           - ascend.astype(I32), 0, p.max_depth),
+            # re-register right away after a depth change
+            t_reg=jnp.where(descend | ascend, now, app.t_reg))
+
+        # event at the leader → fan out to the cell's members
+        en = m.valid & (m.kind == NT_EVENT)
+        row, ok = self._led_row(app, m.a)
+        row = jnp.clip(row, 0, p.led_cells - 1)
+        mem = app.led_mem[row]
+        seen = app.led_seen[row]
+        fresh = (mem != NO_NODE) & (
+            seen + jnp.int64(int(3 * p.refresh * NS)) > now)
+        for j in range(p.member_slots):
+            tgt = mem[j]
+            ob.send(en & ok & fresh[j] & (tgt != m.src), now,
+                    jnp.maximum(tgt, 0), NT_EVENT_FWD, a=m.a,
+                    stamp=m.stamp, size_b=64)
+
+        # event delivery at members
+        en = m.valid & (m.kind == NT_EVENT_FWD)
+        ev.count("ntree_event_delivered", en & ctx.measuring)
+        ev.value("ntree_event_latency_s",
+                 (now - m.stamp).astype(jnp.float32) / NS,
+                 en & ctx.measuring)
+        return app
+
+    @property
+    def hist_map(self):
+        return {}

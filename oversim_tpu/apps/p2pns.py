@@ -1,0 +1,325 @@
+"""P2PNS — P2P name service (register/resolve with cache), vectorized.
+
+TPU-native rebuild of the reference P2PNS (src/tier2/p2pns/P2pns.{h,cc}:
+a SIP/DNS-style name service over KBR — register name→id bindings at the
+responsible node, resolve with a local id cache and keepalive refresh,
+P2pns.h:45-99; used by XML-RPC clients in SingleHost mode).
+
+Engine mapping (apps/base.py tier-app interface over any KBR overlay):
+
+  * every node owns one name (its slot's entry in the global name table,
+    ``glob.name_keys`` — the oracle equivalent of registering a
+    user-chosen name);
+  * **register**: on READY and every ``keepalive`` seconds, resolve the
+    name's key and store the binding (name id → own slot) at the
+    responsible node (P2pns::registerId; the reference stores via the
+    tier-1 DHT with a TTL — here a direct record at the sibling with
+    ``record_ttl``);
+  * **resolve**: every ``resolve_interval``, pick a random live node and
+    resolve its name: local cache first (P2pns twoStageResolution local
+    cache), else lookup + P2pnsResolveCall to the responsible node;
+    success = the returned value matches the oracle owner; successful
+    resolutions fill the cache with ``cache_ttl``.
+
+Stats: registers, resolves, cache hits, success/failure — the
+reference's resolution-delay/success KPIs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+NO_VAL = jnp.int32(-1)
+
+M_REG, M_RESOLVE = 0, 1
+OP_NONE, OP_REG, OP_RESOLVE = 0, 1, 2
+
+
+@dataclasses.dataclass(frozen=True)
+class P2pnsParams:
+    keepalive: float = 120.0      # re-register interval
+    resolve_interval: float = 30.0
+    record_ttl: float = 300.0     # stored binding TTL
+    cache_ttl: float = 60.0       # resolved-binding cache TTL
+    cache_size: int = 8
+    storage_slots: int = 16
+    op_timeout: float = 10.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class P2pnsState:
+    # stored bindings at the responsible node
+    r_name: jnp.ndarray    # [N, D] i32 name id (-1 empty)
+    r_val: jnp.ndarray     # [N, D] i32
+    r_expire: jnp.ndarray  # [N, D] i64
+    # local resolution cache
+    c_name: jnp.ndarray    # [N, C] i32
+    c_val: jnp.ndarray     # [N, C] i32
+    c_expire: jnp.ndarray  # [N, C] i64
+    # timers + one outstanding op
+    t_reg: jnp.ndarray     # [N] i64
+    t_res: jnp.ndarray     # [N] i64
+    op: jnp.ndarray        # [N] i32
+    op_seq: jnp.ndarray    # [N] i32
+    op_name: jnp.ndarray   # [N] i32 — name id being registered/resolved
+    op_expect: jnp.ndarray  # [N] i32 — oracle owner for pending resolve
+    op_to: jnp.ndarray     # [N] i64
+    op_t0: jnp.ndarray     # [N] i64
+    seq: jnp.ndarray       # [N] i32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class P2pnsGlobal:
+    name_keys: jnp.ndarray   # [N, KL] u32 — slot i owns name i
+
+
+class P2pnsApp:
+    """Tier-2 app (interface: apps/base.py docstring)."""
+
+    def __init__(self, params: P2pnsParams = P2pnsParams(),
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC,
+                 num_slots: int = 0):
+        if num_slots <= 0:
+            raise ValueError("P2pnsApp needs num_slots (= engine slots) "
+                             "for the global name table")
+        self.p = params
+        self.spec = spec
+        self.n = num_slots
+
+    def stat_spec(self):
+        return dict(
+            scalars=("p2pns_resolve_latency_s",),
+            hists=(),
+            counters=("p2pns_registers", "p2pns_resolves",
+                      "p2pns_cache_hits", "p2pns_resolve_success",
+                      "p2pns_resolve_failed", "p2pns_stored"))
+
+    def init(self, n: int) -> P2pnsState:
+        p = self.p
+        return P2pnsState(
+            r_name=jnp.full((n, p.storage_slots), -1, I32),
+            r_val=jnp.full((n, p.storage_slots), NO_VAL, I32),
+            r_expire=jnp.zeros((n, p.storage_slots), I64),
+            c_name=jnp.full((n, p.cache_size), -1, I32),
+            c_val=jnp.full((n, p.cache_size), NO_VAL, I32),
+            c_expire=jnp.zeros((n, p.cache_size), I64),
+            t_reg=jnp.full((n,), T_INF, I64),
+            t_res=jnp.full((n,), T_INF, I64),
+            op=jnp.zeros((n,), I32),
+            op_seq=jnp.zeros((n,), I32),
+            op_name=jnp.full((n,), -1, I32),
+            op_expect=jnp.full((n,), NO_VAL, I32),
+            op_to=jnp.full((n,), T_INF, I64),
+            op_t0=jnp.zeros((n,), I64),
+            seq=jnp.zeros((n,), I32))
+
+    def glob_init(self, rng) -> P2pnsGlobal:
+        # one name per slot (the oracle name table)
+        return P2pnsGlobal(name_keys=keys_mod.random_keys(
+            rng, (self.n,), self.spec))
+
+    def post_step(self, ctx, state, glob, events):
+        return state, glob
+
+    def on_ready(self, app, en, now, rng):
+        off = (jax.random.uniform(rng, ())
+               * self.p.resolve_interval * NS).astype(I64)
+        return dataclasses.replace(
+            app,
+            t_reg=jnp.where(en, now, app.t_reg),
+            t_res=jnp.where(en, now + off, app.t_res))
+
+    def on_stop(self, app, en):
+        return dataclasses.replace(
+            app,
+            t_reg=jnp.where(en, T_INF, app.t_reg),
+            t_res=jnp.where(en, T_INF, app.t_res),
+            op=jnp.where(en, OP_NONE, app.op),
+            op_to=jnp.where(en, T_INF, app.op_to))
+
+    def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
+        """Bindings are soft state with keepalive refresh; hand the
+        stored records to the successor like the DHT does."""
+        en = en & (handover != NO_NODE) & (handover != node_idx)
+        valid = app.r_name >= 0
+        has = en & jnp.any(valid)
+        col = jnp.argmax(valid).astype(I32)
+        ob.send(has, now, handover, wire.P2PNS_REG_CALL,
+                a=app.r_name[col], b=app.r_val[col],
+                stamp=app.r_expire[col], size_b=wire.BASE_CALL_B + 12)
+        ccol = jnp.where(has, col, app.r_name.shape[0])
+        return dataclasses.replace(
+            app, r_name=app.r_name.at[ccol].set(-1, mode="drop"))
+
+    def next_event(self, app):
+        t = jnp.minimum(app.t_reg, app.t_res)
+        return jnp.minimum(t, app.op_to)
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        p = self.p
+        glob: P2pnsGlobal = ctx.glob
+
+        to = en & (app.op != OP_NONE) & (app.op_to < ctx.t_end)
+        ev.count("p2pns_resolve_failed", to & (app.op == OP_RESOLVE))
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(to, OP_NONE, app.op),
+            op_to=jnp.where(to, T_INF, app.op_to))
+
+        idle = app.op == OP_NONE
+        # a due timer must ALWAYS advance, even when an op is in flight —
+        # otherwise the engine's event horizon pins simulated time on the
+        # stale timer and the tick loop spins (the action just waits for
+        # the next period)
+        reg_hit = en & (app.t_reg < ctx.t_end)
+        res_hit = en & (app.t_res < ctx.t_end)
+        reg_due = reg_hit & idle
+        res_due = res_hit & ~reg_due & idle
+
+        # resolve target: a random live node's name
+        tgt = ctx.sample_ready(rng)
+        tgt_ok = tgt != NO_NODE
+        # cache check (twoStageResolution stage 1)
+        chit_mask = (app.c_name == tgt) & (app.c_expire > now) & tgt_ok
+        chit = res_due & jnp.any(chit_mask)
+        cval = app.c_val[jnp.argmax(chit_mask)]
+        ev.count("p2pns_resolves", res_due & tgt_ok)
+        ev.count("p2pns_cache_hits", chit)
+        ev.count("p2pns_resolve_success",
+                 chit & (cval == tgt) & ctx.measuring)
+        ev.count("p2pns_registers", reg_due)
+
+        # own name slot index == our node slot; the engine passes no
+        # node_idx here, so we register via the lookup tag round-trip
+        fire_reg = reg_due
+        fire_res = res_due & tgt_ok & ~chit
+        name_id = jnp.where(fire_reg, node_idx, tgt)
+        lk_key = glob.name_keys[jnp.maximum(name_id, 0)]
+        app = dataclasses.replace(
+            app,
+            t_reg=jnp.where(reg_hit, now + jnp.int64(
+                int(p.keepalive * NS)), app.t_reg),
+            t_res=jnp.where(res_hit, now + jnp.int64(
+                int(p.resolve_interval * NS)), app.t_res),
+            op=jnp.where(fire_reg, OP_REG,
+                         jnp.where(fire_res, OP_RESOLVE, app.op)),
+            op_seq=jnp.where(fire_reg | fire_res, app.seq, app.op_seq),
+            op_name=jnp.where(fire_reg | fire_res, name_id, app.op_name),
+            op_expect=jnp.where(fire_res, tgt, app.op_expect),
+            op_to=jnp.where(fire_reg | fire_res, now + jnp.int64(
+                int(p.op_timeout * NS)), app.op_to),
+            op_t0=jnp.where(fire_reg | fire_res, now, app.op_t0),
+            seq=app.seq + (fire_reg | fire_res).astype(I32))
+        mode = jnp.where(fire_reg, M_REG, M_RESOLVE)
+        return app, base.LookupReq(
+            want=fire_reg | fire_res, key=lk_key,
+            tag=app.op_seq * 4 + mode)
+
+    def on_lookup_done(self, app, done: base.LookupDone, ctx, ob, ev, now,
+                       node_idx):
+        p = self.p
+        glob: P2pnsGlobal = ctx.glob
+        en = done.en & (app.op != OP_NONE) & (
+            (done.tag // 4) == app.op_seq)
+        suc = done.success & (done.results[0] != NO_NODE)
+        fail = en & ~suc
+        ev.count("p2pns_resolve_failed", fail & (app.op == OP_RESOLVE))
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(fail, OP_NONE, app.op),
+            op_to=jnp.where(fail, T_INF, app.op_to))
+
+        # register: store the binding at the responsible node
+        en_r = en & suc & (app.op == OP_REG)
+        ob.send(en_r, now, done.results[0], wire.P2PNS_REG_CALL,
+                a=node_idx, b=node_idx,
+                stamp=now + jnp.int64(int(p.record_ttl * NS)),
+                size_b=wire.BASE_CALL_B + 12)
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(en_r, OP_NONE, app.op),
+            op_to=jnp.where(en_r, T_INF, app.op_to))
+
+        # resolve: query the responsible node
+        en_v = en & suc & (app.op == OP_RESOLVE)
+        ob.send(en_v, now, done.results[0], wire.P2PNS_RES_CALL,
+                a=app.op_name, b=app.op_seq, size_b=wire.BASE_CALL_B + 8)
+        return app
+
+    def _cache_put(self, app, en, name, val, now):
+        match = (app.c_name == name) & (name >= 0)
+        have = jnp.any(match)
+        free_col = jnp.argmin(app.c_expire).astype(I32)   # oldest/empty
+        col = jnp.where(have, jnp.argmax(match), free_col).astype(I32)
+        col = jnp.where(en, col, app.c_name.shape[0])
+        return dataclasses.replace(
+            app,
+            c_name=app.c_name.at[col].set(name, mode="drop"),
+            c_val=app.c_val.at[col].set(val, mode="drop"),
+            c_expire=app.c_expire.at[col].set(
+                now + jnp.int64(int(self.p.cache_ttl * NS)), mode="drop"))
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        p = self.p
+        now = m.t_deliver
+
+        # RegisterCall → store binding (overwrite same name / free slot /
+        # evict earliest expiry)
+        en = m.valid & (m.kind == wire.P2PNS_REG_CALL)
+        same = (app.r_name == m.a) & (m.a >= 0)
+        have = jnp.any(same)
+        free = app.r_name < 0
+        col = jnp.where(have, jnp.argmax(same),
+                        jnp.where(jnp.any(free), jnp.argmax(free),
+                                  jnp.argmin(app.r_expire))).astype(I32)
+        col = jnp.where(en, col, app.r_name.shape[0])
+        app = dataclasses.replace(
+            app,
+            r_name=app.r_name.at[col].set(m.a, mode="drop"),
+            r_val=app.r_val.at[col].set(m.b, mode="drop"),
+            r_expire=app.r_expire.at[col].set(m.stamp, mode="drop"))
+        ev.count("p2pns_stored", en)
+        ob.send(en, now, m.src, wire.P2PNS_REG_RES, a=m.a,
+                size_b=wire.BASE_CALL_B)
+
+        # ResolveCall → storage probe
+        en = m.valid & (m.kind == wire.P2PNS_RES_CALL)
+        hit = (app.r_name == m.a) & (m.a >= 0) & (app.r_expire > now)
+        val = jnp.where(jnp.any(hit), app.r_val[jnp.argmax(hit)], NO_VAL)
+        ob.send(en, now, m.src, wire.P2PNS_RES_RES, a=m.a, b=m.b, c=val,
+                size_b=wire.BASE_CALL_B + 4)
+
+        # ResolveResponse → validate vs oracle + cache
+        en = (m.valid & (m.kind == wire.P2PNS_RES_RES)
+              & (app.op == OP_RESOLVE) & (m.b == app.op_seq))
+        good = en & (m.c == app.op_expect) & (m.c != NO_VAL)
+        ev.count("p2pns_resolve_success", good & ctx.measuring)
+        ev.count("p2pns_resolve_failed", en & ~good)
+        ev.value("p2pns_resolve_latency_s",
+                 (now - app.op_t0).astype(jnp.float32) / NS,
+                 good & ctx.measuring)
+        app = self._cache_put(app, good, m.a, m.c, now)
+        app = dataclasses.replace(
+            app,
+            op=jnp.where(en, OP_NONE, app.op),
+            op_to=jnp.where(en, T_INF, app.op_to))
+        return app
+
+    @property
+    def hist_map(self):
+        return {}

@@ -1,0 +1,275 @@
+"""Scribe application-level multicast + ALMTest workload, vectorized.
+
+TPU-native rebuild of the reference Scribe (src/applications/scribe/
+Scribe.{h,cc}: groupId rendezvous, reverse-path multicast tree with
+child tables and subscription refresh/timeouts, Scribe.h:57-152) with
+the ALMTest driver folded in (src/applications/almtest/ALMTest.{h,cc}:
+join a group, multicast periodically, record delivery).
+
+Engine mapping: Scribe is a tier app over any KBR overlay (apps/base.py
+interface).  Each node joins one group (drawn on READY like ALMTest's
+groupNum draw); a subscription resolves the group key to its rendezvous
+root via the overlay lookup, then sends ScribeSubscribe directly.  The
+root accepts up to ``children`` subscribers; a full table redirects the
+subscriber to one of the existing children (b=1 + payload), which grows
+a bounded-degree dissemination tree — the reference grows its tree from
+KBR route convergence with forwarder state on interior nodes
+(handleJoinMessage/children tables); redirect-on-full is the engine
+equivalent (documented deviation: interior tree nodes are always group
+members here).  Publishes route to the root and flood down the child
+tables (ScribeDataMessage), TTL-bounded against transient cycles.
+Subscriptions refresh periodically; parents prune children whose
+refresh is overdue (childTimeout, Scribe.h parent/child timers).
+
+Stats: alm_published / alm_received / alm_delivery-relevant counters —
+ALMTest's delivery measurement (received vs group size is asserted by
+the tests against the membership oracle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+M_SUB, M_PUB = 0, 1     # lookup tag modes
+
+
+@dataclasses.dataclass(frozen=True)
+class ScribeParams:
+    num_groups: int = 4
+    children: int = 4             # child-table capacity per node
+    subscribe_refresh: float = 30.0   # parent/subscription refresh
+    child_timeout: float = 90.0   # prune silent children (childTimeout)
+    publish_interval: float = 30.0    # ALMTest multicast interval
+    mcast_ttl: int = 12
+    payload_bytes: int = 100
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ScribeState:
+    group: jnp.ndarray     # [N] i32 — joined group (-1 until READY)
+    parent: jnp.ndarray    # [N] i32 — tree parent (NO_NODE = root/unjoined)
+    is_root: jnp.ndarray   # [N] bool — responsible for the group key
+    # per-group child tables: any node can serve as rendezvous/forwarder
+    # for any group (reference Scribe keeps per-group children tables on
+    # interior nodes regardless of membership, Scribe.h:57-152)
+    children: jnp.ndarray  # [N, G, CH] i32
+    child_seen: jnp.ndarray  # [N, G, CH] i64
+    t_sub: jnp.ndarray     # [N] i64 — subscribe/refresh timer
+    t_pub: jnp.ndarray     # [N] i64 — publish timer
+    seq: jnp.ndarray       # [N] i32
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ScribeGlobal:
+    keys: jnp.ndarray      # [G, KL] u32 — group rendezvous keys
+
+
+class ScribeApp:
+    """Tier app (interface: apps/base.py docstring)."""
+
+    def __init__(self, params: ScribeParams = ScribeParams(),
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+        self.p = params
+        self.spec = spec
+
+    def stat_spec(self):
+        return dict(
+            scalars=("alm_hops", "alm_latency_s"),
+            hists=(),
+            counters=("alm_joins", "alm_published", "alm_received",
+                      "alm_sub_redirects", "alm_lookup_failed"))
+
+    def init(self, n: int) -> ScribeState:
+        ch, g = self.p.children, self.p.num_groups
+        return ScribeState(
+            group=jnp.full((n,), -1, I32),
+            parent=jnp.full((n,), NO_NODE, I32),
+            is_root=jnp.zeros((n,), bool),
+            children=jnp.full((n, g, ch), NO_NODE, I32),
+            child_seen=jnp.zeros((n, g, ch), I64),
+            t_sub=jnp.full((n,), T_INF, I64),
+            t_pub=jnp.full((n,), T_INF, I64),
+            seq=jnp.zeros((n,), I32))
+
+    def glob_init(self, rng) -> ScribeGlobal:
+        return ScribeGlobal(keys=keys_mod.random_keys(
+            rng, (self.p.num_groups,), self.spec))
+
+    def post_step(self, ctx, state, glob, events):
+        return state, glob
+
+    def on_ready(self, app, en, now, rng):
+        """Join a random group and schedule subscribe + publish
+        (ALMTest::initializeApp joinGroup)."""
+        r_g, r_o = jax.random.split(rng)
+        g = jax.random.randint(r_g, (), 0, self.p.num_groups, dtype=I32)
+        off = (jax.random.uniform(r_o, ())
+               * self.p.publish_interval * NS).astype(I64)
+        return dataclasses.replace(
+            app,
+            group=jnp.where(en, g, app.group),
+            t_sub=jnp.where(en, now, app.t_sub),
+            t_pub=jnp.where(en, now + off, app.t_pub))
+
+    def on_stop(self, app, en):
+        return dataclasses.replace(
+            app,
+            t_sub=jnp.where(en, T_INF, app.t_sub),
+            t_pub=jnp.where(en, T_INF, app.t_pub))
+
+    def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
+        """Scribe state is soft (refresh-rebuilt); nothing to hand over."""
+        return app
+
+    def next_event(self, app):
+        return jnp.minimum(app.t_sub, app.t_pub)
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        """Fire the subscribe-refresh or the publish; each resolves the
+        group key via an overlay lookup first."""
+        p = self.p
+        glob: ScribeGlobal = ctx.glob
+        # prune silent children (childTimeout)
+        stale = (app.children != NO_NODE) & (
+            app.child_seen + jnp.int64(int(p.child_timeout * NS)) < now)
+        app = dataclasses.replace(
+            app,
+            children=jnp.where(stale, NO_NODE, app.children),
+            child_seen=jnp.where(stale, 0, app.child_seen))
+
+        sub_due = en & (app.t_sub < ctx.t_end)
+        pub_due = en & ~sub_due & (app.t_pub < ctx.t_end)
+        mode = jnp.where(sub_due, M_SUB, M_PUB)
+        fire = (sub_due | pub_due) & (app.group >= 0)
+        key = glob.keys[jnp.maximum(app.group, 0)]
+        ev.count("alm_published", fire & pub_due & ctx.measuring)
+        app = dataclasses.replace(
+            app,
+            t_sub=jnp.where(sub_due, now + jnp.int64(
+                int(p.subscribe_refresh * NS)), app.t_sub),
+            t_pub=jnp.where(pub_due, now + jnp.int64(
+                int(p.publish_interval * NS)), app.t_pub),
+            seq=app.seq + (fire & pub_due).astype(I32))
+        return app, base.LookupReq(want=fire, key=key,
+                                   tag=app.seq * 4 + mode)
+
+    def on_lookup_done(self, app, done: base.LookupDone, ctx, ob, ev, now,
+                       node_idx):
+        en = done.en
+        mode = done.tag % 4
+        suc = done.success & (done.results[0] != NO_NODE)
+        root = done.results[0]
+        ev.count("alm_lookup_failed", en & ~suc)
+
+        # subscribe: we ARE the root when the lookup resolves to self
+        en_s = en & suc & (mode == M_SUB)
+        self_root = en_s & (root == node_idx)
+        ev.count("alm_joins", self_root & ~app.is_root)
+        app = dataclasses.replace(
+            app,
+            is_root=jnp.where(en_s, self_root, app.is_root),
+            parent=jnp.where(self_root, NO_NODE, app.parent))
+        ob.send(en_s & ~self_root, now, root, wire.SCRIBE_SUB,
+                a=app.group, size_b=wire.BASE_CALL_B + 4)
+
+        # publish: hand the payload to the root (self-root floods locally
+        # via the child table on the next on_msg loopback send)
+        en_p = en & suc & (mode == M_PUB)
+        ob.send(en_p, now, root, wire.SCRIBE_MCAST, a=app.group,
+                b=done.tag // 4, c=jnp.int32(self.p.mcast_ttl),
+                stamp=now, hops=jnp.int32(0),
+                size_b=self.p.payload_bytes)
+        return app
+
+    def _child_add(self, app, en, g, child, now):
+        """Add/refresh a child-table entry in group row ``g``; returns
+        (app, accepted)."""
+        ch = app.children.shape[-1]
+        g = jnp.clip(g, 0, self.p.num_groups - 1)
+        row = app.children[g]
+        rseen = app.child_seen[g]
+        match = (row == child) & (child != NO_NODE)
+        have = jnp.any(match)
+        free = row == NO_NODE
+        col = jnp.where(have, jnp.argmax(match), jnp.argmax(free)).astype(I32)
+        ok = en & (have | jnp.any(free))
+        col = jnp.where(ok, col, ch)
+        return dataclasses.replace(
+            app,
+            children=app.children.at[g, col].set(child, mode="drop"),
+            child_seen=app.child_seen.at[g, col].set(now, mode="drop")), ok
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        p = self.p
+        now = m.t_deliver
+
+        # ScribeSubscribe → accept as child, or redirect to a child
+        # (bounded-degree tree; module docstring).  Any node serves any
+        # group's subscribers (rendezvous responsibility is by key)
+        en = m.valid & (m.kind == wire.SCRIBE_SUB)
+        mg = jnp.clip(m.a, 0, p.num_groups - 1)
+        app, ok = self._child_add(app, en, mg, m.src, now)
+        redirect = en & ~ok
+        # pick the least-recently-refreshed child as redirect target
+        grow = app.children[mg]
+        gseen = app.child_seen[mg]
+        tgt = grow[jnp.argmin(gseen).astype(I32)]
+        redirect &= (tgt != NO_NODE) & (tgt != m.src)
+        ev.count("alm_sub_redirects", redirect)
+        payload = jnp.full((grow.shape[0],), NO_NODE, I32)
+        ob.send(en & (ok | redirect), now, m.src, wire.SCRIBE_SUB_ACK,
+                a=m.a, b=redirect.astype(I32),
+                nodes=jnp.where(redirect, payload.at[0].set(tgt), payload),
+                size_b=wire.BASE_CALL_B + 4)
+
+        # SubscribeAck → adopt parent (or chase the redirect)
+        en = m.valid & (m.kind == wire.SCRIBE_SUB_ACK) & (
+            m.a == app.group)
+        direct = en & (m.b == 0)
+        app = dataclasses.replace(
+            app,
+            parent=jnp.where(direct, m.src, app.parent),
+            is_root=jnp.where(direct, False, app.is_root))
+        red_tgt = m.nodes[0]
+        ob.send(en & (m.b != 0) & (red_tgt != NO_NODE), now,
+                jnp.maximum(red_tgt, 0), wire.SCRIBE_SUB, a=app.group,
+                size_b=wire.BASE_CALL_B + 4)
+
+        # multicast data → deliver (members only) + forward down the
+        # group's child table (forwarders need not be members)
+        en = m.valid & (m.kind == wire.SCRIBE_MCAST) & (m.c > 0)
+        member = en & (m.a == app.group)
+        ev.count("alm_received", member & ctx.measuring)
+        ev.value("alm_hops", m.hops.astype(jnp.float32),
+                 member & ctx.measuring)
+        ev.value("alm_latency_s",
+                 (now - m.stamp).astype(jnp.float32) / NS,
+                 member & ctx.measuring)
+        mg = jnp.clip(m.a, 0, p.num_groups - 1)
+        for i in range(p.children):
+            c = app.children[mg, i]
+            fwd = en & (c != NO_NODE) & (c != m.src)
+            ob.send(fwd, now, c, wire.SCRIBE_MCAST, a=m.a, b=m.b,
+                    c=m.c - 1, hops=m.hops + 1, stamp=m.stamp,
+                    size_b=p.payload_bytes)
+        return app
+
+    @property
+    def hist_map(self):
+        return {}

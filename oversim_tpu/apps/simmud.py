@@ -1,0 +1,120 @@
+"""SimMud — region-based MMOG over Scribe multicast, vectorized.
+
+Rebuild of the reference SimMud (src/tier2/simmud/SimMud.{h,cc}): the
+game map is divided into square regions; each region is a multicast
+group (region key = group id) on Scribe over any KBR overlay; players
+multicast their position updates to their current region and re-join
+the region group when they cross a boundary (SimMud.h:33-46
+regionSize/playerMoveMessages).
+
+Engine mapping: extends apps/scribe.py's tree machinery with a movement
+layer (apps/movement.py generators).  The movement timer advances the
+position every ``move_interval``; a region change re-targets ``group``
+and forces an immediate re-subscribe; the Scribe publish IS the
+position-update multicast (alm_* stats double as SimMud's move-delivery
+KPIs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.apps import movement as move_mod
+from oversim_tpu.apps.scribe import (ScribeApp, ScribeParams, ScribeState,
+                                     M_SUB)
+from oversim_tpu.core import keys as keys_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimMudParams(ScribeParams):
+    grid: int = 2                 # regions per axis (num_groups = grid²)
+    move_interval: float = 5.0    # movementDelay
+    move: move_mod.MoveParams = move_mod.MoveParams(field=1000.0)
+
+    def __post_init__(self):
+        object.__setattr__(self, "num_groups", self.grid * self.grid)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SimMudState(ScribeState):
+    pos: jnp.ndarray       # [N, 2] f32
+    wp: jnp.ndarray        # [N, 2] f32 movement waypoint
+    t_move: jnp.ndarray    # [N] i64
+    region_moves: jnp.ndarray  # [N] i32 — boundary crossings (stat aid)
+
+
+class SimMudApp(ScribeApp):
+    """Tier-2 game app (interface: apps/base.py docstring)."""
+
+    def __init__(self, params: SimMudParams = SimMudParams(),
+                 spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+        super().__init__(params, spec)
+
+    def _region_of(self, pos):
+        p: SimMudParams = self.p
+        cell = jnp.clip((pos / (p.move.field / p.grid)).astype(I32),
+                        0, p.grid - 1)
+        return cell[..., 0] * p.grid + cell[..., 1]
+
+    def init(self, n: int) -> SimMudState:
+        base_st = super().init(n)
+        kw = {f.name: getattr(base_st, f.name)
+              for f in dataclasses.fields(base_st)}
+        pos, wp = move_mod.init_positions(jax.random.PRNGKey(97), n,
+                                          self.p.move)
+        return SimMudState(**kw, pos=pos, wp=wp,
+                           t_move=jnp.full((n,), T_INF, I64),
+                           region_moves=jnp.zeros((n,), I32))
+
+    def on_ready(self, app, en, now, rng):
+        app = super().on_ready(app, en, now, rng)
+        # the joined group is the region under our feet, not random
+        return dataclasses.replace(
+            app,
+            group=jnp.where(en, self._region_of(app.pos), app.group),
+            t_move=jnp.where(en, now + jnp.int64(
+                int(self.p.move_interval * NS)), app.t_move))
+
+    def on_stop(self, app, en):
+        app = super().on_stop(app, en)
+        return dataclasses.replace(
+            app, t_move=jnp.where(en, T_INF, app.t_move))
+
+    def next_event(self, app):
+        return jnp.minimum(super().next_event(app), app.t_move)
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        p: SimMudParams = self.p
+        # movement tick (SimMud::handleMove): advance position, and on a
+        # region crossing re-target the group + force a re-subscribe
+        mv = en & (app.t_move < ctx.t_end)
+        r_mv, r_rest = jax.random.split(rng)
+        new_pos, new_wp = move_mod.step(app.pos, app.wp,
+                                        jnp.float32(p.move_interval),
+                                        r_mv, p.move)
+        new_pos = jnp.where(mv, new_pos, app.pos)
+        new_wp = jnp.where(mv, new_wp, app.wp)
+        new_region = self._region_of(new_pos)
+        crossed = mv & (app.group >= 0) & (new_region != app.group)
+        app = dataclasses.replace(
+            app,
+            pos=new_pos, wp=new_wp,
+            group=jnp.where(crossed, new_region, app.group),
+            parent=jnp.where(crossed, NO_NODE, app.parent),
+            is_root=jnp.where(crossed, False, app.is_root),
+            region_moves=app.region_moves + crossed.astype(I32),
+            t_sub=jnp.where(crossed, now, app.t_sub),
+            t_move=jnp.where(mv, now + jnp.int64(
+                int(p.move_interval * NS)), app.t_move))
+        return super().on_timer(app, en, ctx, now, r_rest, ev, node_idx)

@@ -64,14 +64,22 @@ class ChurnParams:
     deadtime_mean: float | None = None  # deadtimeMean (pareto; None = life)
     lifetime_dist: str = "weibull"    # lifetimeDistName
     lifetime_par1: float = 1.0        # lifetimeDistPar1
-    graceful_leave_delay: float = 15.0
+    graceful_leave_delay: float = 15.0        # gracefulLeaveDelay, default.ini:493
+    graceful_leave_probability: float = 0.5   # default.ini:494
     # RandomChurn (RandomChurn.{h,cc}): periodic probabilistic events
     churn_change_interval: float = 10.0   # churnChangeInterval
     creation_probability: float = 0.5     # creationProbability
     removal_probability: float = 0.5      # removalProbability
+    # TraceChurn (TraceChurn.{h,cc} + GlobalTraceManager): precomputed
+    # per-slot join/leave schedules from a trace file (trace.py parses
+    # `<time> <nodeID> JOIN|LEAVE` lines into these tuples)
+    trace_create: tuple = ()              # seconds, one entry per slot
+    trace_kill: tuple = ()
 
     @property
     def num_slots(self) -> int:
+        if self.model == "trace":
+            return len(self.trace_create)
         if self.model == "none":
             return self.target_num
         if self.model == "pareto":
@@ -83,6 +91,8 @@ class ChurnParams:
     @property
     def init_finished_time(self) -> float:
         """When the init phase ends and transition time starts counting."""
+        if self.model == "trace":
+            return 0.0
         return self.init_interval * self.target_num
 
 
@@ -90,10 +100,21 @@ class ChurnParams:
 @dataclasses.dataclass
 class ChurnState:
     t_create: jnp.ndarray  # [N] i64 — pending create events (T_INF if none)
-    t_kill: jnp.ndarray    # [N] i64 — pending kill events
+    t_kill: jnp.ndarray    # [N] i64 — pending pre-kill (leave notification)
+    t_dead: jnp.ndarray    # [N] i64 — scheduled final kill (grace window end;
+                           # preKillNode schedules removal gracefulLeaveDelay
+                           # later, SimpleUnderlayConfigurator.cc:375-376)
+    graceful: jnp.ndarray  # [N] bool — NF_OVERLAY_NODE_GRACEFUL_LEAVE drawn
+                           # (w.p. gracefulLeaveProbability, :370-373)
     l_mean: jnp.ndarray    # [N] f32 — per-slot mean lifetime (pareto)
     d_mean: jnp.ndarray    # [N] f32 — per-slot mean deadtime (pareto)
     t_tick: jnp.ndarray    # [] i64 — next periodic churn tick (random model)
+
+
+def _with_grace(state_kw, n):
+    state_kw.setdefault("t_dead", jnp.full((n,), T_INF, I64))
+    state_kw.setdefault("graceful", jnp.zeros((n,), bool))
+    return state_kw
 
 
 def _draw_lifetime(rng, p: ChurnParams, shape):
@@ -127,10 +148,21 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
     if p.model == "none":
         stagger = _truncnormal(r1, p.init_interval, p.init_deviation, (n,))
         t_create = jnp.cumsum(stagger)
-        return ChurnState(
+        return ChurnState(**_with_grace(dict(
             t_create=(t_create * NS).astype(I64),
             t_kill=jnp.full((n,), T_INF, I64),
-            l_mean=zeros, d_mean=zeros, t_tick=T_INF)
+            l_mean=zeros, d_mean=zeros, t_tick=T_INF), n))
+    if p.model == "trace":
+        # TraceChurn: the schedule IS the trace (GlobalTraceManager
+        # createNode/deleteNode at the traced times)
+        t_create = jnp.asarray(
+            [t * NS if t is not None else int(T_INF)
+             for t in p.trace_create], I64)
+        t_kill = jnp.asarray(
+            [t * NS if t is not None else int(T_INF)
+             for t in p.trace_kill], I64)
+        return ChurnState(**_with_grace(dict(t_create=t_create, t_kill=t_kill,
+                          l_mean=zeros, d_mean=zeros, t_tick=T_INF), n))
     if p.model == "lifetime":
         fin = p.init_finished_time
         i = jnp.arange(tgt)
@@ -141,12 +173,13 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         second_kill = second_create + _draw_lifetime(r4, p, (tgt,))
         t_create = jnp.concatenate([first_create, second_create])
         t_kill = jnp.concatenate([first_kill, second_kill])
-        # kill fires gracefulLeaveDelay before the end of the session
+        # pre-kill (leave notification) fires gracefulLeaveDelay before
+        # the session end; the node survives the grace window so total
+        # session length == the drawn lifetime (LifetimeChurn.cc:112-113)
         t_kill = jnp.maximum(t_kill - p.graceful_leave_delay, t_create)
-        return ChurnState(
-            t_create=(t_create * NS).astype(I64),
+        return ChurnState(**_with_grace(dict(t_create=(t_create * NS).astype(I64),
             t_kill=(t_kill * NS).astype(I64),
-            l_mean=zeros, d_mean=zeros, t_tick=T_INF)
+            l_mean=zeros, d_mean=zeros, t_tick=T_INF), n))
     if p.model == "pareto":
         # ParetoChurn.cc:66-126: per-slot individual mean life/dead times,
         # equilibrium init (alive w.p. availability), stretch to hit the
@@ -189,41 +222,64 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
                              t_create)
         t_create = jnp.where(participating, t_create, T_INF / NS)
         t_kill = jnp.where(participating, t_kill, T_INF / NS)
-        return ChurnState(
-            t_create=(t_create * NS).astype(I64),
+        return ChurnState(**_with_grace(dict(t_create=(t_create * NS).astype(I64),
             t_kill=(t_kill * NS).astype(I64),
             l_mean=l_i.astype(jnp.float32), d_mean=d_i.astype(jnp.float32),
-            t_tick=T_INF)
+            t_tick=T_INF), n))
     if p.model == "random":
         # RandomChurn: start tgt nodes, then probabilistic create/remove
         # ticks every churnChangeInterval (step() drives the process)
         stagger = _truncnormal(r1, p.init_interval, p.init_deviation, (n,))
         t_create = jnp.cumsum(stagger)
         t_create = jnp.where(jnp.arange(n) < tgt, t_create, T_INF / NS)
-        return ChurnState(
+        return ChurnState(**_with_grace(dict(
             t_create=(t_create * NS).astype(I64),
             t_kill=jnp.full((n,), T_INF, I64),
             l_mean=zeros, d_mean=zeros,
             t_tick=jnp.int64(int((p.init_finished_time
-                                  + p.churn_change_interval) * NS)))
+                                  + p.churn_change_interval) * NS))), n))
     raise ValueError(f"unknown churn model {p.model}")
 
 
 def next_event(state: ChurnState):
-    return jnp.minimum(state.t_tick,
-                       jnp.minimum(jnp.min(state.t_create),
-                                   jnp.min(state.t_kill)))
+    # t_kill holds the already-fired pre-kill time during a grace window
+    # (rebirth anchor) — mask it so the engine doesn't spin on it
+    kill_eff = jnp.where(state.t_dead < T_INF, T_INF, state.t_kill)
+    t = jnp.minimum(state.t_tick,
+                    jnp.minimum(jnp.min(state.t_create),
+                                jnp.min(kill_eff)))
+    return jnp.minimum(t, jnp.min(state.t_dead))
 
 
 def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
-    """Fire create/kill events inside [t_start, t_end).
+    """Fire create/pre-kill/kill events inside [t_start, t_end).
 
-    Returns (state', created [N] bool, killed [N] bool).  A kill immediately
-    schedules the slot's next incarnation (LifetimeChurn::deleteNode
-    re-creates after a dead-time draw with a fresh lifetime draw).
+    Returns (state', created, killed, leaving — all [N] bool).  A pre-kill
+    (t_kill) starts the grace window: the node keeps running for
+    gracefulLeaveDelay, is removed from the bootstrap oracle, and — w.p.
+    gracefulLeaveProbability — receives the graceful-leave notification
+    (``state.graceful``) so overlay/apps can hand data over
+    (SimpleUnderlayConfigurator::preKillNode, :312-377).  The final kill
+    (t_dead) frees the slot and schedules its next incarnation
+    (LifetimeChurn::deleteNode re-creates after a dead-time draw).
+    ``leaving`` marks the pre-kills fired THIS window.
     """
     created = (state.t_create < t_end) & ~alive
-    killed = (state.t_kill < t_end) & alive & ~created
+    leaving = (state.t_kill < t_end) & alive & ~created & (
+        state.t_dead >= T_INF)
+    killed = (state.t_dead < t_end) & alive & ~created
+
+    r_grace, rng = jax.random.split(rng)
+    grace_ns = jnp.int64(int(p.graceful_leave_delay * NS))
+    coin = jax.random.uniform(r_grace, (p.num_slots,)) \
+        < p.graceful_leave_probability
+    t_dead = jnp.where(leaving, state.t_kill + grace_ns, state.t_dead)
+    graceful = jnp.where(leaving, coin, state.graceful)
+    t_dead = jnp.where(killed, T_INF, t_dead)
+    graceful = jnp.where(killed, False, graceful)
+    # t_kill keeps the pre-kill time through the grace window: the rebirth
+    # dead-time below starts at deleteNode (= the pre-kill), matching
+    # LifetimeChurn::deleteNode; next_event() masks it while t_dead runs
 
     t_create = jnp.where(created, T_INF, state.t_create)
     t_kill = state.t_kill
@@ -234,9 +290,9 @@ def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
         r1, r2 = jax.random.split(rng)
         dead_time = (_draw_lifetime(r1, p, (n,)) * NS).astype(I64)
         lifetime = (_draw_lifetime(r2, p, (n,)) * NS).astype(I64)
-        graceful = jnp.int64(p.graceful_leave_delay * NS)
         next_create = state.t_kill + dead_time
-        next_kill = jnp.maximum(next_create + lifetime - graceful, next_create)
+        next_kill = jnp.maximum(next_create + lifetime - grace_ns,
+                                next_create)
         t_create = jnp.where(killed, next_create, t_create)
         t_kill = jnp.where(killed, next_kill, t_kill)
     elif p.model == "pareto":
@@ -247,15 +303,16 @@ def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
                      * NS).astype(I64)
         lifetime = (_shifted_pareto(r2, 3.0, state.l_mean, (n,))
                     * NS).astype(I64)
-        graceful = jnp.int64(p.graceful_leave_delay * NS)
         next_create = state.t_kill + dead_time
-        next_kill = jnp.maximum(next_create + lifetime - graceful, next_create)
+        next_kill = jnp.maximum(next_create + lifetime - grace_ns,
+                                next_create)
         t_create = jnp.where(killed, next_create, t_create)
         t_kill = jnp.where(killed, next_kill, t_kill)
     elif p.model == "random":
         # RandomChurn::handleMessage: every churnChangeInterval flip a coin
         # for one create and one removal (probabilistic population drift)
         t_kill = jnp.where(killed, T_INF, t_kill)
+        del n  # slots indexed directly below
         tick = t_tick < t_end
         r1, r2, r3, r4 = jax.random.split(rng, 4)
         do_create = tick & (jax.random.uniform(r1) < p.creation_probability)
@@ -277,7 +334,12 @@ def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
             t_tick)
     else:
         t_kill = jnp.where(killed, T_INF, t_kill)
+    # a next-incarnation pre-kill drawn inside the current window must be
+    # DEFERRED past it (cancelling would make the slot immortal; leaving
+    # it stale would pin the event horizon)
+    t_kill = jnp.where(killed & (t_kill <= t_end), t_end + 1, t_kill)
 
-    return ChurnState(t_create=t_create, t_kill=t_kill,
-                      l_mean=state.l_mean, d_mean=state.d_mean,
-                      t_tick=t_tick), created, killed
+    return ChurnState(
+        t_create=t_create, t_kill=t_kill, t_dead=t_dead, graceful=graceful,
+        l_mean=state.l_mean, d_mean=state.d_mean,
+        t_tick=t_tick), created, killed, leaving

@@ -1,0 +1,47 @@
+"""CryptoModule — RPC message authentication (sim-mode overhead model).
+
+Rebuild of the reference CryptoModule (src/common/CryptoModule.{h,cc}:
+signs/verifies an AuthBlock on RPC messages — `signMessage`
+CryptoModule.h:56, AuthBlock fields CommonMessages.msg:172-177,217.
+Real asymmetric crypto only runs in SingleHost mode with a key file; in
+simulation the module measures the byte/latency overhead of carrying
+signatures (`measureAuthBlock`)).
+
+Engine mapping: signatures are modeled, not computed — `auth_overhead`
+returns the wire-size surcharge every signed RPC carries (certificate +
+signature, the reference's AUTHBLOCK_L) and `sign`/`verify` model the
+constant-time cost and an always-valid check in sim mode (a byzantine
+node's forged block is caught with probability 1, matching the
+reference's oracle-backed sim verification).  The host-side gateway
+(singlehost.py) is where real crypto would attach.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+
+AUTHBLOCK_B = 140   # certificate + signature bytes (AUTHBLOCK_L / 8)
+
+
+@dataclasses.dataclass(frozen=True)
+class CryptoParams:
+    enabled: bool = False         # sign all RPCs (overhead model)
+    sign_cost_s: float = 0.0005   # modeled signing latency
+    verify_cost_s: float = 0.0008
+
+
+def auth_overhead(p: CryptoParams) -> int:
+    """Extra wire bytes per signed RPC (added to size_b by callers)."""
+    return AUTHBLOCK_B if p.enabled else 0
+
+
+def sign(key: bytes, payload: bytes) -> bytes:
+    """Host-side real signature for the gateway path (HMAC stand-in for
+    the reference's RSA keyFile signatures)."""
+    return hmac.new(key, payload, hashlib.sha1).digest()
+
+
+def verify(key: bytes, payload: bytes, signature: bytes) -> bool:
+    return hmac.compare_digest(sign(key, payload), signature)

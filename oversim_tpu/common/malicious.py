@@ -1,0 +1,65 @@
+"""Malicious-node attack switches (byzantine fault injection).
+
+Rebuild of the reference's malicious-node support (BaseOverlay.h:203-206
+isMalicious/dropFindNodeAttack/isSiblingAttack/invalidNodesAttack +
+findNodeRpc attack payloads BaseOverlay.cc:1873-1899; population ratio
+maliciousNodeProbability, default.ini:529-536).  Flags live in the engine
+(SimState.malicious, drawn per slot) and are broadcast read-only through
+``Ctx.malicious``; every overlay's FindNode server applies
+``attack_findnode`` to its response before sending.
+
+Attacks implemented (all off by default):
+  * dropFindNodeAttack — a malicious node silently drops FindNode calls
+    (BaseOverlay.cc:1845-1850);
+  * isSiblingAttack — a malicious node claims to be the key's sibling
+    and returns only itself (BaseOverlay.cc:1875-1881: "try to attract
+    all traffic");
+  * invalidNodesAttack — the response is filled with random node slots
+    regardless of distance (BaseOverlay.cc:1883-1899 returns invalid
+    handles; here: uniform random slots, mostly wrong/dead, which
+    poisons frontiers and burns RPC timeouts the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+NO_NODE = jnp.int32(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class MaliciousParams:
+    """default.ini:529-536 + BaseOverlay.h:203-206."""
+
+    probability: float = 0.0      # maliciousNodeProbability
+    drop_find_node: bool = False  # dropFindNodeAttack
+    is_sibling: bool = False      # isSiblingAttack
+    invalid_nodes: bool = False   # invalidNodesAttack
+
+    @property
+    def active(self) -> bool:
+        return self.probability > 0.0
+
+
+def attack_findnode(ctx, mp: MaliciousParams, node_idx, res, sib, rng):
+    """Apply this node's attack behavior to its FindNode response.
+
+    Returns (res', sib', respond) — ``respond`` false = drop the call.
+    No-op (statically) when no attack is configured."""
+    if not mp.active or ctx.malicious is None:
+        return res, sib, jnp.bool_(True)
+    mal = ctx.malicious[node_idx]
+    respond = ~(mal & jnp.bool_(mp.drop_find_node))
+    if mp.is_sibling:
+        self_only = jnp.full(res.shape, NO_NODE, I32).at[0].set(node_idx)
+        res = jnp.where(mal, self_only, res)
+        sib = sib | mal
+    if mp.invalid_nodes:
+        n = ctx.alive.shape[0]
+        rand = jax.random.randint(rng, res.shape, 0, n, dtype=I32)
+        res = jnp.where(mal & ~sib, rand, res)
+    return res, sib, respond

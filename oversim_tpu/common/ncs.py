@@ -1,0 +1,141 @@
+"""Network coordinate systems: Vivaldi / SVivaldi / SimpleNcs, vectorized.
+
+TPU-native rebuild of the reference NCS family hosted by NeighborCache
+(src/common/Vivaldi.{h,cc}, SVivaldi.{h,cc}, SimpleNcs.{h,cc};
+``ncsType`` none/vivaldi/svivaldi/simple, default.ini:451).  The
+coordinates give every node an RTT predictor used for proximity routing
+(R/Kademlia), PNS (Pastry), CBR, and NCS-based adaptive RPC timeouts.
+
+Vivaldi (Vivaldi::processCoordinates, Vivaldi.cc:56-100): a spring
+relaxation over measured RTTs —
+
+    w      = e_i / (e_i + e_j)                 (confidence weight)
+    dist   = |x_i - x_j| (+ heights)
+    e_i    = |dist - rtt|/rtt · ce·w + e_i · (1 - ce·w)
+    x_i   += cc·w · (rtt - dist) · (x_i - x_j)/dist
+
+with cc = coordC = 0.25 and ce = errorC = 0.5 (Vivaldi.ned defaults).
+SVivaldi adds a loss factor that freezes adaptation as the estimate
+stabilizes (SVivaldi.cc: delta = cc·w·loss).  SimpleNcs simply reveals
+the underlay's ground-truth coordinates scaled to delay space
+(SimpleNcs.cc: coords/dimension falloff — here coord · 0.001 s/unit,
+the SimpleUnderlay delay constant, SimpleNodeEntry.cc:186).
+
+All state is [N, ...] arrays; ``update`` is written against one node's
+slice (vmapped by the caller — overlays feed it RTT samples from their
+ping/RPC round trips; the reference piggybacks coords on every RPC
+response via the ``ncsInfo[]`` field, CommonMessages.msg:233).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+F32 = jnp.float32
+NS = 1_000_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class NcsParams:
+    """Vivaldi.ned / SVivaldi.ned defaults."""
+
+    ncs_type: str = "vivaldi"     # "none"|"vivaldi"|"svivaldi"|"simple"
+    dims: int = 2                 # vivaldiDimConfig
+    coord_c: float = 0.25         # vivaldiCoordConfig (cc)
+    error_c: float = 0.5          # vivaldiErrorConfig (ce)
+    enable_height: bool = False   # enableHeightVector
+    loss_c: float = 0.5           # SVivaldi loss smoothing
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NcsState:
+    coords: jnp.ndarray   # [N, D] f32 — predicted-delay space (seconds)
+    height: jnp.ndarray   # [N] f32
+    error: jnp.ndarray    # [N] f32 — local error estimate (starts 1.0)
+    loss: jnp.ndarray     # [N] f32 — SVivaldi loss factor
+
+
+def init(rng, n: int, p: NcsParams) -> NcsState:
+    """Coords start uniform in [-0.2, 0.2] (Vivaldi.cc:46-49)."""
+    return NcsState(
+        coords=jax.random.uniform(rng, (n, p.dims), F32, -0.2, 0.2),
+        height=jnp.zeros((n,), F32),
+        error=jnp.ones((n,), F32),
+        loss=jnp.zeros((n,), F32))
+
+
+def from_underlay(coords, delay_per_unit: float = 0.001) -> NcsState:
+    """SimpleNcs: perfect coordinates from the underlay ground truth."""
+    n = coords.shape[0]
+    return NcsState(coords=jnp.asarray(coords, F32) * delay_per_unit,
+                    height=jnp.zeros((n,), F32),
+                    error=jnp.full((n,), 1e-6, F32),
+                    loss=jnp.ones((n,), F32))
+
+
+def distance(xi, hi, xj, hj):
+    """Predicted RTT between two coordinate points (+ height vectors,
+    VivaldiCoordsInfo::getDistance)."""
+    d = xi - xj
+    return jnp.sqrt(jnp.sum(d * d, axis=-1)) + hi + hj
+
+
+def update(me: dict, rtt_s, xj, ej, hj, p: NcsParams):
+    """One Vivaldi sample for one node (vmap over nodes outside).
+
+    ``me``: dict(coords [D], height, error, loss) — this node's slice.
+    Returns the updated dict.  No-op (returns ``me``) for rtt <= 0.
+    """
+    ok = rtt_s > 0.0
+    rtt = jnp.maximum(rtt_s, 1e-9)
+    xi, hi = me["coords"], me["height"]
+    ei = me["error"]
+    wsum = ei + ej
+    w = jnp.where(wsum > 0, ei / jnp.maximum(wsum, 1e-12), 0.0)
+    dist = distance(xi, hi, xj, hj)
+    rel_err = jnp.abs(dist - rtt) / rtt
+    new_err = rel_err * p.error_c * w + ei * (1.0 - p.error_c * w)
+    delta = p.coord_c * w
+    if p.ncs_type == "svivaldi":
+        # loss factor rises toward 1 as samples accumulate, then damps
+        # coordinate movement (SVivaldi: delta *= (1 - loss))
+        new_loss = me["loss"] * (1 - p.loss_c) + \
+            (1.0 - jnp.minimum(rel_err, 1.0)) * p.loss_c
+        delta = delta * (1.0 - new_loss)
+    else:
+        new_loss = me["loss"]
+    unit = jnp.where(dist > 0, (xi - xj) / jnp.maximum(dist, 1e-12), 0.0)
+    new_coords = xi + delta * (rtt - dist) * unit
+    new_height = hi + (delta * (rtt - dist) if p.enable_height else 0.0)
+    return dict(
+        coords=jnp.where(ok & (dist > 0), new_coords, xi),
+        height=jnp.where(ok & (dist > 0), new_height, hi),
+        error=jnp.clip(jnp.where(ok, new_err, ei), 0.0, 10.0),
+        loss=jnp.where(ok, new_loss, me["loss"]))
+
+
+def slice_of(st: NcsState, idx):
+    return dict(coords=st.coords[idx], height=st.height[idx],
+                error=st.error[idx], loss=st.loss[idx])
+
+
+def pack_wire(coords, error, lanes: int):
+    """Pack (coords [D], error) into a [lanes] u32 key field — the
+    engine's stand-in for the reference's ncsInfo[] piggyback on RPC
+    responses (CommonMessages.msg:233).  Needs lanes >= D + 1."""
+    d = coords.shape[-1]
+    if lanes < d + 1:
+        raise ValueError("key lanes too narrow for NCS piggyback")
+    payload = jnp.concatenate([coords.astype(F32), error[None].astype(F32)])
+    words = jax.lax.bitcast_convert_type(payload, jnp.uint32)
+    return jnp.zeros((lanes,), jnp.uint32).at[:d + 1].set(words)
+
+
+def unpack_wire(key, dims: int):
+    """Inverse of pack_wire: returns (coords [D], error)."""
+    payload = jax.lax.bitcast_convert_type(key[:dims + 1], F32)
+    return payload[:dims], payload[dims]

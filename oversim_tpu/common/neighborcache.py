@@ -1,0 +1,128 @@
+"""NeighborCache: per-node RTT/proximity cache with adaptive timeouts.
+
+TPU-native rebuild of src/common/NeighborCache.{h,cc}: every node keeps
+a bounded cache of peers with their last measured RTTs and a liveness
+state, answering
+
+  * proximity queries — ``get_prox`` (NeighborCache::getProx,
+    NeighborCache.cc:577): last-known RTT for a peer, -1 when unknown
+    (the reference's query types exact/estimated/available collapse to
+    cached-or-unknown here; NCS estimation is the caller's fallback via
+    common/ncs.py distance);
+  * adaptive RPC timeouts — ``node_timeout`` (getNodeTimeout /
+    getRttBasedTimeout, NeighborCache.cc:802-838): TCP-style
+    mean + 4·var over the RTT history, or mean·1.2 with a single
+    sample, scaled by RTT_TIMEOUT_ADJUSTMENT = 1.3; falls back to the
+    caller's default when the peer is unknown.
+
+State is [N, C, ...] structure-of-arrays; per-entry RTT history is an
+exponential pair (mean, var) instead of the reference's last-8 ring
+buffer — same TCP-style estimator family, O(1) per sample.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+F32 = jnp.float32
+NO_NODE = jnp.int32(-1)
+
+RTT_TIMEOUT_ADJUSTMENT = 1.3   # NeighborCache.cc RTT_TIMEOUT_ADJUSTMENT
+ALPHA = 0.125                  # EWMA weights (TCP RFC 6298 style)
+BETA = 0.25
+
+# entry liveness (NeighborCache.h:152-164 RttState)
+S_UNKNOWN, S_ALIVE, S_WAITING, S_TIMEOUT = 0, 1, 2, 3
+
+
+@dataclasses.dataclass(frozen=True)
+class NcParams:
+    capacity: int = 16            # entries per node
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NcState:
+    peer: jnp.ndarray       # [N, C] i32
+    rtt_mean: jnp.ndarray   # [N, C] f32 seconds (-1 = no sample)
+    rtt_var: jnp.ndarray    # [N, C] f32
+    last: jnp.ndarray       # [N, C] i64 — last update time
+    live: jnp.ndarray       # [N, C] i32 S_*
+
+
+def init(n: int, p: NcParams) -> NcState:
+    c = p.capacity
+    return NcState(peer=jnp.full((n, c), NO_NODE, I32),
+                   rtt_mean=jnp.full((n, c), -1.0, F32),
+                   rtt_var=jnp.zeros((n, c), F32),
+                   last=jnp.zeros((n, c), I64),
+                   live=jnp.zeros((n, c), I32))
+
+
+def _find(row_peer, peer):
+    hit = row_peer == peer
+    return jnp.any(hit), jnp.argmax(hit).astype(I32)
+
+
+def insert_rtt(nc_row: dict, peer, rtt_s, now, en=True):
+    """Record one RTT sample for ``peer`` on one node's cache slice
+    (updateNode/insertNodeRtt).  Evicts the least-recently-updated entry
+    when full.  ``nc_row`` is a dict of this node's [C, ...] arrays."""
+    en = jnp.asarray(en) & (peer != NO_NODE) & (rtt_s > 0)
+    found, col_hit = _find(nc_row["peer"], peer)
+    col_new = jnp.argmin(nc_row["last"]).astype(I32)  # LRU / free slot
+    col = jnp.where(found, col_hit, col_new)
+    old_mean = jnp.where(found, nc_row["rtt_mean"][col], -1.0)
+    has_hist = found & (old_mean >= 0)
+    mean = jnp.where(has_hist,
+                     (1 - ALPHA) * old_mean + ALPHA * rtt_s, rtt_s)
+    var = jnp.where(has_hist,
+                    (1 - BETA) * nc_row["rtt_var"][col]
+                    + BETA * jnp.abs(rtt_s - old_mean), 0.0)
+    col = jnp.where(en, col, nc_row["peer"].shape[0])   # OOB drop
+    return dict(
+        peer=nc_row["peer"].at[col].set(peer, mode="drop"),
+        rtt_mean=nc_row["rtt_mean"].at[col].set(mean, mode="drop"),
+        rtt_var=nc_row["rtt_var"].at[col].set(var, mode="drop"),
+        last=nc_row["last"].at[col].set(now, mode="drop"),
+        live=nc_row["live"].at[col].set(S_ALIVE, mode="drop"))
+
+
+def set_state(nc_row: dict, peer, state, en=True):
+    """Mark an entry's liveness (WAITING at send, TIMEOUT on miss)."""
+    en = jnp.asarray(en) & (peer != NO_NODE)
+    found, col = _find(nc_row["peer"], peer)
+    col = jnp.where(en & found, col, nc_row["peer"].shape[0])
+    out = dict(nc_row)
+    out["live"] = nc_row["live"].at[col].set(state, mode="drop")
+    return out
+
+
+def get_prox(nc_row: dict, peer):
+    """Last-known RTT for ``peer`` (seconds; -1 unknown) + alive flag."""
+    found, col = _find(nc_row["peer"], peer)
+    rtt = jnp.where(found, nc_row["rtt_mean"][col], -1.0)
+    alive = found & (nc_row["live"][col] != S_TIMEOUT)
+    return rtt, alive
+
+
+def node_timeout(nc_row: dict, peer, default_s):
+    """Adaptive RPC timeout (getRttBasedTimeout): mean + 4·var (or
+    mean·1.2 with one sample) · 1.3; ``default_s`` when unknown."""
+    rtt, _ = get_prox(nc_row, peer)
+    found, col = _find(nc_row["peer"], peer)
+    var = jnp.where(found, nc_row["rtt_var"][col], 0.0)
+    t = jnp.where(var > 0, rtt + 4.0 * var, rtt * 1.2)
+    t = t * RTT_TIMEOUT_ADJUSTMENT
+    return jnp.where(rtt > 0, t, default_s)
+
+
+def slice_of(st: NcState, idx):
+    return dict(peer=st.peer[idx], rtt_mean=st.rtt_mean[idx],
+                rtt_var=st.rtt_var[idx], last=st.last[idx],
+                live=st.live[idx])

@@ -40,6 +40,33 @@ DHT_PUT_CALL = 31       # DHTPutCall: key, value id, ttl (DHT.msg)
 DHT_PUT_RES = 32
 DHT_GET_CALL = 33       # DHTGetCall: key
 DHT_GET_RES = 34        # DHTGetResponse: value id (-1 = not found)
+APP_RPC_CALL = 35       # KbrTestCall: routed RPC test (KBRTestApp.cc:160)
+APP_RPC_RES = 36        # KbrTestResponse: direct reply, echoes stamp/seq
+
+# --- Scribe ALM (src/applications/scribe; ScribeMessage.msg) ---
+SCRIBE_SUB = 90         # ScribeSubscribeCall: join the group tree (a=group)
+SCRIBE_SUB_ACK = 91     # accept: a=group; b=1 → redirect, nodes[0]=new parent
+SCRIBE_MCAST = 92       # ScribeDataMessage: a=group, b=publisher seq,
+                        # c=ttl, stamp=publish time
+
+# --- KBR broadcast API (BaseOverlay.h:817-818 forwardBroadcast +
+# BroadcastRequestCall; keyspace-partitioned, Chord.cc:1410-1446) ---
+BROADCAST = 99          # key=limit of this copy's keyspace range,
+                        # a=broadcast seq, b=initiator, hops in hops
+
+# --- P2PNS name service (src/tier2/p2pns; P2pnsMessage.msg) ---
+P2PNS_REG_CALL = 95     # P2pnsRegisterCall: a=name id, b=value, stamp=ttl
+P2PNS_REG_RES = 96
+P2PNS_RES_CALL = 97     # P2pnsResolveCall: a=name id, b=op nonce
+P2PNS_RES_RES = 98      # a=name id, b=op nonce, c=value (-1 = unknown)
+
+# --- i3 Internet Indirection Infrastructure (src/applications/i3) ---
+I3_INSERT = 100         # insert/refresh trigger: a=trigger id, b=owner,
+                        # stamp=expiry
+I3_INSERT_RES = 101
+I3_PACKET = 102         # data to trigger id: a=trigger id, b=sender,
+                        # stamp=send time
+I3_DELIVER = 103        # server → trigger owner (matched forward)
 
 # --- Kademlia (src/overlay/kademlia) ---
 KAD_PING_CALL = 40      # routingAdd liveness ping (maintenance)
@@ -49,6 +76,11 @@ KAD_PING_RES = 41
 PASTRY_STATE_CALL = 20  # RequestStateMessage / leafset push-pull
 PASTRY_STATE_RES = 21   # PastryStateMessage: leafset (+ self) payload
 
+# --- Broose (src/overlay/broose; BrooseMessage.msg) ---
+BROOSE_BUCKET_CALL = 70  # BucketCall: a=bucket type (BROTHER/LEFT),
+                         # b=proState tag (PINIT/PRSET/PBSET)
+BROOSE_BUCKET_RES = 71   # BucketResponse: requested bucket contents
+
 # --- GIA (src/overlay/gia; GiaMessage.msg) ---
 GIA_NEIGHBOR_CALL = 60  # GiaNeighborMessage: connect request (capacity)
 GIA_NEIGHBOR_RES = 61   # accept/deny + own neighbor sample
@@ -56,6 +88,13 @@ GIA_TOKEN = 62          # GiaTokenFactory::sendToken flow-control grant
 GIA_QUERY = 63          # GiaSearchMessage: biased random-walk search
 GIA_QUERY_RES = 64      # GiaSearchResponseMessage (direct to originator)
 GIA_DISCONNECT = 65     # GiaDisconnectMessage (dropped neighbor notice)
+
+# --- EpiChord (src/overlay/epichord; EpiChordMessage.msg) ---
+EPI_JOIN_CALL = 80      # EpiChordJoinCall (routed to own key)
+EPI_JOIN_RES = 81       # EpiChordJoinResponse: succ+pred lists + cache
+EPI_JOINACK_CALL = 82   # EpiChordJoinAckCall (joiner → old responsible)
+EPI_STAB_CALL = 84      # EpiChordStabilizeCall: a=node type, nodes=additions
+EPI_STAB_RES = 85       # EpiChordStabilizeResponse: a=#preds, nodes=pred++succ
 
 NODEHANDLE_B = 25
 

@@ -11,6 +11,8 @@ reference config runs against the TPU backend unchanged.
 
 from __future__ import annotations
 
+import dataclasses
+
 from oversim_tpu import churn as churn_mod
 from oversim_tpu.apps import kbrtest
 from oversim_tpu.common import lookup as lk_mod
@@ -75,27 +77,54 @@ def build_underlay(ini: IniFile, config: str) -> underlay_mod.UnderlayParams:
     )
 
 
-def build_app(ini: IniFile, config: str, spec: K.KeySpec):
+def build_app(ini: IniFile, config: str, spec: K.KeySpec, trace=None):
     """tier1Type/tier2Type string → app object (reference default.ini:622-628
-    module-type plugin selection)."""
+    module-type plugin selection).  ``trace`` is an optional
+    trace.TraceWorkload for trace-driven DHT runs."""
     t1 = str(_value(ini.get("**.tier1Type", config), ""))
     t2 = str(_value(ini.get("**.tier2Type", config), ""))
-    if "DHT" in t1 or "DHTTestApp" in t2:
+    if "DHT" in t1 or "DHTTestApp" in t2 or trace is not None:
         from oversim_tpu.apps.dht import DhtApp, DhtParams
         return DhtApp(DhtParams(
             num_replica=int(_get(ini, config, "tier1.dht.numReplica", 4)),
+            num_get_requests=int(_get(
+                ini, config, "tier1.dht.numGetRequests", 4)),
+            ratio_identical=float(_get(
+                ini, config, "tier1.dht.ratioIdentical", 0.5)),
             test_interval=float(_get(
                 ini, config, "tier2.dhtTestApp.testInterval", 60.0)),
             test_ttl=float(_get(
                 ini, config, "tier2.dhtTestApp.testTtl", 300.0)),
-        ), spec)
+        ), spec, trace=trace)
     from oversim_tpu.apps.kbrtest import KbrTestApp
     return KbrTestApp(kbrtest.KbrTestParams(
         test_interval=float(_get(
             ini, config, "tier1.kbrTestApp.testMsgInterval", 60.0)),
         test_msg_bytes=int(_get(
             ini, config, "tier1.kbrTestApp.testMsgSize", 100)),
+        oneway_test=bool(_get(
+            ini, config, "tier1.kbrTestApp.kbrOneWayTest", True)),
+        rpc_test=bool(_get(
+            ini, config, "tier1.kbrTestApp.kbrRpcTest", False)),
+        lookup_test=bool(_get(
+            ini, config, "tier1.kbrTestApp.kbrLookupTest", False)),
     ))
+
+
+def build_malicious(ini: IniFile, config: str):
+    """maliciousNodeProbability + attack switches (default.ini:529-536,
+    BaseOverlay.h:203-206) → MaliciousParams."""
+    from oversim_tpu.common.malicious import MaliciousParams
+    return MaliciousParams(
+        probability=float(_value(
+            ini.get("**.maliciousNodeProbability", config), 0.0)),
+        drop_find_node=bool(_get(
+            ini, config, "overlay.dropFindNodeAttack", False)),
+        is_sibling=bool(_get(
+            ini, config, "overlay.isSiblingAttack", False)),
+        invalid_nodes=bool(_get(
+            ini, config, "overlay.invalidNodesAttack", False)),
+    )
 
 
 def build_lookup_config(ini: IniFile, config: str, proto: str,
@@ -109,18 +138,43 @@ def build_lookup_config(ini: IniFile, config: str, proto: str,
 
 
 def build_simulation(ini: IniFile, config: str = "General",
-                     engine_params: sim_mod.EngineParams | None = None):
-    """Instantiate the full Simulation for one [Config ...] section."""
+                     engine_params: sim_mod.EngineParams | None = None,
+                     trace_events=None):
+    """Instantiate the full Simulation for one [Config ...] section.
+
+    ``trace_events``: parsed trace.TraceEvent list — overrides the churn
+    model with the trace schedule, drives the DHT workload from PUT/GET
+    commands, and applies CONNECT/DISCONNECT_NODETYPES partitions
+    (reference GlobalTraceManager)."""
     overlay_type = str(_value(ini.get("**.overlayType", config), ""))
     spec = K.KeySpec(int(_value(ini.get("**.keyLength", config), 160)))
-    cp = build_churn(ini, config)
     up = build_underlay(ini, config)
-    ap = build_app(ini, config, spec)
+    workload = None
+    if trace_events is not None:
+        from oversim_tpu import trace as trace_mod
+        cp = trace_mod.churn_from_trace(trace_events)
+        workload = trace_mod.workload_from_trace(trace_events, cp.num_slots,
+                                                 spec)
+        ps = trace_mod.partitions_from_trace(trace_events)
+        if len(ps.t):
+            ntypes = int(max(ps.a.max(), ps.b.max())) + 1
+            bounds = tuple(cp.num_slots * i // ntypes
+                           for i in range(1, ntypes))
+            up = dataclasses.replace(
+                up, num_node_types=ntypes, type_boundaries=bounds,
+                partition_events=tuple(
+                    (float(t), int(a), int(b), bool(c))
+                    for t, a, b, c in zip(ps.t, ps.a, ps.b, ps.connect)))
+    else:
+        cp = build_churn(ini, config)
+    ap = build_app(ini, config, spec, trace=workload)
+    mp = build_malicious(ini, config)
     ep = engine_params or sim_mod.EngineParams(
         transition_time=float(_value(
             ini.get("**.transitionTime", config), 0.0)),
         measurement_time=float(_value(
             ini.get("**.measurementTime", config), -1.0)),
+        malicious=mp,
     )
 
     if "chord" in overlay_type.lower():
@@ -141,7 +195,7 @@ def build_simulation(ini: IniFile, config: str = "General",
         )
         logic = ChordLogic(spec, params,
                            build_lookup_config(ini, config, "chord", False),
-                           ap)
+                           ap, mparams=mp)
     elif "kademlia" in overlay_type.lower():
         from oversim_tpu.overlay.kademlia import (KademliaLogic,
                                                   KademliaParams)
@@ -161,7 +215,7 @@ def build_simulation(ini: IniFile, config: str = "General",
         )
         logic = KademliaLogic(spec, params,
                               build_lookup_config(ini, config, "kademlia",
-                                                  True), ap)
+                                                  True), ap, mparams=mp)
     elif "pastry" in overlay_type.lower() or "bamboo" in overlay_type.lower():
         from oversim_tpu.overlay.pastry import (BambooLogic, PastryLogic,
                                                 PastryParams)
@@ -178,6 +232,77 @@ def build_simulation(ini: IniFile, config: str = "General",
         cls = BambooLogic if proto == "bamboo" else PastryLogic
         logic = cls(spec, params,
                     build_lookup_config(ini, config, proto, False), ap)
+    elif "koorde" in overlay_type.lower():
+        from oversim_tpu.overlay.koorde import KoordeLogic, KoordeParams
+        params = KoordeParams(
+            stabilize_delay=float(_get(
+                ini, config, "overlay.koorde.stabilizeDelay", 10.0)),
+            succ_size=int(_get(
+                ini, config, "overlay.koorde.successorListSize", 16)),
+            de_bruijn_delay=float(_get(
+                ini, config, "overlay.koorde.deBruijnDelay", 30.0)),
+            de_bruijn_size=int(_get(
+                ini, config, "overlay.koorde.deBruijnListSize", 16)),
+            shifting_bits=int(_get(
+                ini, config, "overlay.koorde.shiftingBits", 4)),
+        )
+        logic = KoordeLogic(spec, params, app=ap)
+    elif "broose" in overlay_type.lower():
+        from oversim_tpu.overlay.broose import BrooseLogic, BrooseParams
+        params = BrooseParams(
+            bucket_size=int(_get(
+                ini, config, "overlay.broose.bucketSize", 8)),
+            r_bucket_size=int(_get(
+                ini, config, "overlay.broose.rBucketSize", 8)),
+            shifting_bits=int(_value(
+                ini.get("**.brooseShiftingBits", config), 2)),
+            join_delay=float(_get(
+                ini, config, "overlay.broose.joinDelay", 10.0)),
+            refresh_time=float(_get(
+                ini, config, "overlay.broose.refreshTime", 180.0)),
+        )
+        logic = BrooseLogic(spec, params, app=ap)
+    elif "epichord" in overlay_type.lower():
+        from oversim_tpu.overlay.epichord import (EpiChordLogic,
+                                                  EpiChordParams)
+        params = EpiChordParams(
+            succ_size=int(_get(
+                ini, config, "overlay.epichord.successorListSize", 4)),
+            join_delay=float(_get(
+                ini, config, "overlay.epichord.joinDelay", 10.0)),
+            stabilize_delay=float(_get(
+                ini, config, "overlay.epichord.stabilizeDelay", 20.0)),
+            cache_flush_delay=float(_get(
+                ini, config, "overlay.epichord.cacheFlushDelay", 20.0)),
+            cache_check_mult=int(_get(
+                ini, config, "overlay.epichord.cacheCheckMultiplier", 3)),
+            cache_ttl=float(_get(
+                ini, config, "overlay.epichord.cacheTTL", 120.0)),
+            nodes_per_slice=int(_get(
+                ini, config, "overlay.epichord.nodesPerSlice", 2)),
+            redundant_nodes=int(_get(
+                ini, config, "overlay.epichord.lookupRedundantNodes", 3)),
+        )
+        logic = EpiChordLogic(spec, params,
+                              build_lookup_config(ini, config, "epichord",
+                                                  True), ap)
+    elif "gia" in overlay_type.lower():
+        from oversim_tpu.overlay.gia import GiaLogic, GiaParams
+        params = GiaParams(
+            min_neighbors=int(_get(
+                ini, config, "overlay.gia.minNeighbors", 3)),
+            max_neighbors=int(_get(
+                ini, config, "overlay.gia.maxNeighbors", 10)),
+            adapt_interval=float(_get(
+                ini, config, "overlay.gia.maxTopAdaptionInterval", 10.0)),
+            search_ttl=int(_get(
+                ini, config, "overlay.gia.maxHopCount", 20)),
+            max_responses=int(_get(
+                ini, config, "overlay.gia.maxResponses", 1)),
+            token_wait=float(_get(
+                ini, config, "overlay.gia.tokenWaitTime", 1.0)),
+        )
+        logic = GiaLogic(spec, params)
     else:
         raise ScenarioError(f"unsupported overlayType: {overlay_type!r}")
 

@@ -77,14 +77,45 @@ class Ctx:
     n_ready: jnp.ndarray      # i32 scalar
     measuring: jnp.ndarray    # bool scalar — inside measurement phase
     glob: object = None       # logic-global read-only state (see LogicBase)
+    # graceful-leave grace windows (engine/sim.py step; reference
+    # NF_OVERLAY_NODE_LEAVE / NF_OVERLAY_NODE_GRACEFUL_LEAVE):
+    leaving: object = None      # [N] bool — pre-killed, still running
+    graceful: object = None     # [N] bool — subset doing data handover
+    malicious: object = None    # [N] bool — byzantine attacker flags
+    # partition support (set only when the underlay defines >1 node type):
+    node_type: object = None    # [N] i32
+    conn: object = None         # [T, T] bool connectivity matrix
+    ready_cum_t: object = None  # [T, N] i32 per-type ready cumsums
 
-    def sample_ready(self, rng):
+    def sample_ready(self, rng, me=None):
         """Draw a uniformly random READY node slot (-1 if none).
 
         Oracle bootstrap draw, reference GlobalNodeList::getBootstrapNode
         (GlobalNodeList.h:115) / getRandomNode — O(1) via the per-type
         bootstrapped-peer vectors; here a searchsorted over the cumsum.
+
+        With partitions active and ``me`` given (the drawing node's slot),
+        the draw is restricted to node types connected to ``me``'s type
+        (the reference's per-type bootstrap vectors + connectionMatrix,
+        GlobalNodeList.h:232-235) so a partitioned node never bootstraps
+        across the cut.
         """
+        if self.conn is not None and me is not None:
+            my_type = self.node_type[me]
+            allowed = self.conn[my_type]                  # [T]
+            counts = self.ready_cum_t[:, -1]              # [T]
+            eff = jnp.where(allowed, counts, 0)
+            total = jnp.sum(eff)
+            k = jax.random.randint(rng, (), 0, jnp.maximum(total, 1),
+                                   dtype=I32)
+            cum_t = jnp.cumsum(eff)
+            tpick = jnp.searchsorted(cum_t, k + 1, side="left").astype(I32)
+            tpick = jnp.clip(tpick, 0, counts.shape[0] - 1)
+            within = k - jnp.where(tpick > 0, cum_t[jnp.maximum(tpick - 1, 0)],
+                                   0)
+            idx = jnp.searchsorted(self.ready_cum_t[tpick], within + 1,
+                                   side="left").astype(I32)
+            return jnp.where(total > 0, idx, NO_NODE)
         k = jax.random.randint(rng, (), 0, jnp.maximum(self.n_ready, 1),
                                dtype=I32)
         idx = jnp.searchsorted(self.ready_cumsum, k + 1, side="left").astype(I32)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from oversim_tpu import churn as churn_mod
 from oversim_tpu import stats as stats_mod
+from oversim_tpu.common.malicious import MaliciousParams
 from oversim_tpu.core import keys as keys_mod
 from oversim_tpu.engine import pool as pool_mod
 from oversim_tpu.engine.logic import Ctx, Msg
@@ -59,6 +60,8 @@ class EngineParams:
     rmax: int = 16                 # node-list payload width
     transition_time: float = 0.0   # default.ini:491
     measurement_time: float = -1.0  # default.ini:492 (-1 = unbounded)
+    # byzantine fault injection (common/malicious.py; default.ini:529-536)
+    malicious: MaliciousParams = MaliciousParams()
 
 
 @jax.tree_util.register_dataclass
@@ -72,13 +75,16 @@ class SimState:
     underlay: underlay_mod.UnderlayState
     pool: pool_mod.MsgPool
     churn: churn_mod.ChurnState
+    malicious: jnp.ndarray    # [N] bool — attacker flags (GlobalNodeList
+                              # malicious-node marks, default.ini:529-536)
     logic: object             # per-node logic state pytree
     stats: dict
     counters: dict            # engine drop/overflow counters
 
 
 ENGINE_COUNTERS = ("queue_lost", "bit_error_lost", "dest_unavailable_lost",
-                   "pool_overflow", "outbox_overflow", "inbox_deferred")
+                   "partition_lost", "pool_overflow", "outbox_overflow",
+                   "inbox_deferred")
 
 
 class Simulation:
@@ -98,7 +104,8 @@ class Simulation:
 
     def init(self, seed: int = 1) -> SimState:
         rng = jax.random.PRNGKey(seed)
-        r_keys, r_ul, r_churn, r_logic, r_run = jax.random.split(rng, 5)
+        (r_keys, r_ul, r_churn, r_logic, r_run,
+         r_mal) = jax.random.split(rng, 6)
         n = self.n
         node_keys = keys_mod.random_keys(r_keys, (n,), self.spec)
         return SimState(
@@ -111,6 +118,8 @@ class Simulation:
             pool=pool_mod.empty(self.ep.pool_factor * n, self.spec.lanes,
                                 self.ep.rmax),
             churn=churn_mod.init(r_churn, self.cp),
+            malicious=(jax.random.uniform(r_mal, (n,))
+                       < self.ep.malicious.probability),
             logic=self.logic.init(r_logic, n),
             stats=stats_mod.init_stats(self.logic.stat_spec()),
             counters={name: jnp.zeros((), I64) for name in ENGINE_COUNTERS},
@@ -138,10 +147,14 @@ class Simulation:
         (rng, r_churn, r_keys, r_reset, r_nodes, r_mig,
          r_send) = jax.random.split(s.rng, 7)
 
-        # 2. churn events
-        churn_state, created, killed = churn_mod.step(
+        # 2. churn events (incl. graceful-leave grace windows)
+        churn_state, created, killed, _leaving = churn_mod.step(
             s.churn, cp, s.alive, t_next, t_end, r_churn)
         alive = (s.alive | created) & ~killed
+        # pre-killed nodes run until their final kill but leave the
+        # bootstrap oracle immediately (preKillNode removePeer,
+        # SimpleUnderlayConfigurator.cc:350)
+        pre_killed = churn_state.t_dead < T_INF
         # created slots get fresh nodeIds (BaseOverlay::join draws a random
         # nodeId, BaseOverlay.cc:597-608) and fresh coordinates
         node_keys = jnp.where(
@@ -168,7 +181,7 @@ class Simulation:
             stamp=s.pool.stamp[safe])
 
         # 4. context + vmapped node step
-        ready = logic.ready_mask(logic_state) & alive
+        ready = logic.ready_mask(logic_state) & alive & ~pre_killed
         ready_cumsum = jnp.cumsum(ready.astype(I32))
         measure_start = jnp.int64(
             int((cp.init_finished_time + ep.transition_time) * NS))
@@ -180,9 +193,25 @@ class Simulation:
                 int(ep.measurement_time * NS))
         node_part, glob = (logic.split(logic_state)
                            if hasattr(logic, "split") else (logic_state, None))
+        # partition support: per-type ready cumsums + live conn matrix
+        # (GlobalNodeList per-type bootstrap vectors + connectionMatrix)
+        if up.num_node_types > 1:
+            conn = underlay_mod.connection_matrix(up, t_next)
+            tmask = (ul_state.node_type[None, :]
+                     == jnp.arange(up.num_node_types)[:, None])
+            ready_cum_t = jnp.cumsum(
+                (ready[None, :] & tmask).astype(I32), axis=1)
+            part_kw = dict(node_type=ul_state.node_type, conn=conn,
+                           ready_cum_t=ready_cum_t)
+        else:
+            part_kw = {}
         ctx = Ctx(t_start=t_next, t_end=t_end, keys=node_keys, alive=alive,
                   ready=ready, ready_cumsum=ready_cumsum,
-                  n_ready=ready_cumsum[-1], measuring=measuring, glob=glob)
+                  n_ready=ready_cumsum[-1], measuring=measuring, glob=glob,
+                  leaving=pre_killed & alive,
+                  graceful=pre_killed & alive & churn_state.graceful,
+                  malicious=s.malicious,
+                  **part_kw)
         node_rngs = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
             jax.random.fold_in(r_nodes, s.tick), jnp.arange(n))
         node_idx = jnp.arange(n, dtype=I32)
@@ -215,6 +244,7 @@ class Simulation:
         counters = dict(s.counters)
         counters["queue_lost"] += drops["queue_lost"]
         counters["bit_error_lost"] += drops["bit_error_lost"]
+        counters["partition_lost"] += drops["partition_lost"]
         counters["dest_unavailable_lost"] += (
             drops["dest_unavailable_lost"] + jnp.sum(to_dead))
         counters["pool_overflow"] += pool_overflow
@@ -227,7 +257,8 @@ class Simulation:
 
         return SimState(t_now=t_next, tick=s.tick + 1, rng=rng, alive=alive,
                         node_keys=node_keys, underlay=ul_state, pool=new_pool,
-                        churn=churn_state, logic=logic_state, stats=new_stats,
+                        churn=churn_state, malicious=s.malicious,
+                        logic=logic_state, stats=new_stats,
                         counters=counters)
 
     def _node_step(self, ctx, state_n, msgs_n, rng_n, node_idx):

@@ -1,0 +1,110 @@
+"""ctypes bindings for the native host components (native/*.c).
+
+The reference's runtime front-end is C++ (GlobalTraceManager's mmap
+reader, the OMNeT++ ini/NED machinery); the TPU rebuild keeps the hot
+host-side file path native too: ``native/tracescan.c`` scans trace
+files at memory bandwidth and this module exposes it as
+``scan_trace(path) -> list[TraceEvent]``.
+
+The shared library builds lazily with the system compiler on first use
+(`cc -O2 -shared -fPIC`); when no toolchain is available the caller
+falls back to the pure-Python parser (oversim_tpu/trace.py parse_trace)
+— same output, slower on million-line traces.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parent.parent
+_SRC = _ROOT / "native" / "tracescan.c"
+_SO = _ROOT / "native" / "tracescan.so"
+_lock = threading.Lock()
+_lib = None
+_failed = False
+
+_CMD_NAMES = ("JOIN", "LEAVE", "PUT", "GET",
+              "CONNECT_NODETYPES", "DISCONNECT_NODETYPES")
+
+
+class _TsEvent(ctypes.Structure):
+    _fields_ = [("time", ctypes.c_double),
+                ("node", ctypes.c_int32),
+                ("cmd", ctypes.c_int32),
+                ("arg0_off", ctypes.c_int64),
+                ("arg0_len", ctypes.c_int32),
+                ("arg1_off", ctypes.c_int64),
+                ("arg1_len", ctypes.c_int32)]
+
+
+def _build() -> bool:
+    if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return True
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", str(_SRC), "-o", str(_SO)],
+                capture_output=True, timeout=120)
+            if r.returncode == 0:
+                return True
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return False
+
+
+def _load():
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        if not _build():
+            _failed = True
+            return None
+        lib = ctypes.CDLL(str(_SO))
+        lib.ts_scan.restype = ctypes.c_void_p
+        lib.ts_scan.argtypes = [ctypes.c_char_p]
+        lib.ts_count.restype = ctypes.c_long
+        lib.ts_count.argtypes = [ctypes.c_void_p]
+        lib.ts_buf.restype = ctypes.c_void_p
+        lib.ts_buf.argtypes = [ctypes.c_void_p]
+        lib.ts_events.restype = ctypes.POINTER(_TsEvent)
+        lib.ts_events.argtypes = [ctypes.c_void_p]
+        lib.ts_free.restype = ctypes.c_long
+        lib.ts_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def scan_trace(path):
+    """Native trace scan → list of (time, node, cmd, args) tuples in the
+    shape trace.TraceEvent expects; None when the native path is
+    unavailable (caller falls back to the Python parser)."""
+    lib = _load()
+    if lib is None:
+        return None
+    handle = lib.ts_scan(str(path).encode())
+    if not handle:
+        return None
+    try:
+        n = lib.ts_count(handle)
+        evs = lib.ts_events(handle)
+        buf = lib.ts_buf(handle)
+        out = []
+        for i in range(n):
+            e = evs[i]
+            args = []
+            for off, ln in ((e.arg0_off, e.arg0_len),
+                            (e.arg1_off, e.arg1_len)):
+                if off >= 0 and ln > 0:
+                    args.append(ctypes.string_at(buf + off, ln).decode())
+            out.append((e.time, e.node, _CMD_NAMES[e.cmd], tuple(args)))
+        return out
+    finally:
+        lib.ts_free(handle)

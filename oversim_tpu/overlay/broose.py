@@ -1,0 +1,883 @@
+"""Broose de Bruijn DHT — XOR buckets + shift routing as vectorized logic.
+
+TPU-native rebuild of the reference Broose
+(src/overlay/broose/Broose.{h,cc} + BrooseBucket.{h,cc}; params
+default.ini:294-303: bucketSize 8, rBucketSize 8, shiftingBits 2,
+joinDelay 10s, refreshTime 180s, numberRetries 0, stab1 false,
+stab2 true), per "Broose: A Practical Distributed Hashtable Based on the
+De-Bruijn Topology" (Gai & Viennot).
+
+State per node (structure-of-arrays; every bucket kept XOR-sorted to its
+bucket key, so "closest" is entry 0 — reference BrooseBucket is a std::map
+keyed by XOR distance, BrooseBucket.cc:70-135):
+
+  * ``rb`` [N, 2^s, k'] — right buckets: contacts near (me >> s) + i·2^(B-s)
+    for each of the 2^s prefixes i (BrooseBucket::initializeBucket,
+    BrooseBucket.cc:49-68);
+  * ``lb`` [N, 2^s·k'] — left bucket: contacts near (me << s);
+  * ``bb`` [N, 7k]      — brother bucket: contacts near me; the k closest
+    are the sibling set (keyInRange, BrooseBucket.cc:239-258).
+
+Routing (Broose::findNode, Broose.cc:574-770): a lookup carries mutable
+state with the message — routeKey, signed step, right/left flag, last hop
+— in the lookup engine's opaque ext words (common/lookup.py; the Koorde
+pattern).  On initialization the hop distance is estimated from the
+longest shared prefix inside rBucket[0]/rBucket[1] (+1+userDist, rounded
+up to a multiple of shiftingBits) and the direction alternates per lookup
+(chooseLookup counter).  Each hop shifts ``shiftingBits`` bits into/out of
+the route key and forwards to the contact closest (XOR) to the updated
+route key from the L bucket (left), rBucket[prefix] (right), or the B
+bucket (step 0 = brother lookup).  isSiblingFor(key) = B-bucket range
+check: (key ^ me) <= XOR distance of the k-th closest brother.
+
+Join (Broose::changeState / handleBucketResponseRpc, Broose.cc:133-264,
+1010-1052): INIT routes 2^s BBucketCalls to the keys i·2^(B-s)+(me>>s)
+(here: 2^s iterative lookups seeded at the bootstrap node, each followed
+by a direct BUCKET_CALL to the responsible node); all 2^s responses →
+RSET, which pulls L buckets from every R-bucket contact (half must answer)
+→ BSET, which pulls L buckets from every brother (half must answer) →
+READY.  Deviations (documented): the RSET/BSET call fan-out is paced at
+``calls_per_tick`` per pacing-timer fire to respect the bounded outbox;
+per-BucketCall timeouts are replaced by a per-state deadline
+(``join_state_timeout``) that restarts the join from INIT — the
+reference restarts on any BucketCall timeout (handleBucketTimeout,
+Broose.cc:1055-1062).
+
+Maintenance: every refreshTime/2 the stalest entries are pinged
+(handleBucketTimerExpired, Broose.cc:318-341; bounded to ``ping_slots``
+concurrent pings); a ping/FindNode timeout removes the node from all
+buckets (routingTimeout with numberRetries=0, Broose.cc:1070-1079);
+every inbound message refreshes its sender (routingAdd alive,
+Broose.cc:914-916); FindNodeResponse contents are learned as unverified
+contacts (handleRpcResponse, Broose.cc:928-933).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps.kbrtest import KbrTestApp
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+UMAX = jnp.uint32(0xFFFFFFFF)
+
+# lifecycle (Broose States INIT→RSET→BSET→READY, Broose.cc:145-253)
+DEAD, INIT, RSET, BSET, READY = 0, 1, 2, 3, 4
+
+# lookup purposes
+P_JOINB, P_APP = 1, 3
+
+# BucketCall proState tags (BrooseMessage.msg PINIT/PRSET/PBSET;
+# PR_REFRESH is the periodic brother-bucket exchange)
+PR_INIT, PR_RSET, PR_BSET, PR_REFRESH = 0, 1, 2, 3
+# BucketCall bucket types
+BT_BROTHER, BT_LEFT = 0, 1
+
+SELF_HOPS = 2        # unrolled findNode self-recursion (Broose.cc:766-769)
+
+
+@dataclasses.dataclass(frozen=True)
+class BrooseParams:
+    """default.ini:294-303."""
+
+    bucket_size: int = 8          # k  — sibling count
+    r_bucket_size: int = 8        # k' — per-prefix right bucket
+    shifting_bits: int = 2
+    user_dist: int = 0
+    join_delay: float = 10.0
+    refresh_time: float = 180.0
+    number_retries: int = 0       # kept for parity; 0 = remove on timeout
+    rpc_timeout: float = 1.5
+    # engine-shape knobs (module docstring: deviations)
+    calls_per_tick: int = 4       # RSET/BSET fan-out pace
+    pace_delay: float = 0.5
+    ping_slots: int = 4
+    join_state_timeout: float = 20.0
+
+    @property
+    def pow_shift(self) -> int:
+        return 1 << self.shifting_bits
+
+    @property
+    def lb_size(self) -> int:
+        return self.pow_shift * self.r_bucket_size
+
+    @property
+    def bb_size(self) -> int:
+        return 7 * self.bucket_size
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BrooseState:
+    state: jnp.ndarray      # [N] i32
+    rb: jnp.ndarray         # [N, 2^s, k'] i32
+    rb_seen: jnp.ndarray    # [N, 2^s, k'] i64
+    lb: jnp.ndarray         # [N, LB] i32
+    lb_seen: jnp.ndarray    # [N, LB] i64
+    bb: jnp.ndarray         # [N, BB] i32
+    bb_seen: jnp.ndarray    # [N, BB] i64
+    choose: jnp.ndarray     # [N] i32 — chooseLookup direction alternator
+    t_join: jnp.ndarray     # [N] i64 — join + RSET/BSET pacing timer
+    t_bucket: jnp.ndarray   # [N] i64 — refresh timer
+    state_to: jnp.ndarray   # [N] i64 — join-state deadline
+    jb_recv: jnp.ndarray    # [N] i32 — BROTHER responses (INIT)
+    pr_recv: jnp.ndarray    # [N] i32 — PRSET responses
+    pr_need: jnp.ndarray    # [N] i32
+    pr_cursor: jnp.ndarray  # [N] i32 — next rb-flat index to call
+    pb_recv: jnp.ndarray    # [N] i32 — PBSET responses
+    pb_need: jnp.ndarray    # [N] i32
+    pb_cursor: jnp.ndarray  # [N] i32
+    ping_dst: jnp.ndarray   # [N, PP] i32
+    ping_to: jnp.ndarray    # [N, PP] i64
+    lk: lk_mod.LookupState
+    app: object
+    app_glob: object
+
+
+class BrooseLogic:
+    """Engine logic interface (engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: BrooseParams = BrooseParams(),
+                 lcfg: lk_mod.LookupConfig | None = None,
+                 app=None):
+        self.key_spec = spec
+        self.p = params
+        ew = spec.lanes + 3
+        self.lcfg = lcfg or lk_mod.LookupConfig(slots=8, ext_words=ew)
+        if self.lcfg.ext_words != ew:
+            raise ValueError("Broose needs ext_words == key lanes + 3")
+        if params.shifting_bits > spec.top_lane_bits:
+            raise ValueError("shiftingBits must fit in the top key lane")
+        self.app = app or KbrTestApp()
+        # static: keyLength rounded down to a shifting_bits multiple
+        self.max_dist = spec.bits - spec.bits % params.shifting_bits
+
+    # -- engine interface ---------------------------------------------------
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        app = self.app.stat_spec()
+        return stats_mod.StatSpec(
+            scalars=tuple(app["scalars"]) + ("lookup_hops",),
+            hists=tuple(app["hists"]),
+            counters=tuple(app["counters"]) + (
+                "broose_joins", "broose_join_retries", "lookup_success",
+                "lookup_failed"),
+        )
+
+    def split(self, st: BrooseState):
+        return dataclasses.replace(st, app_glob=None), st.app_glob
+
+    def merge(self, node_part: BrooseState, glob):
+        return dataclasses.replace(node_part, app_glob=glob)
+
+    def post_step(self, ctx, st: BrooseState, events):
+        app, glob = self.app.post_step(ctx, st.app, st.app_glob, events)
+        return dataclasses.replace(st, app=app, app_glob=glob)
+
+    def init(self, rng, n: int) -> BrooseState:
+        p = self.p
+        return BrooseState(
+            state=jnp.zeros((n,), I32),
+            rb=jnp.full((n, p.pow_shift, p.r_bucket_size), NO_NODE, I32),
+            rb_seen=jnp.zeros((n, p.pow_shift, p.r_bucket_size), I64),
+            lb=jnp.full((n, p.lb_size), NO_NODE, I32),
+            lb_seen=jnp.zeros((n, p.lb_size), I64),
+            bb=jnp.full((n, p.bb_size), NO_NODE, I32),
+            bb_seen=jnp.zeros((n, p.bb_size), I64),
+            choose=jnp.zeros((n,), I32),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_bucket=jnp.full((n,), T_INF, I64),
+            state_to=jnp.full((n,), T_INF, I64),
+            jb_recv=jnp.zeros((n,), I32),
+            pr_recv=jnp.zeros((n,), I32),
+            pr_need=jnp.zeros((n,), I32),
+            pr_cursor=jnp.zeros((n,), I32),
+            pb_recv=jnp.zeros((n,), I32),
+            pb_need=jnp.zeros((n,), I32),
+            pb_cursor=jnp.zeros((n,), I32),
+            ping_dst=jnp.full((n, p.ping_slots), NO_NODE, I32),
+            ping_to=jnp.full((n, p.ping_slots), T_INF, I64),
+            lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
+                jnp.arange(n)),
+            app=self.app.init(n),
+            app_glob=self.app.glob_init(rng),
+        )
+
+    def reset(self, st: BrooseState, clear, join, t_now, rng) -> BrooseState:
+        n = st.state.shape[0]
+        glob = st.app_glob
+        st = dataclasses.replace(st, app_glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), app_glob=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, app_glob=glob)
+        jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, INIT, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: BrooseState):
+        return st.state == READY
+
+    def next_event(self, st: BrooseState):
+        joining = (st.state >= INIT) & (st.state < READY)
+        ready = st.state == READY
+        t = jnp.where(joining, st.t_join, T_INF)
+        t = jnp.minimum(t, st.state_to)
+        t = jnp.minimum(t, jnp.where(ready, st.t_bucket, T_INF))
+        t = jnp.minimum(t, jnp.min(st.ping_to, axis=-1))
+        t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
+                                     T_INF))
+        t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        return t
+
+    # -- bucket machinery ---------------------------------------------------
+
+    def _bucket_keys(self, me_key):
+        """(rb_keys [2^s, KL], lb_key, bb_key) — BrooseBucket bucket keys
+        (BrooseBucket::initializeBucket, BrooseBucket.cc:49-68)."""
+        p, spec = self.p, self.key_spec
+        shr = K.shr_const(me_key, p.shifting_bits, spec)
+        rb_keys = jnp.stack([
+            K.add(shr, K.from_int(i << (spec.bits - p.shifting_bits), spec),
+                  spec)
+            for i in range(p.pow_shift)])
+        lb_key = K.shl_const(me_key, p.shifting_bits, spec)
+        return rb_keys, lb_key, me_key
+
+    def _xor_to(self, ctx, slots, key):
+        ck = ctx.keys[jnp.maximum(slots, 0)]
+        d = ck ^ jnp.broadcast_to(key, ck.shape)
+        return jnp.where((slots == NO_NODE)[..., None], UMAX, d)
+
+    def _bkt_put(self, ctx, bkey, arr, seen, cands, cseen):
+        """Merge candidate slots into one XOR-sorted bucket row.
+
+        ``cands`` [C] may contain NO_NODE/duplicates; existing entries win
+        their stored lastSeen unless a candidate duplicates them with a
+        newer one (BrooseBucket::add, BrooseBucket.cc:70-135: insert if
+        closer than the current farthest or bucket not full)."""
+        cap = arr.shape[0]
+        aug = jnp.concatenate([arr, cands])
+        aseen = jnp.concatenate([seen, cseen])
+        # newer lastSeen for duplicated existing entries
+        match = (arr[:, None] == cands[None, :]) & (cands != NO_NODE)[None, :]
+        upd = jnp.max(jnp.where(match, cseen[None, :], 0), axis=1)
+        aseen = aseen.at[:cap].set(jnp.maximum(seen, upd))
+        dup = K.dup_mask(aug) | (aug == NO_NODE)
+        aug = jnp.where(dup, NO_NODE, aug)
+        d = self._xor_to(ctx, aug, bkey)
+        _, (aug_s, seen_s) = K.sort_by_distance(d, (aug, aseen))
+        return aug_s[:cap], jnp.where(aug_s[:cap] == NO_NODE, 0, seen_s[:cap])
+
+    def _routing_add(self, ctx, st, me_key, node_idx, cands, alive, now):
+        """routingAdd to every bucket (Broose.cc:1081-1091).  ``cands``
+        [C] slots, ``alive`` scalar or [C] bool."""
+        p = self.p
+        cands = jnp.atleast_1d(jnp.asarray(cands, I32))
+        alive = jnp.broadcast_to(jnp.asarray(alive), cands.shape)
+        cands = jnp.where(cands == node_idx, NO_NODE, cands)
+        cseen = jnp.where(alive & (cands != NO_NODE), now, 0).astype(I64)
+        rb_keys, lb_key, bb_key = self._bucket_keys(me_key)
+        rb, rb_seen = jax.vmap(
+            lambda bk, a, s: self._bkt_put(ctx, bk, a, s, cands, cseen))(
+                rb_keys, st.rb, st.rb_seen)
+        lb, lb_seen = self._bkt_put(ctx, lb_key, st.lb, st.lb_seen, cands,
+                                    cseen)
+        bb, bb_seen = self._bkt_put(ctx, bb_key, st.bb, st.bb_seen, cands,
+                                    cseen)
+        return dataclasses.replace(st, rb=rb, rb_seen=rb_seen, lb=lb,
+                                   lb_seen=lb_seen, bb=bb, bb_seen=bb_seen)
+
+    def _remove_node(self, ctx, st, me_key, node_idx, bad):
+        """Drop ``bad`` [F] slots from all buckets and re-compact
+        (routingTimeout with numberRetries=0, Broose.cc:1070-1079)."""
+        bad = jnp.atleast_1d(bad)
+        any_bad = jnp.any(bad != NO_NODE)
+
+        def hit(x):
+            return (x[..., None] == bad).any(-1) & (x != NO_NODE)
+
+        rb = jnp.where(hit(st.rb), NO_NODE, st.rb)
+        lb = jnp.where(hit(st.lb), NO_NODE, st.lb)
+        bb = jnp.where(hit(st.bb), NO_NODE, st.bb)
+        rb_keys, lb_key, bb_key = self._bucket_keys(me_key)
+        none = jnp.full((1,), NO_NODE, I32)
+        zer = jnp.zeros((1,), I64)
+        rb, rb_seen = jax.vmap(
+            lambda bk, a, s: self._bkt_put(ctx, bk, a, s, none, zer))(
+                rb_keys, rb, st.rb_seen)
+        lb, lb_seen = self._bkt_put(ctx, lb_key, lb, st.lb_seen, none, zer)
+        bb, bb_seen = self._bkt_put(ctx, bb_key, bb, st.bb_seen, none, zer)
+        return select_tree(
+            any_bad,
+            dataclasses.replace(st, rb=rb, rb_seen=rb_seen, lb=lb,
+                                lb_seen=lb_seen, bb=bb, bb_seen=bb_seen),
+            st)
+
+    def _longest_prefix(self, ctx, arr):
+        """sharedPrefixLength of a bucket's closest and farthest entries
+        (BrooseBucket::longestPrefix, BrooseBucket.cc:202-209); buckets
+        are kept XOR-sorted so those are the first/last valid entries."""
+        n = jnp.sum((arr != NO_NODE).astype(I32))
+        first = arr[0]
+        last = arr[jnp.clip(n - 1, 0, arr.shape[0] - 1)]
+        spl = K.shared_prefix_length(
+            ctx.keys[jnp.maximum(first, 0)], ctx.keys[jnp.maximum(last, 0)],
+            self.key_spec)
+        return jnp.where(n < 2, 0, spl).astype(I32)
+
+    def _is_sibling(self, ctx, st, me_key, key):
+        """bBucket keyInRange (BrooseBucket.cc:239-258): true when
+        (key ^ me) <= XOR distance of the k-th closest brother.
+
+        The reference inserts thisNode into every bucket on READY
+        (changeState(READY), Broose.cc:237-240); here self is an implicit
+        rank-0 member (XOR distance 0), so the k-th closest overall is
+        the stored bucket's (k-1)-th entry — and a lone bootstrap node
+        (empty bb) is sibling for everything."""
+        p, spec = self.p, self.key_spec
+        nb = jnp.sum((st.bb != NO_NODE).astype(I32)) + 1   # + self
+        kth = st.bb[jnp.clip(p.bucket_size - 2, 0, p.bb_size - 1)]
+        dist = ctx.keys[jnp.maximum(kth, 0)] ^ me_key
+        close = K.le(key ^ me_key, dist)
+        return (st.state == READY) & ((nb <= p.bucket_size) | close)
+
+    # -- findNode (Broose.cc:574-770) ---------------------------------------
+
+    def _unpack_ext(self, ext):
+        spec = self.key_spec
+        rk = jax.lax.bitcast_convert_type(ext[:spec.lanes], U32)
+        return rk, ext[spec.lanes], ext[spec.lanes + 1], ext[spec.lanes + 2]
+
+    def _pack_ext(self, rk, step, flags, last):
+        return jnp.concatenate([
+            jax.lax.bitcast_convert_type(rk, I32),
+            jnp.stack([jnp.asarray(step, I32), jnp.asarray(flags, I32),
+                       jnp.asarray(last, I32)])])
+
+    def _init_ext(self, ctx, st, me_key, node_idx, key):
+        """First findNode evaluation initializes the ext (Broose.cc:622-668):
+        estimate the hop distance from the R buckets' longest shared
+        prefixes and alternate the shifting direction per lookup."""
+        p, spec = self.p, self.key_spec
+        s = p.shifting_bits
+        dist = jnp.maximum(self._longest_prefix(ctx, st.rb[0]),
+                           self._longest_prefix(ctx, st.rb[1])) + 1 \
+            + p.user_dist
+        dist = dist + (s - dist % s) % s
+        dist = jnp.minimum(dist, self.max_dist)
+        left = st.choose % 2 == 0
+        # left: routeKey = (key >> dist) + me's top dist bits in place
+        me_top = K.shl_dyn(K.shr_dyn(me_key, spec.bits - dist, spec),
+                           spec.bits - dist, spec)
+        rk_left = K.add(K.shr_dyn(key, dist, spec), me_top, spec)
+        rk = jnp.where(left, rk_left, me_key)
+        step = jnp.where(left, -dist, dist)
+        flags = jnp.where(left, 1, 3).astype(I32)   # bit0 init, bit1 right
+        return rk, step, flags
+
+    def _eval_once(self, ctx, st, me_key, node_idx, key, rk, step, right,
+                   rmax):
+        """One shifting-hop evaluation: returns (res [rmax] sorted
+        candidates, rk', step')."""
+        p, spec = self.p, self.key_spec
+        s = p.shifting_bits
+        brother = step == 0
+        # left hop (Broose.cc:697-727)
+        rk_l = K.shl_const(rk, s, spec)
+        step_l = step + s
+        # right hop (Broose.cc:728-764): prefix = s key bits at MSB
+        # positions [dist-s, dist-1] → MSB digit index dist/s - 1
+        di = jnp.maximum(step // s - 1, 0)
+        pfx = K.digit(key, di, s, spec)
+        top = jnp.zeros((spec.lanes,), U32).at[0].set(
+            pfx.astype(U32) << (spec.top_lane_bits - s))
+        rk_r = K.add(K.shr_const(rk, s, spec), top, spec)
+        step_r = step - s
+
+        rk2 = jnp.where(brother, rk, jnp.where(right, rk_r, rk_l))
+        step2 = jnp.where(brother, step, jnp.where(right, step_r, step_l))
+        # candidate bucket: bb (brother) / rb[pfx] (right) / lb (left),
+        # plus self; sorted by XOR to key (brother) or new route key
+        pad = max(p.bb_size, p.lb_size, p.r_bucket_size) + 1
+
+        def padded(v):
+            return jnp.concatenate(
+                [v, jnp.full((pad - v.shape[0],), NO_NODE, I32)])
+
+        cands = jnp.where(
+            brother, padded(jnp.concatenate([st.bb, node_idx[None]])),
+            jnp.where(right,
+                      padded(jnp.concatenate([st.rb[pfx], node_idx[None]])),
+                      padded(jnp.concatenate([st.lb, node_idx[None]]))))
+        sort_key = jnp.where(brother, key, rk2)
+        d = self._xor_to(ctx, cands, sort_key)
+        d = jnp.where(K.dup_mask(cands)[:, None], UMAX, d)
+        _, (cands_s,) = K.sort_by_distance(d, (cands,))
+        res = cands_s[:rmax]
+        if res.shape[0] < rmax:
+            res = jnp.concatenate(
+                [res, jnp.full((rmax - res.shape[0],), NO_NODE, I32)])
+        return res, rk2, step2
+
+    def _eval_find(self, ctx, st, me_key, node_idx, key, ext, rmax):
+        """Full findNode evaluation against this node's buckets.
+
+        Returns (res [rmax], is_sib, ext_out, answerable, inited).
+        ``answerable`` is false in INIT/RSET (reference findNode returns
+        an empty vector, Broose.cc:578-580) and for left-shifting hops in
+        BSET (Broose.cc:699-701)."""
+        p, spec = self.p, self.key_spec
+        rk_in, step_in, flags, _last = self._unpack_ext(ext)
+        need_init = (flags & 1) == 0
+        rk0, step0, flags0 = self._init_ext(ctx, st, me_key, node_idx, key)
+        rk = jnp.where(need_init, rk0, rk_in)
+        step = jnp.where(need_init, step0, step_in)
+        flags = jnp.where(need_init, flags0, flags)
+        right = (flags & 2) != 0
+
+        is_sib = self._is_sibling(ctx, st, me_key, key)
+        # sibling result: brothers + self by XOR to key (Broose.cc:598-620)
+        sib_set, _, _ = self._eval_once(ctx, st, me_key, node_idx, key,
+                                        rk, jnp.int32(0), right, rmax)
+
+        # self-recursion unrolled (Broose.cc:766-769): while the best
+        # candidate is this node itself, take another shifting hop
+        res, rk_c, step_c = self._eval_once(ctx, st, me_key, node_idx, key,
+                                            rk, step, right, rmax)
+        for _ in range(SELF_HOPS - 1):
+            again = res[0] == node_idx
+            res2, rk2, step2 = self._eval_once(ctx, st, me_key, node_idx,
+                                               key, rk_c, step_c, right,
+                                               rmax)
+            res = jnp.where(again, res2, res)
+            rk_c = jnp.where(again, rk2, rk_c)
+            step_c = jnp.where(again, step2, step_c)
+
+        left_hop = ~right & (step != 0)
+        answerable = ((st.state == READY)
+                      | ((st.state == BSET) & ~left_hop))
+        res = jnp.where(answerable, res, NO_NODE)
+        ext_out = self._pack_ext(rk_c, step_c, flags, node_idx)
+        return jnp.where(is_sib, sib_set, res), is_sib, ext_out, answerable, \
+            need_init
+
+    # -- failure/ready hooks ------------------------------------------------
+
+    def _handle_failed(self, ctx, st, me_key, node_idx, failed):
+        return self._remove_node(ctx, st, me_key, node_idx, failed)
+
+    def _restart_join_node(self, st, en, now, rng):
+        """Back to INIT: clear buckets and counters, redraw bootstrap at
+        the next join-timer fire (changeState(INIT), Broose.cc:148-173).
+        Per-node form (all leaves are one node's slice)."""
+        jitter = (jax.random.uniform(rng, ()) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(en, INIT, st.state),
+            rb=jnp.where(en, NO_NODE, st.rb),
+            rb_seen=jnp.where(en, 0, st.rb_seen),
+            lb=jnp.where(en, NO_NODE, st.lb),
+            lb_seen=jnp.where(en, 0, st.lb_seen),
+            bb=jnp.where(en, NO_NODE, st.bb),
+            bb_seen=jnp.where(en, 0, st.bb_seen),
+            jb_recv=jnp.where(en, 0, st.jb_recv),
+            pr_recv=jnp.where(en, 0, st.pr_recv),
+            pb_recv=jnp.where(en, 0, st.pb_recv),
+            t_join=jnp.where(en, now + jitter, st.t_join),
+            state_to=jnp.where(en, T_INF, st.state_to))
+
+    def _become_ready(self, ctx, st, en, now, rng):
+        p = self.p
+        return dataclasses.replace(
+            st,
+            state=jnp.where(en, READY, st.state),
+            t_join=jnp.where(en, T_INF, st.t_join),
+            state_to=jnp.where(en, T_INF, st.state_to),
+            t_bucket=jnp.where(
+                en, now + jnp.int64(int(p.refresh_time / 2 * NS)),
+                st.t_bucket),
+            app=self.app.on_ready(st.app, en, now, rng))
+
+    def _paced_calls(self, st, ob, en, now, arr, cursor, pro_state):
+        """Send up to calls_per_tick BUCKET_CALL(LEFT, pro_state) to the
+        valid entries of ``arr`` starting at ``cursor`` (the paced RSET/
+        BSET fan-out; module docstring).  Returns new cursor."""
+        p = self.p
+        valid = (arr != NO_NODE) & ~K.dup_mask(arr)
+        idx = jnp.arange(arr.shape[0], dtype=I32)
+        elig = valid & (idx >= cursor)
+        cum = jnp.cumsum(elig.astype(I32))
+        last_sent = cursor
+        for j in range(p.calls_per_tick):
+            pick = elig & (cum == j + 1)
+            hit = jnp.any(pick)
+            tgt = arr[jnp.argmax(pick)]
+            ob.send(en & hit, now, tgt, wire.BROOSE_BUCKET_CALL,
+                    a=jnp.int32(BT_LEFT), b=jnp.int32(pro_state),
+                    size_b=wire.BASE_CALL_B + 2)
+            last_sent = jnp.where(en & hit, idx[jnp.argmax(pick)] + 1,
+                                  last_sent)
+        return jnp.where(en, last_sent, cursor)
+
+    # -- the per-node step ---------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, lcfg, spec = self.p, self.lcfg, self.key_spec
+        s = p.shifting_bits
+        ew = lcfg.ext_words
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rngs = jax.random.split(rng, 9)
+        t0 = ctx.t_start
+        t_end = ctx.t_end
+        pace_ns = jnp.int64(int(p.pace_delay * NS))
+        state_to_ns = jnp.int64(int(p.join_state_timeout * NS))
+
+        def metric_fn(cand_slots, target):
+            return self._xor_to(ctx, cand_slots, target)
+
+        ev = app_base.AppEvents()
+        joins_cnt = jnp.int32(0)
+        retries_cnt = jnp.int32(0)
+        anyfail_cnt = jnp.int32(0)
+        lksucc_cnt = jnp.int32(0)
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # every inbound message refreshes its sender (routingAdd alive,
+            # Broose.cc:840-846, 914-916).  Gated on the sender being
+            # READY: in the reference a joining node never emits FindNode
+            # itself — its join calls are proxy-routed by the bootstrap
+            # node (sendRouteRpcCall via bootstrapNode, Broose.cc:296-303)
+            # — so joiners must not enter anyone's routing buckets, or
+            # walks forward into non-answering INIT nodes and die
+            st = select_tree(
+                v & ctx.ready[jnp.maximum(m.src, 0)],
+                self._routing_add(ctx, st, me_key, node_idx, m.src,
+                                  jnp.bool_(True), now), st)
+
+            # FindNodeCall → shift-routing evaluation.  Only BSET/READY
+            # answer (handleRpcCall, Broose.cc:878-909)
+            en = v & (m.kind == wire.FINDNODE_CALL)
+            ext_in = m.nodes[:ew]
+            res, sib, ext_out, ok, _ = self._eval_find(
+                ctx, st, me_key, node_idx, m.key, ext_in, rmax)
+            # learn the previous hop from the ext (Broose.cc:673-680;
+            # READY-gated like every learn — see above)
+            _, _, _, last = self._unpack_ext(ext_in)
+            st = select_tree(
+                en & (last != NO_NODE) & ctx.ready[jnp.maximum(last, 0)],
+                self._routing_add(ctx, st, me_key, node_idx, last,
+                                  jnp.bool_(True), now), st)
+            res = jnp.where(sib, res, res.at[rmax - ew:].set(ext_out))
+            n_res = jnp.sum((res != NO_NODE).astype(I32))
+            ob.send(en & ok, now, m.src, wire.FINDNODE_RES, key=m.key,
+                    a=m.a, b=m.b, c=sib.astype(I32), nodes=res,
+                    size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
+
+            # FindNodeResponse → lookup engine + unverified learns
+            en = v & (m.kind == wire.FINDNODE_RES)
+            st = dataclasses.replace(st, lk=lk_mod.on_response(
+                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
+            learned = m.nodes[:lcfg.frontier]
+            l_ok = (learned != NO_NODE) & ctx.ready[jnp.maximum(learned, 0)]
+            st = select_tree(
+                en, self._routing_add(ctx, st, me_key, node_idx,
+                                      jnp.where(l_ok, learned, NO_NODE),
+                                      l_ok, now), st)
+
+            # BucketCall server (handleBucketRequestRpc, Broose.cc:962-1008)
+            en = v & (m.kind == wire.BROOSE_BUCKET_CALL) & (
+                (st.state == BSET) | (st.state == READY))
+            is_left = m.a == BT_LEFT
+            lb_pad = jnp.concatenate(
+                [st.lb, jnp.full((max(p.bb_size - p.lb_size, 0),), NO_NODE,
+                                 I32)])[:p.bb_size]
+            src_bucket = jnp.where(is_left, lb_pad, st.bb)
+            nb_src = jnp.where(is_left,
+                               jnp.sum((st.lb != NO_NODE).astype(I32)),
+                               jnp.sum((st.bb != NO_NODE).astype(I32)))
+            payload = jnp.full((rmax,), NO_NODE, I32)
+            take = min(rmax, p.bb_size)
+            payload = payload.at[:take].set(src_bucket[:take])
+            payload = jnp.where(jnp.arange(rmax) < jnp.minimum(nb_src, rmax),
+                                payload, NO_NODE)
+            ob.send(en, now, m.src, wire.BROOSE_BUCKET_RES, a=m.a, b=m.b,
+                    nodes=payload,
+                    size_b=wire.BASE_CALL_B
+                    + wire.NODEHANDLE_B * min(rmax, p.bb_size))
+
+            # BucketResponse → join state machine
+            # (handleBucketResponseRpc, Broose.cc:1010-1052)
+            en = v & (m.kind == wire.BROOSE_BUCKET_RES)
+            learned = m.nodes[:rmax]
+            lb_ok = (learned[:lcfg.frontier] != NO_NODE) \
+                & ctx.ready[jnp.maximum(learned[:lcfg.frontier], 0)]
+            st = select_tree(
+                en, self._routing_add(
+                    ctx, st, me_key, node_idx,
+                    jnp.where(lb_ok, learned[:lcfg.frontier], NO_NODE),
+                    lb_ok, now), st)
+            # INIT: BROTHER/PINIT responses
+            hit_i = en & (st.state == INIT) & (m.b == PR_INIT)
+            jb = st.jb_recv + hit_i.astype(I32)
+            to_rset = hit_i & (jb >= p.pow_shift)
+            # RSET: LEFT/PRSET responses
+            hit_r = en & (st.state == RSET) & (m.b == PR_RSET)
+            pr = st.pr_recv + hit_r.astype(I32)
+            to_bset = hit_r & (pr >= st.pr_need)
+            # BSET: LEFT/PBSET responses
+            hit_b = en & (st.state == BSET) & (m.b == PR_BSET)
+            pb = st.pb_recv + hit_b.astype(I32)
+            to_ready = hit_b & (pb >= st.pb_need)
+            # state-entry bookkeeping
+            rb_flat = st.rb.reshape(-1)
+            n_rb = jnp.sum(((rb_flat != NO_NODE)
+                            & ~K.dup_mask(rb_flat)).astype(I32))
+            n_bb = jnp.sum((st.bb != NO_NODE).astype(I32))
+            st = dataclasses.replace(
+                st,
+                jb_recv=jb,
+                pr_recv=jnp.where(to_rset, 0, pr),
+                pb_recv=jnp.where(to_bset, 0, pb),
+                state=jnp.where(to_rset, RSET,
+                                jnp.where(to_bset, BSET, st.state)),
+                pr_need=jnp.where(to_rset, (n_rb + 1) // 2,
+                                  st.pr_need).astype(I32),
+                pr_cursor=jnp.where(to_rset, 0, st.pr_cursor),
+                pb_need=jnp.where(to_bset, (n_bb + 1) // 2,
+                                  st.pb_need).astype(I32),
+                pb_cursor=jnp.where(to_bset, 0, st.pb_cursor),
+                t_join=jnp.where(to_rset | to_bset, now, st.t_join),
+                state_to=jnp.where(to_rset | to_bset, now + state_to_ns,
+                                   st.state_to))
+            joins_cnt += to_ready.astype(I32)
+            st = self._become_ready(ctx, st, to_ready, now, rngs[0])
+
+            # app-owned kinds
+            sib_app = self._is_sibling(ctx, st, me_key, m.key)
+            st = dataclasses.replace(st, app=self.app.on_msg(
+                st.app, m, ctx, ob, ev, sib_app))
+
+            # pings (refresh liveness)
+            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
+                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+            en = v & (m.kind == wire.PING_RES)
+            phit = en & (st.ping_dst == m.src)
+            st = dataclasses.replace(
+                st,
+                ping_dst=jnp.where(phit, NO_NODE, st.ping_dst),
+                ping_to=jnp.where(phit, T_INF, st.ping_to))
+
+        # ------------------------------------------------------- timers ----
+        # join timer in INIT (handleJoinTimerExpired, Broose.cc:268-318):
+        # 2^s lookups for i·2^(B-s) + (me >> s), seeded at the bootstrap
+        en_j = (st.state == INIT) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1], node_idx)
+        no_jb = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOINB))
+        alone = en_j & (boot == NO_NODE)
+        joins_cnt += alone.astype(I32)
+        st = self._become_ready(ctx, st, alone, now_j, rngs[2])
+        fire_j = en_j & ~alone & no_jb & (
+            lk_mod.num_free(st.lk) >= p.pow_shift)
+        shr_me = K.shr_const(me_key, s, spec)
+        for i in range(p.pow_shift):
+            tgt_key = K.add(shr_me, K.from_int(i << (spec.bits - s), spec),
+                            spec)
+            slot, have = lk_mod.free_slot(st.lk)
+            seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(boot)
+            ext0 = self._pack_ext(jnp.zeros((spec.lanes,), U32),
+                                  jnp.int32(0), jnp.int32(0), node_idx)
+            st = dataclasses.replace(st, lk=lk_mod.start(
+                st.lk, fire_j & have, slot, P_JOINB, i, tgt_key, seed,
+                now_j, lcfg, ext=ext0))
+        st = dataclasses.replace(
+            st,
+            t_join=jnp.where(en_j & ~alone,
+                             now_j + jnp.int64(int(p.join_delay * NS)),
+                             st.t_join),
+            state_to=jnp.where(fire_j, now_j + state_to_ns, st.state_to),
+            jb_recv=jnp.where(fire_j, 0, st.jb_recv))
+
+        # pacing timer in RSET/BSET: next batch of LBucket calls
+        en_p = (st.state == RSET) & (st.t_join < t_end)
+        now_p = jnp.maximum(st.t_join, t0)
+        cur = self._paced_calls(st, ob, en_p, now_p, st.rb.reshape(-1),
+                                st.pr_cursor, PR_RSET)
+        more = cur > st.pr_cursor
+        st = dataclasses.replace(
+            st, pr_cursor=cur,
+            t_join=jnp.where(en_p, jnp.where(more, now_p + pace_ns, T_INF),
+                             st.t_join))
+        en_p = (st.state == BSET) & (st.t_join < t_end)
+        now_p = jnp.maximum(st.t_join, t0)
+        cur = self._paced_calls(st, ob, en_p, now_p, st.bb, st.pb_cursor,
+                                PR_BSET)
+        more = cur > st.pb_cursor
+        st = dataclasses.replace(
+            st, pb_cursor=cur,
+            t_join=jnp.where(en_p, jnp.where(more, now_p + pace_ns, T_INF),
+                             st.t_join))
+
+        # join-state deadline → restart from INIT (module docstring)
+        en_d = (st.state >= INIT) & (st.state < READY) & (
+            st.state_to < t_end)
+        retries_cnt += en_d.astype(I32)
+        st = self._restart_join_node(st, en_d, jnp.maximum(st.state_to, t0),
+                                     rngs[3])
+
+        # refresh timer (handleBucketTimerExpired, Broose.cc:318-341):
+        # ping the stalest entries; bounded concurrent pings
+        en_b = (st.state == READY) & (st.t_bucket < t_end)
+        now_b = jnp.maximum(st.t_bucket, t0)
+        refresh_ns = jnp.int64(int(p.refresh_time * NS))
+        all_e = jnp.concatenate([st.rb.reshape(-1), st.lb, st.bb])
+        all_seen = jnp.concatenate([st.rb_seen.reshape(-1), st.lb_seen,
+                                    st.bb_seen])
+        stale = (all_e != NO_NODE) & ~K.dup_mask(all_e) & (
+            all_seen + refresh_ns < now_b)
+        order = jnp.argsort(jnp.where(stale, all_seen, T_INF))
+        for j in range(p.ping_slots):
+            free = st.ping_dst[j] == NO_NODE
+            tgt = all_e[order[j]]
+            fire = en_b & free & stale[order[j]]
+            ob.send(fire, now_b, tgt, wire.PING_CALL,
+                    size_b=wire.BASE_CALL_B)
+            st = dataclasses.replace(
+                st,
+                ping_dst=st.ping_dst.at[j].set(
+                    jnp.where(fire, tgt, st.ping_dst[j])),
+                ping_to=st.ping_to.at[j].set(
+                    jnp.where(fire, now_b + jnp.int64(
+                        int(p.rpc_timeout * NS)), st.ping_to[j])))
+        # periodic brother-bucket exchange: pull a random brother's B
+        # bucket so the sibling set keeps converging (the reference
+        # refreshes via its continuous BucketCall traffic; with learns
+        # READY-gated an explicit pull keeps bb complete)
+        nbb = jnp.sum((st.bb != NO_NODE).astype(I32))
+        pick = jax.random.randint(rngs[7], (), 0, jnp.maximum(nbb, 1),
+                                  dtype=I32)
+        btgt = st.bb[jnp.clip(pick, 0, p.bb_size - 1)]
+        ob.send(en_b & (btgt != NO_NODE), now_b, btgt,
+                wire.BROOSE_BUCKET_CALL, a=jnp.int32(BT_BROTHER),
+                b=jnp.int32(PR_REFRESH), size_b=wire.BASE_CALL_B + 2)
+        st = dataclasses.replace(st, t_bucket=jnp.where(
+            en_b, now_b + refresh_ns // 2, st.t_bucket))
+
+        # ping timeouts → remove from all buckets
+        pto = st.ping_to < t_end
+        ping_failed = jnp.where(pto, st.ping_dst, NO_NODE)
+        st = dataclasses.replace(
+            st,
+            ping_dst=jnp.where(pto, NO_NODE, st.ping_dst),
+            ping_to=jnp.where(pto, T_INF, st.ping_to))
+        st = self._handle_failed(ctx, st, me_key, node_idx, ping_failed)
+
+        # app timer
+        # graceful-leave: hand app data to the closest brother and stop
+        # firing app tests during the grace window (apps/base.py on_leave)
+        st = dataclasses.replace(st, app=app_base.leave_protocol(
+            self.app, st.app, ctx, ob, ev, t0, node_idx, st.bb[0],
+            st.state == READY))
+        en_a = (st.state == READY) & (
+            self.app.next_event(st.app) < t_end)
+        now_a = jnp.maximum(self.app.next_event(st.app), t0)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[4], ev, node_idx)
+        st = dataclasses.replace(st, app=app)
+        ext_a = self._pack_ext(jnp.zeros((spec.lanes,), U32), jnp.int32(0),
+                               jnp.int32(0), NO_NODE)
+        seed_a, sib_a, ext_a, _, _ = self._eval_find(
+            ctx, st, me_key, node_idx, req.key, ext_a, rmax)
+        st = dataclasses.replace(
+            st, choose=st.choose + (req.want & ~sib_a).astype(I32))
+        local = req.want & sib_a
+        res_local = seed_a[:lcfg.frontier]
+        slot, have = lk_mod.free_slot(st.lk)
+        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=local | insta_fail, success=local, tag=req.tag,
+                target=req.key,
+                results=jnp.where(local, res_local, NO_NODE),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_app, slot, P_APP, req.tag, req.key,
+            seed_a[:lcfg.frontier], now_a, lcfg, ext=ext_a))
+
+        # ------------------------------------------------ lookup timeouts --
+        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+        st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes)
+
+        # ------------------------------------------------- completions -----
+        new_lk, comp = lk_mod.take_completions(st.lk, t_end)
+        st = dataclasses.replace(st, lk=new_lk)
+        comp_hops_ev = (comp["hops"].astype(jnp.float32),
+                        comp["taken"] & comp["success"])
+        for li in range(lcfg.slots):
+            en = comp["taken"][li]
+            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
+            res = comp["result"][li]
+            pur = comp["purpose"][li]
+            lksucc_cnt += (en & suc).astype(I32)
+            anyfail_cnt += (en & ~suc).astype(I32)
+
+            # join bucket lookup → BBucketCall to the responsible node
+            enj = en & (pur == P_JOINB) & (st.state == INIT)
+            ob.send(enj & suc, t0, res, wire.BROOSE_BUCKET_CALL,
+                    a=jnp.int32(BT_BROTHER), b=jnp.int32(PR_INIT),
+                    size_b=wire.BASE_CALL_B + 2)
+            # a failed join lookup restarts the join (reference: restart
+            # on BucketCall timeout, Broose.cc:1055-1062)
+            fail_j = enj & ~suc
+            retries_cnt += fail_j.astype(I32)
+            st = self._restart_join_node(st, fail_j, t0, rngs[5])
+
+            # app lookup → app completion hook
+            ena = en & (pur == P_APP)
+            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                st.app, app_base.LookupDone(
+                    en=ena, success=ena & suc, tag=comp["aux"][li],
+                    target=comp["target"][li], results=comp["results"][li],
+                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                ctx, ob, ev, t0, node_idx))
+
+        # ------------------------------------------------------- pump ------
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[6], lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+
+        # ------------------------------------------------------ events -----
+        events = {
+            "c:broose_joins": joins_cnt,
+            "c:broose_join_retries": retries_cnt,
+            "c:lookup_success": lksucc_cnt,
+            "c:lookup_failed": anyfail_cnt,
+            "s:lookup_hops": comp_hops_ev,
+        }
+        ev.finish(events, self.app.hist_map)
+        return st, ob, events

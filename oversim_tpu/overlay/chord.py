@@ -47,6 +47,8 @@ from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import malicious as mal_mod
+from oversim_tpu.common import ncs as ncs_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -64,6 +66,8 @@ DEAD, JOINING, READY = 0, 1, 2
 
 # lookup purposes (owner dispatch tags)
 P_JOIN, P_FINGER, P_APP = 1, 2, 3
+
+BCAST_FANOUT = 8   # broadcast copies per hop (≥ distinct fingers at test N)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,6 +101,8 @@ class ChordState:
     cp_to: jnp.ndarray         # [N] i64 pending predecessor-ping timeout
     cp_dst: jnp.ndarray        # [N] i32 the node that ping targeted
     lk: lk_mod.LookupState     # [N, L, ...]
+    cp_sent: jnp.ndarray       # [N] i64 — predecessor-ping send time (RTT)
+    ncs: ncs_mod.NcsState      # [N, ...] Vivaldi coordinates (common/ncs.py)
     app: object                # [N, ...] tier-app state (apps/base.py)
     app_glob: object           # simulation-global app state (oracle maps)
 
@@ -118,11 +124,17 @@ class ChordLogic:
     def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
                  params: ChordParams = ChordParams(),
                  lcfg: lk_mod.LookupConfig = lk_mod.LookupConfig(),
-                 app=None):
+                 app=None,
+                 mparams: mal_mod.MaliciousParams = mal_mod.MaliciousParams(),
+                 ncs_params: ncs_mod.NcsParams = ncs_mod.NcsParams()):
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg
         self.app = app or KbrTestApp()
+        self.mp = mparams
+        self.ncs = ncs_params
+        if spec.lanes < ncs_params.dims + 1:
+            raise ValueError("key lanes too narrow for the NCS piggyback")
         self._pow2 = K.pow2_table(spec)          # [B, KL] finger offsets
 
     # -- engine interface ---------------------------------------------------
@@ -165,6 +177,8 @@ class ChordLogic:
             cp_dst=jnp.full((n,), NO_NODE, I32),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
+            cp_sent=jnp.zeros((n,), I64),
+            ncs=ncs_mod.init(rng, n, self.ncs),
             app=self.app.init(n),
             app_glob=self.app.glob_init(rng),
         )
@@ -344,7 +358,7 @@ class ChordLogic:
         ob = Outbox(outbox_slots, spec.lanes, rmax)
         me_key = ctx.keys[node_idx]
         rpc_to_ns = jnp.int64(int(p.rpc_timeout * NS))
-        rngs = jax.random.split(rng, 6)
+        rngs = jax.random.split(rng, 7)
         t0 = ctx.t_start
 
         def pad_nodes(vec):
@@ -379,9 +393,17 @@ class ChordLogic:
             en = v & (m.kind == wire.FINDNODE_CALL)
             res_nodes, sib = self._respond_find(ctx, st, me_key, node_idx,
                                                 m, rmax, pad_nodes)
-            n_res = jnp.sum((res_nodes != NO_NODE).astype(I32))
-            ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
-                    a=m.a, b=m.b, c=sib.astype(I32), nodes=res_nodes,
+            # byzantine switches (common/malicious.py; no-op by default).
+            # The attacked flag only goes on the wire — the honest ``sib``
+            # is reused below for the app deliver check, so an attacker
+            # that lies about responsibility still records a wrong-node
+            # delivery (KBRTestApp.cc:252-286 oracle check)
+            res_atk, sib_atk, respond = mal_mod.attack_findnode(
+                ctx, self.mp, node_idx, res_nodes, sib,
+                jax.random.fold_in(rngs[6], r))
+            n_res = jnp.sum((res_atk != NO_NODE).astype(I32))
+            ob.send(en & respond, now, m.src, wire.FINDNODE_RES, key=m.key,
+                    a=m.a, b=m.b, c=sib_atk.astype(I32), nodes=res_atk,
                     size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
 
             # FindNodeResponse → lookup engine
@@ -496,6 +518,49 @@ class ChordLogic:
                 take, self._succ_add(ctx, me_key, node_idx, st.succ, m.a,
                                      take), st.succ))
 
+            # KBR broadcast (Chord::forwardBroadcast, Chord.cc:1410-1446):
+            # walk fingers+successors by DESCENDING clockwise distance;
+            # every candidate inside (me, limit) gets a copy whose limit
+            # is the previous candidate, shrinking the covered range.
+            # Fan-out is capped at BCAST_FANOUT copies with the closest
+            # successor always last so the near range stays covered
+            # (distinct fingers ≈ log N; the cap only binds at huge N).
+            en_b = v & (m.kind == wire.BROADCAST) & (st.state == READY)
+            bc = jnp.concatenate([st.finger, st.succ])
+            bck = ctx.keys[jnp.maximum(bc, 0)]
+            me_bb = jnp.broadcast_to(me_key, bck.shape)
+            lim_b = jnp.broadcast_to(m.key, bck.shape)
+            ok_b = (bc != NO_NODE) & (bc != node_idx) & ~K.dup_mask(bc) \
+                & K.is_between(bck, me_bb, lim_b, spec)
+            d_b = K.sub(bck, me_bb, spec)          # cw distance me → cand
+            d_b = jnp.where(ok_b[:, None], d_b, jnp.zeros_like(d_b))
+            (bc_s,) = _sort_lanes(d_b, (jnp.where(ok_b, bc, NO_NODE),))
+            # bc_s ascending by distance with invalid entries (distance
+            # zeroed) at the head; the valid tail holds the real
+            # candidates — walk it from the far end
+            limit = m.key
+            n_ok = jnp.sum(ok_b.astype(I32))
+            for j in range(BCAST_FANOUT):
+                idx_j = jnp.clip(bc_s.shape[0] - 1 - j, 0,
+                                 bc_s.shape[0] - 1)
+                tgt_j = jnp.where(j < n_ok, bc_s[idx_j], NO_NODE)
+                fire_b = en_b & (tgt_j != NO_NODE)
+                ob.send(fire_b, now, tgt_j, wire.BROADCAST, key=limit,
+                        a=m.a, b=m.b, hops=m.hops + 1,
+                        size_b=wire.BASE_CALL_B + 20)
+                limit = jnp.where(fire_b, ctx.keys[jnp.maximum(tgt_j, 0)],
+                                  limit)
+            # cap bound (> FANOUT candidates): one extra copy to the
+            # NEAREST candidate carries the remaining (me, limit) range,
+            # which it re-splits recursively — without it the near range
+            # would never see the broadcast
+            near = bc_s[jnp.clip(bc_s.shape[0] - n_ok, 0,
+                                 bc_s.shape[0] - 1)]
+            fire_n = en_b & (n_ok > BCAST_FANOUT) & (near != NO_NODE)
+            ob.send(fire_n, now, jnp.maximum(near, 0), wire.BROADCAST,
+                    key=limit, a=m.a, b=m.b, hops=m.hops + 1,
+                    size_b=wire.BASE_CALL_B + 20)
+
             # app-owned message kinds (Common API deliver path,
             # BaseApp::handleCommonAPIMessage).  Reuse the findNode
             # sibling flag computed for this slot above: no handler
@@ -504,10 +569,24 @@ class ChordLogic:
             st = dataclasses.replace(st, app=self.app.on_msg(
                 st.app, m, ctx, ob, ev, sib))
 
-            # ping (predecessor liveness + generic)
+            # ping (predecessor liveness + generic); the response
+            # piggybacks this node's Vivaldi coordinates (the reference
+            # attaches ncsInfo[] to every RPC response,
+            # CommonMessages.msg:233 / NeighborCache piggybacking)
             ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
-                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+                    wire.PING_RES, a=m.a,
+                    key=ncs_mod.pack_wire(st.ncs.coords, st.ncs.error,
+                                          spec.lanes),
+                    size_b=wire.BASE_CALL_B + 4 * (self.ncs.dims + 1))
             en = v & (m.kind == wire.PING_RES) & (m.src == st.cp_dst)
+            if self.ncs.ncs_type in ("vivaldi", "svivaldi"):
+                rtt_s = (now - st.cp_sent).astype(jnp.float32) / NS
+                xj, ej = ncs_mod.unpack_wire(m.key, self.ncs.dims)
+                me_ncs = dict(coords=st.ncs.coords, height=st.ncs.height,
+                              error=st.ncs.error, loss=st.ncs.loss)
+                upd = ncs_mod.update(me_ncs, jnp.where(en, rtt_s, -1.0),
+                                     xj, ej, jnp.float32(0.0), self.ncs)
+                st = dataclasses.replace(st, ncs=ncs_mod.NcsState(**upd))
             st = dataclasses.replace(
                 st, cp_to=jnp.where(en, T_INF, st.cp_to),
                 cp_dst=jnp.where(en, NO_NODE, st.cp_dst))
@@ -518,7 +597,7 @@ class ChordLogic:
         # join (joinOverlay / handleJoinTimerExpired Chord.cc:758)
         en_j = (st.state == JOINING) & (st.t_join < t_end)
         now_j = jnp.maximum(st.t_join, t0)
-        boot = ctx.sample_ready(rngs[1])
+        boot = ctx.sample_ready(rngs[1], node_idx)
         no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
         alone_start = en_j & (boot == NO_NODE)
         st = self._become_ready(ctx, st, alone_start, now_j, rngs[2])
@@ -577,15 +656,21 @@ class ChordLogic:
             st,
             cp_to=jnp.where(fire_c, now_c + rpc_to_ns, st.cp_to),
             cp_dst=jnp.where(fire_c, st.pred, st.cp_dst),
+            cp_sent=jnp.where(fire_c, now_c, st.cp_sent),
             t_cp=jnp.where(en_c, now_c + jnp.int64(
                 int(p.check_pred_delay * NS)), st.t_cp))
 
         # app timer → start an app lookup (KBRTestApp::handleTimerEvent →
         # callRoute → iterative lookup, SURVEY §3.2)
+        # graceful-leave: hand app data to the successor and stop
+        # firing app tests during the grace window (apps/base.py on_leave)
+        st = dataclasses.replace(st, app=app_base.leave_protocol(
+            self.app, st.app, ctx, ob, ev, t0, node_idx, st.succ[0],
+            st.state == READY))
         en_a = (st.state == READY) & (
             self.app.next_event(st.app) < t_end)
         now_a = jnp.maximum(self.app.next_event(st.app), t0)
-        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev, node_idx)
         st = dataclasses.replace(st, app=app)
         nxt_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key)
         # local responsibility → immediate completion, hopCount 0

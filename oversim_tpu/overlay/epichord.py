@@ -1,0 +1,700 @@
+"""EpiChord — reactive Chord with a slice-invariant finger cache.
+
+TPU-native rebuild of the reference EpiChord
+(src/overlay/epichord/EpiChord.{h,cc} + EpiChordNodeList +
+EpiChordFingerCache; params default.ini:144-164: successorListSize 4,
+joinDelay 10s, joinRetry 2, stabilizeDelay 20s, cacheFlushDelay 20s,
+cacheCheckMultiplier 3, cacheTTL 120s, nodesPerSlice 2, lookupMerge true),
+after "EpiChord: Parallelizing the Chord Lookup Algorithm with Reactive
+Routing State Management" (Leong/Liskov/Demaine, MIT-LCS-TR-963).
+
+State per node:
+  * symmetric neighbor lists — ``succ``/``pred`` [N, S] ring-sorted both
+    ways from the own key (EpiChordNodeList);
+  * a **finger cache** [N, C] of every node ever observed, with per-entry
+    lastUpdate timestamps and TTL expiry (EpiChordFingerCache::
+    updateFinger / removeOldFingers).  The cache — not a routing table —
+    is the routing state: it is fed reactively by every received call,
+    response, FindNode payload, join transfer, and stabilize exchange
+    (receiveNewNode, EpiChord.cc:1178-1209).
+
+Protocol:
+  * join: iterative lookup of the own key seeded at a bootstrap node,
+    then EpiChordJoinCall to the responsible node; the JoinResponse
+    transfers succ+pred lists and a cache sample; the joiner becomes
+    READY and JoinAcks the responder, which adopts it as predecessor
+    (rpcJoin/handleRpcJoinResponse/rpcJoinAck, EpiChord.cc:871-965);
+  * stabilize: every stabilizeDelay, one call to pred (type SUCCESSOR)
+    and one to succ (type PREDECESSOR), each carrying neighbor additions;
+    the callee direct-adds the caller + additions to the matching list
+    and responds with its pred+succ lists, which the caller folds into
+    the cache (rpcStabilize/handleRpcStabilizeResponse, EpiChord.cc:
+    999-1150);
+  * cache flush: every cacheFlushDelay expired fingers are dropped; every
+    cacheCheckMultiplier-th flush checks the **slice invariant** — the
+    ring is divided into exponentially growing slices (me ± max>>offset)
+    and any slice not covered by the succ/pred lists must hold
+    ≥ nodesPerSlice cache entries, else a lookup to the slice midpoint
+    repopulates it (checkCacheInvariant/checkCacheSlice,
+    EpiChord.cc:416-516);
+  * findNode (EpiChord.cc:517-629): siblings (self+neighbors) when
+    responsible; otherwise the directional succ/pred head plus the
+    numRedundantNodes cache entries closest at-or-after the key
+    clockwise (EpiChordFingerCache::findBestHops lower_bound walk).
+
+Deviations (documented): the cache is bounded at ``cache_size`` with
+oldest-lastUpdate eviction (the reference's std::map is unbounded); the
+per-entry lastUpdate piggyback ext (EpiChordFindNodeExtMessage) is
+dropped — learned fingers are stamped with receive time; stabilize
+responses are always "full" (the hasChanged-gated partial response and
+the dead-range gossip of the reference are skipped);
+FalseNegWarning/stabilizeEstimation/fibonacci-slices are not implemented
+(defaults exercise none of the latter two beyond estimation, which only
+rescales the stabilize interval).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps.kbrtest import KbrTestApp
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+UMAX = jnp.uint32(0xFFFFFFFF)
+
+DEAD, JOINING, READY = 0, 1, 2
+P_JOIN, P_SLICE, P_APP = 1, 2, 3
+
+# stabilize call node types (EpiChordMessage.msg NodeType)
+NT_PRED, NT_SUCC = 0, 1
+
+
+@dataclasses.dataclass(frozen=True)
+class EpiChordParams:
+    """default.ini:144-164."""
+
+    succ_size: int = 4            # successorListSize (both lists)
+    join_delay: float = 10.0
+    join_retry: int = 2
+    stabilize_delay: float = 20.0
+    cache_flush_delay: float = 20.0
+    cache_check_mult: int = 3
+    cache_ttl: float = 120.0
+    nodes_per_slice: int = 2
+    redundant_nodes: int = 3      # lookupRedundantNodes
+    rpc_timeout: float = 1.5
+    # engine-shape knobs
+    cache_size: int = 64          # bounded cache (module docstring)
+    max_slices: int = 24          # static slice-check unroll
+    additions: int = 4            # neighbors piggybacked per stabilize
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class EpiChordState:
+    state: jnp.ndarray        # [N] i32
+    succ: jnp.ndarray         # [N, S] i32 cw-sorted
+    pred: jnp.ndarray         # [N, S] i32 ccw-sorted
+    cache: jnp.ndarray        # [N, C] i32
+    cache_seen: jnp.ndarray   # [N, C] i64 lastUpdate
+    t_join: jnp.ndarray       # [N] i64
+    join_retry: jnp.ndarray   # [N] i32
+    t_stab: jnp.ndarray       # [N] i64
+    t_cache: jnp.ndarray      # [N] i64
+    check_ctr: jnp.ndarray    # [N] i32
+    slice_cursor: jnp.ndarray  # [N] i32 — round-robin deficient slice
+    lk: lk_mod.LookupState
+    app: object
+    app_glob: object
+
+
+class EpiChordLogic:
+    """Engine logic interface (engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: EpiChordParams = EpiChordParams(),
+                 lcfg: lk_mod.LookupConfig | None = None,
+                 app=None):
+        self.key_spec = spec
+        self.p = params
+        self.lcfg = lcfg or lk_mod.LookupConfig(merge=True)
+        self.app = app or KbrTestApp()
+        # static table: max_key >> o for the slice bounds
+        self._shifted_max = jnp.stack(
+            [K.shr_const(K.max_key(spec), o, spec)
+             for o in range(1, params.max_slices + 3)])
+
+    # -- engine interface ---------------------------------------------------
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        app = self.app.stat_spec()
+        return stats_mod.StatSpec(
+            scalars=tuple(app["scalars"]) + ("lookup_hops",),
+            hists=tuple(app["hists"]),
+            counters=tuple(app["counters"]) + (
+                "epi_joins", "epi_slice_lookups", "lookup_success",
+                "lookup_failed"),
+        )
+
+    def split(self, st: EpiChordState):
+        return dataclasses.replace(st, app_glob=None), st.app_glob
+
+    def merge(self, node_part: EpiChordState, glob):
+        return dataclasses.replace(node_part, app_glob=glob)
+
+    def post_step(self, ctx, st: EpiChordState, events):
+        app, glob = self.app.post_step(ctx, st.app, st.app_glob, events)
+        return dataclasses.replace(st, app=app, app_glob=glob)
+
+    def init(self, rng, n: int) -> EpiChordState:
+        p = self.p
+        return EpiChordState(
+            state=jnp.zeros((n,), I32),
+            succ=jnp.full((n, p.succ_size), NO_NODE, I32),
+            pred=jnp.full((n, p.succ_size), NO_NODE, I32),
+            cache=jnp.full((n, p.cache_size), NO_NODE, I32),
+            cache_seen=jnp.zeros((n, p.cache_size), I64),
+            t_join=jnp.full((n,), T_INF, I64),
+            join_retry=jnp.full((n,), p.join_retry, I32),
+            t_stab=jnp.full((n,), T_INF, I64),
+            t_cache=jnp.full((n,), T_INF, I64),
+            check_ctr=jnp.zeros((n,), I32),
+            slice_cursor=jnp.zeros((n,), I32),
+            lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
+                jnp.arange(n)),
+            app=self.app.init(n),
+            app_glob=self.app.glob_init(rng),
+        )
+
+    def reset(self, st: EpiChordState, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        glob = st.app_glob
+        st = dataclasses.replace(st, app_glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), app_glob=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, app_glob=glob)
+        jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: EpiChordState):
+        return st.state == READY
+
+    def next_event(self, st: EpiChordState):
+        joining = st.state == JOINING
+        ready = st.state == READY
+        t = jnp.where(joining, st.t_join, T_INF)
+        t = jnp.minimum(t, jnp.where(ready, st.t_stab, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, st.t_cache, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
+                                     T_INF))
+        t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        return t
+
+    # -- neighbor lists + cache ---------------------------------------------
+
+    def _ring_sorted(self, ctx, me_key, node_idx, cands, clockwise):
+        """Top-S unique candidates by cw/ccw ring distance from own key
+        (EpiChordNodeList: std::map keyed by directional distance)."""
+        s = self.p.succ_size
+        ck = ctx.keys[jnp.maximum(cands, 0)]
+        bad = (cands == NO_NODE) | (cands == node_idx) | K.dup_mask(cands)
+        me_b = jnp.broadcast_to(me_key, ck.shape)
+        d = K.sub(ck, me_b, self.key_spec) if clockwise \
+            else K.sub(me_b, ck, self.key_spec)
+        d = jnp.where(bad[:, None], UMAX, d)
+        _, (c_s, bad_s) = K.sort_by_distance(d, (cands, bad.astype(I32)))
+        out = jnp.where(bad_s[:s] != 0, NO_NODE, c_s[:s])
+        if out.shape[0] < s:
+            out = jnp.concatenate(
+                [out, jnp.full((s - out.shape[0],), NO_NODE, I32)])
+        return out
+
+    def _cache_put(self, st, cands, seen):
+        """updateFinger: refresh lastUpdate for known fingers, insert new
+        ones, evict the oldest when full (bounded-cache deviation)."""
+        cache, cseen = st.cache, st.cache_seen
+        cands = jnp.atleast_1d(jnp.asarray(cands, I32))
+        seen = jnp.broadcast_to(jnp.asarray(seen, I64), cands.shape)
+        match = (cache[:, None] == cands[None, :]) & (
+            cands != NO_NODE)[None, :]
+        cseen = jnp.maximum(cseen, jnp.max(
+            jnp.where(match, seen[None, :], 0), axis=1))
+        fresh_mask = (cands != NO_NODE) & ~jnp.any(match, axis=0) \
+            & ~K.dup_mask(cands)
+        aug = jnp.concatenate([cache, jnp.where(fresh_mask, cands, NO_NODE)])
+        aseen = jnp.concatenate([cseen, jnp.where(fresh_mask, seen, 0)])
+        # keep the newest C entries (invalid slots sort oldest)
+        order = jnp.argsort(
+            jnp.where(aug == NO_NODE, jnp.int64(-1), aseen))[::-1]
+        aug, aseen = aug[order], aseen[order]
+        return dataclasses.replace(
+            st, cache=aug[:self.p.cache_size],
+            cache_seen=jnp.where(aug[:self.p.cache_size] == NO_NODE, 0,
+                                 aseen[:self.p.cache_size]))
+
+    def _receive_new_node(self, ctx, st, me_key, node_idx, cands, direct,
+                          now):
+        """receiveNewNode (EpiChord.cc:1178-1209): cache always; the
+        succ/pred lists only for directly observed nodes."""
+        st = self._cache_put(st, cands, now)
+        cands = jnp.atleast_1d(jnp.asarray(cands, I32))
+        if direct:
+            st = dataclasses.replace(
+                st,
+                succ=self._ring_sorted(
+                    ctx, me_key, node_idx,
+                    jnp.concatenate([st.succ, cands]), True),
+                pred=self._ring_sorted(
+                    ctx, me_key, node_idx,
+                    jnp.concatenate([st.pred, cands]), False))
+        return st
+
+    def _expire_cache(self, st, now):
+        ttl_ns = jnp.int64(int(self.p.cache_ttl * NS))
+        dead = (st.cache != NO_NODE) & (st.cache_seen + ttl_ns < now)
+        return dataclasses.replace(
+            st,
+            cache=jnp.where(dead, NO_NODE, st.cache),
+            cache_seen=jnp.where(dead, 0, st.cache_seen))
+
+    def _handle_failed(self, ctx, st, me_key, node_idx, failed, now):
+        """Remove failed nodes everywhere; losing the last succ or pred
+        while READY → rejoin (handleFailedNode, EpiChord.cc:816-846)."""
+        failed = jnp.atleast_1d(failed)
+        failed = jnp.where(failed == node_idx, NO_NODE, failed)
+        any_failed = jnp.any(failed != NO_NODE)
+
+        def hit(x):
+            return (x[..., None] == failed).any(-1) & (x != NO_NODE)
+
+        succ = self._ring_sorted(ctx, me_key, node_idx,
+                                 jnp.where(hit(st.succ), NO_NODE, st.succ),
+                                 True)
+        pred = self._ring_sorted(ctx, me_key, node_idx,
+                                 jnp.where(hit(st.pred), NO_NODE, st.pred),
+                                 False)
+        chit = hit(st.cache)
+        st2 = dataclasses.replace(
+            st, succ=succ, pred=pred,
+            cache=jnp.where(chit, NO_NODE, st.cache),
+            cache_seen=jnp.where(chit, 0, st.cache_seen))
+        st = select_tree(any_failed, st2, st)
+        rejoin = any_failed & (st.state == READY) & (
+            (st.succ[0] == NO_NODE) | (st.pred[0] == NO_NODE))
+        fresh_lk = lk_mod.init(self.lcfg, self.key_spec.lanes)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(rejoin, JOINING, st.state),
+            t_join=jnp.where(rejoin, now, st.t_join),
+            t_stab=jnp.where(rejoin, T_INF, st.t_stab),
+            t_cache=jnp.where(rejoin, T_INF, st.t_cache),
+            lk=select_tree(rejoin, fresh_lk, st.lk),
+            app=self.app.on_stop(st.app, rejoin))
+
+    def _become_ready(self, ctx, st, en, now, rng):
+        p = self.p
+        return dataclasses.replace(
+            st,
+            state=jnp.where(en, READY, st.state),
+            t_join=jnp.where(en, T_INF, st.t_join),
+            t_stab=jnp.where(en, now + jnp.int64(
+                int(p.stabilize_delay * NS)), st.t_stab),
+            t_cache=jnp.where(en, now + jnp.int64(
+                int(p.cache_flush_delay * NS)), st.t_cache),
+            app=self.app.on_ready(st.app, en, now, rng))
+
+    # -- findNode (EpiChord.cc:517-629) -------------------------------------
+
+    def _is_sibling(self, st, ctx, me_key, key):
+        pred_ok = st.pred[0] != NO_NODE
+        pk = ctx.keys[jnp.maximum(st.pred[0], 0)]
+        alone = ~pred_ok & (st.succ[0] == NO_NODE)
+        return (st.state == READY) & (
+            alone
+            | (~pred_ok & K.eq(key, me_key))
+            | (pred_ok & K.is_between_r(key, pk, me_key, self.key_spec)))
+
+    def _find_node(self, ctx, st, me_key, node_idx, key, rmax, src):
+        """Returns ([rmax] candidates, is_sib).  ``src`` selects the
+        directional neighbor per the source-side rule (NO_NODE = local
+        request → whichever of succ/pred is closer to the key)."""
+        p, spec = self.p, self.key_spec
+        is_sib = self._is_sibling(st, ctx, me_key, key)
+
+        # sibling payload: self + pred0 + successor list
+        sib_set = jnp.full((rmax,), NO_NODE, I32)
+        sib_set = sib_set.at[0].set(node_idx)
+        sib_set = sib_set.at[1].set(st.pred[0])
+        k = min(p.succ_size, rmax - 2)
+        sib_set = sib_set.at[2:2 + k].set(st.succ[:k])
+
+        # directional head
+        s0, p0 = st.succ[0], st.pred[0]
+        s0k = ctx.keys[jnp.maximum(s0, 0)]
+        p0k = ctx.keys[jnp.maximum(p0, 0)]
+        src_ok = src != NO_NODE
+        srck = ctx.keys[jnp.maximum(src, 0)]
+        d_s = K.sub(key, s0k, spec)
+        d_p = K.sub(key, p0k, spec)
+        local_pick = jnp.where(K.lt(K.sub(s0k, key, spec),
+                                    K.sub(key, s0k, spec)), s0, p0)
+        # remote: us between source and key → successor side, else pred
+        fwd = K.is_between(me_key, srck, key, spec)
+        head = jnp.where(src_ok, jnp.where(fwd, s0, p0),
+                         jnp.where(K.lt(K.ring_distance(s0k, key, spec),
+                                        K.ring_distance(p0k, key, spec))
+                                   if False else
+                                   K.lt(d_s, d_p), s0, p0))
+
+        # findBestHops: cache entries at-or-after the key clockwise
+        # (lower_bound walk over the cw-from-me keyed map)
+        cands = jnp.concatenate([st.cache, st.succ, st.pred])
+        ck = ctx.keys[jnp.maximum(cands, 0)]
+        bad = (cands == NO_NODE) | (cands == node_idx) | (
+            src_ok & (cands == src)) | (cands == head) | K.dup_mask(cands)
+        d = K.sub(ck, jnp.broadcast_to(key, ck.shape), spec)  # cw key→cand
+        d = jnp.where(bad[:, None], UMAX, d)
+        _, (c_s,) = K.sort_by_distance(d, (cands,))
+        res = jnp.full((rmax,), NO_NODE, I32)
+        res = res.at[0].set(jnp.where(head != NO_NODE, head, c_s[0]))
+        take = min(p.redundant_nodes, rmax - 1)
+        res = res.at[1:1 + take].set(c_s[:take])
+        res = jnp.where(st.state == READY, res, NO_NODE)
+        return jnp.where(is_sib, sib_set, res), is_sib
+
+    # -- the per-node step ---------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, lcfg, spec = self.p, self.lcfg, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rngs = jax.random.split(rng, 8)
+        t0 = ctx.t_start
+        t_end = ctx.t_end
+        S = p.succ_size
+
+        def metric_fn(cand_slots, target):
+            # frontier sorted by how far past the key a candidate sits
+            # (candidates are successor-side, EpiChordIterativeLookup)
+            ck = ctx.keys[jnp.maximum(cand_slots, 0)]
+            return K.sub(ck, jnp.broadcast_to(target, ck.shape), spec)
+
+        ev = app_base.AppEvents()
+        joins_cnt = jnp.int32(0)
+        slice_cnt = jnp.int32(0)
+        anyfail_cnt = jnp.int32(0)
+        lksucc_cnt = jnp.int32(0)
+
+        def pad_nodes(vec):
+            out = jnp.full((rmax,), NO_NODE, I32)
+            k = min(vec.shape[0], rmax)
+            return out.at[:k].set(vec[:k])
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # every inbound call/response feeds the cache + lists
+            # (handleRpcCall/handleRpcResponse receiveNewNode direct).
+            # READY-gated: a joining node never emits RPCs in the
+            # reference (its JoinCall is proxy-routed via the bootstrap,
+            # EpiChord.cc:309-337), so joiners must not enter routing
+            # state or lookups forward into non-answering nodes.
+            # Protocol-explicit adds (JoinAck, stabilize additions)
+            # below stay ungated.
+            st = select_tree(
+                v & ctx.ready[jnp.maximum(m.src, 0)],
+                self._receive_new_node(ctx, st, me_key, node_idx, m.src,
+                                       True, now), st)
+
+            # FindNodeCall
+            en = v & (m.kind == wire.FINDNODE_CALL)
+            res, sib = self._find_node(ctx, st, me_key, node_idx, m.key,
+                                       rmax, m.src)
+            n_res = jnp.sum((res != NO_NODE).astype(I32))
+            ob.send(en & (st.state == READY), now, m.src, wire.FINDNODE_RES,
+                    key=m.key, a=m.a, b=m.b, c=sib.astype(I32), nodes=res,
+                    size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
+
+            # FindNodeResponse → lookup engine + cache learning
+            en = v & (m.kind == wire.FINDNODE_RES)
+            st = dataclasses.replace(st, lk=lk_mod.on_response(
+                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
+            learned = m.nodes[:lcfg.frontier]
+            l_ok = (learned != NO_NODE) & ctx.ready[jnp.maximum(learned, 0)]
+            st = select_tree(
+                en, self._cache_put(st, jnp.where(l_ok, learned, NO_NODE),
+                                    now), st)
+
+            # JoinCall → transfer lists + cache sample (rpcJoin)
+            en = v & (m.kind == wire.EPI_JOIN_CALL) & (st.state == READY)
+            n_cache = max(0, rmax - 2 * S)
+            payload = jnp.concatenate(
+                [st.pred, st.succ, st.cache[:n_cache]])
+            ob.send(en, now, m.src, wire.EPI_JOIN_RES, a=jnp.int32(S),
+                    nodes=pad_nodes(payload),
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B * rmax)
+
+            # JoinResponse (handleRpcJoinResponse): adopt lists, READY,
+            # ack the responder
+            en = v & (m.kind == wire.EPI_JOIN_RES) & (st.state == JOINING)
+            preds = m.nodes[:S]
+            succs = m.nodes[S:2 * S]
+            cache_x = m.nodes[2 * S:]
+            new_succ = self._ring_sorted(
+                ctx, me_key, node_idx,
+                jnp.concatenate([st.succ, succs, m.src[None]]), True)
+            new_pred = self._ring_sorted(
+                ctx, me_key, node_idx,
+                jnp.concatenate([st.pred, preds, m.src[None]]), False)
+            st = dataclasses.replace(
+                st,
+                succ=jnp.where(en, new_succ, st.succ),
+                pred=jnp.where(en, new_pred, st.pred))
+            st = select_tree(en, self._cache_put(st, cache_x, now), st)
+            joins_cnt += en.astype(I32)
+            st = self._become_ready(ctx, st, en, now, rngs[0])
+            ob.send(en, now, m.src, wire.EPI_JOINACK_CALL,
+                    size_b=wire.BASE_CALL_B)
+
+            # JoinAck (rpcJoinAck): the joiner becomes our predecessor
+            en = v & (m.kind == wire.EPI_JOINACK_CALL) & (
+                st.state == READY)
+            st = dataclasses.replace(
+                st,
+                pred=jnp.where(en, self._ring_sorted(
+                    ctx, me_key, node_idx,
+                    jnp.concatenate([st.pred, m.src[None]]), False),
+                    st.pred),
+                succ=jnp.where(en & (st.succ[0] == NO_NODE),
+                               st.succ.at[0].set(m.src), st.succ))
+
+            # StabilizeCall (rpcStabilize): direct-add requestor +
+            # additions to the matching list; respond with pred++succ
+            en = v & (m.kind == wire.EPI_STAB_CALL) & (st.state == READY)
+            adds = jnp.concatenate([m.src[None], m.nodes[:p.additions]])
+            from_pred = m.a == NT_PRED
+            st = dataclasses.replace(
+                st,
+                pred=jnp.where(en & from_pred, self._ring_sorted(
+                    ctx, me_key, node_idx,
+                    jnp.concatenate([st.pred, adds]), False), st.pred),
+                succ=jnp.where(en & ~from_pred, self._ring_sorted(
+                    ctx, me_key, node_idx,
+                    jnp.concatenate([st.succ, adds]), True), st.succ))
+            ob.send(en, now, m.src, wire.EPI_STAB_RES, a=jnp.int32(S),
+                    nodes=pad_nodes(jnp.concatenate([st.pred, st.succ])),
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B * 2 * S)
+
+            # StabilizeResponse → cache only (handleRpcStabilizeResponse)
+            en = v & (m.kind == wire.EPI_STAB_RES) & (st.state == READY)
+            learned = m.nodes[:2 * S]
+            s_ok = (learned != NO_NODE) & ctx.ready[jnp.maximum(learned, 0)]
+            st = select_tree(
+                en, self._cache_put(st, jnp.where(s_ok, learned, NO_NODE),
+                                    now), st)
+
+            # app-owned kinds
+            sib_app = self._is_sibling(st, ctx, me_key, m.key)
+            st = dataclasses.replace(st, app=self.app.on_msg(
+                st.app, m, ctx, ob, ev, sib_app))
+
+            # pings
+            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
+                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+
+        # ------------------------------------------------------- timers ----
+        # join (handleJoinTimerExpired: routed JoinCall via bootstrap →
+        # here a lookup for the own key, then a direct JoinCall)
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1], node_idx)
+        no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
+        alone = en_j & (boot == NO_NODE)
+        joins_cnt += alone.astype(I32)
+        st = self._become_ready(ctx, st, alone, now_j, rngs[2])
+        slot, have = lk_mod.free_slot(st.lk)
+        start_join = en_j & (boot != NO_NODE) & no_join_lk & have
+        seed = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(boot)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_join, slot, P_JOIN, 0, me_key, seed, now_j, lcfg))
+        st = dataclasses.replace(st, t_join=jnp.where(
+            en_j & ~alone, now_j + jnp.int64(int(p.join_delay * NS)),
+            st.t_join))
+
+        # stabilize (handleStabilizeTimerExpired): one call each way
+        en_s = (st.state == READY) & (st.t_stab < t_end)
+        now_s = jnp.maximum(st.t_stab, t0)
+        adds_s = pad_nodes(st.succ[:p.additions])
+        adds_p = pad_nodes(st.pred[:p.additions])
+        ob.send(en_s & (st.pred[0] != NO_NODE), now_s, st.pred[0],
+                wire.EPI_STAB_CALL, a=jnp.int32(NT_SUCC), nodes=adds_s,
+                size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B * p.additions)
+        ob.send(en_s & (st.succ[0] != NO_NODE), now_s, st.succ[0],
+                wire.EPI_STAB_CALL, a=jnp.int32(NT_PRED), nodes=adds_p,
+                size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B * p.additions)
+        st = dataclasses.replace(st, t_stab=jnp.where(
+            en_s, now_s + jnp.int64(int(p.stabilize_delay * NS)),
+            st.t_stab))
+
+        # cache flush + slice invariant (handleCacheFlushTimerExpired)
+        en_c = (st.state == READY) & (st.t_cache < t_end)
+        now_c = jnp.maximum(st.t_cache, t0)
+        st = select_tree(en_c, self._expire_cache(st, now_c), st)
+        ctr = jnp.where(en_c, st.check_ctr + 1, st.check_ctr)
+        do_check = en_c & (ctr > p.cache_check_mult)
+        ctr = jnp.where(do_check, 0, ctr)
+        st = dataclasses.replace(
+            st, check_ctr=ctr,
+            t_cache=jnp.where(en_c, now_c + jnp.int64(
+                int(p.cache_flush_delay * NS)), st.t_cache))
+
+        # slice check (checkCacheInvariant, non-fibonacci): find deficient
+        # slices on both sides, start ONE midpoint lookup per check
+        # (round-robin cursor; the reference fires one per slice)
+        lists_full = (st.succ[-1] != NO_NODE) & (st.pred[-1] != NO_NODE)
+        lastsk = ctx.keys[jnp.maximum(st.succ[-1], 0)]
+        lastpk = ctx.keys[jnp.maximum(st.pred[-1], 0)]
+        cachek = ctx.keys[jnp.maximum(st.cache, 0)]
+        cache_ok = st.cache != NO_NODE
+        deficient = []
+        targets = []
+        for o in range(p.max_slices):
+            far_s = K.add(me_key, self._shifted_max[o], spec)
+            near_s = K.add(me_key, self._shifted_max[o + 1], spec)
+            act_s = K.is_between(lastsk, me_key, near_s, spec)
+            n_in = jnp.sum((cache_ok & K.is_between_r(
+                cachek, jnp.broadcast_to(near_s, cachek.shape),
+                jnp.broadcast_to(far_s, cachek.shape), spec)).astype(I32))
+            mid_s = K.add(near_s, K.shr_const(
+                K.sub(far_s, near_s, spec), 1, spec), spec)
+            deficient.append(act_s & (n_in < p.nodes_per_slice))
+            targets.append(mid_s)
+            far_p = K.sub(me_key, self._shifted_max[o], spec)
+            near_p = K.sub(me_key, self._shifted_max[o + 1], spec)
+            act_p = K.is_between(lastpk, near_p, me_key, spec)
+            n_in_p = jnp.sum((cache_ok & K.is_between_r(
+                cachek, jnp.broadcast_to(far_p, cachek.shape),
+                jnp.broadcast_to(near_p, cachek.shape), spec)).astype(I32))
+            mid_p = K.add(far_p, K.shr_const(
+                K.sub(near_p, far_p, spec), 1, spec), spec)
+            deficient.append(act_p & (n_in_p < p.nodes_per_slice))
+            targets.append(mid_p)
+        deficient = jnp.stack(deficient)          # [2*O]
+        targets = jnp.stack(targets)              # [2*O, KL]
+        nsl = deficient.shape[0]
+        rot = (jnp.arange(nsl, dtype=I32) + st.slice_cursor) % nsl
+        pick_rot = jnp.argmax(deficient[rot]).astype(I32)
+        pick = rot[pick_rot]
+        any_def = jnp.any(deficient)
+        tgt = targets[pick]
+        no_slice_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_SLICE))
+        seed_s, sib_s = self._find_node(ctx, st, me_key, node_idx, tgt,
+                                        rmax, NO_NODE)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_slice = do_check & lists_full & any_def & no_slice_lk \
+            & have & ~sib_s & (seed_s[0] != NO_NODE)
+        slice_cnt += start_slice.astype(I32)
+        st = dataclasses.replace(
+            st,
+            slice_cursor=jnp.where(do_check, pick + 1, st.slice_cursor),
+            lk=lk_mod.start(st.lk, start_slice, slot, P_SLICE, 0, tgt,
+                            seed_s[:lcfg.frontier], now_c, lcfg))
+
+        # app timer
+        # graceful-leave: hand app data to the successor and stop
+        # firing app tests during the grace window (apps/base.py on_leave)
+        st = dataclasses.replace(st, app=app_base.leave_protocol(
+            self.app, st.app, ctx, ob, ev, t0, node_idx, st.succ[0],
+            st.state == READY))
+        en_a = (st.state == READY) & (
+            self.app.next_event(st.app) < t_end)
+        now_a = jnp.maximum(self.app.next_event(st.app), t0)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev, node_idx)
+        st = dataclasses.replace(st, app=app)
+        seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
+                                        rmax, NO_NODE)
+        local = req.want & sib_a
+        res_local = seed_a[:lcfg.frontier]
+        slot, have = lk_mod.free_slot(st.lk)
+        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=local | insta_fail, success=local, tag=req.tag,
+                target=req.key,
+                results=jnp.where(local, res_local, NO_NODE),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_app, slot, P_APP, req.tag, req.key,
+            seed_a[:lcfg.frontier], now_a, lcfg))
+
+        # ------------------------------------------------ lookup timeouts --
+        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        st = dataclasses.replace(st, lk=new_lk)
+        st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes,
+                                 t0)
+
+        # ------------------------------------------------- completions -----
+        new_lk, comp = lk_mod.take_completions(st.lk, t_end)
+        st = dataclasses.replace(st, lk=new_lk)
+        comp_hops_ev = (comp["hops"].astype(jnp.float32),
+                        comp["taken"] & comp["success"])
+        for li in range(lcfg.slots):
+            en = comp["taken"][li]
+            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
+            res = comp["result"][li]
+            pur = comp["purpose"][li]
+            lksucc_cnt += (en & suc).astype(I32)
+            anyfail_cnt += (en & ~suc).astype(I32)
+
+            # join lookup done → JoinCall to the responsible node
+            enj = en & (pur == P_JOIN) & (st.state == JOINING)
+            ob.send(enj & suc, t0, res, wire.EPI_JOIN_CALL,
+                    size_b=wire.BASE_CALL_B)
+            # failure → retry handled by t_join periodic refire
+
+            # app lookup → app completion hook
+            ena = en & (pur == P_APP)
+            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                st.app, app_base.LookupDone(
+                    en=ena, success=ena & suc, tag=comp["aux"][li],
+                    target=comp["target"][li], results=comp["results"][li],
+                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                ctx, ob, ev, t0, node_idx))
+
+        # ------------------------------------------------------- pump ------
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[6], lcfg,
+                                num_redundant=p.redundant_nodes)
+        st = dataclasses.replace(st, lk=new_lk)
+
+        # ------------------------------------------------------ events -----
+        events = {
+            "c:epi_joins": joins_cnt,
+            "c:epi_slice_lookups": slice_cnt,
+            "c:lookup_success": lksucc_cnt,
+            "c:lookup_failed": anyfail_cnt,
+            "s:lookup_hops": comp_hops_ev,
+        }
+        ev.finish(events, self.app.hist_map)
+        return st, ob, events

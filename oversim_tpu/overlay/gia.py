@@ -359,7 +359,7 @@ class GiaLogic:
         # reference walks PICK messages — simplification, module doc)
         en_j = (st.state == JOINING) & (st.t_join < t_end)
         now_j = jnp.maximum(st.t_join, t0)
-        boot = ctx.sample_ready(rngs[5])
+        boot = ctx.sample_ready(rngs[5], node_idx)
         alone = en_j & (boot == NO_NODE)
         joins_cnt += alone.astype(I32)
         st = dataclasses.replace(
@@ -384,7 +384,7 @@ class GiaLogic:
         deg = self._deg(st)
         want_more = en_t & ((sat < 1.0) | (deg < p.min_neighbors)) & (
             deg < p.max_neighbors)
-        cand = ctx.sample_ready(rngs[6])
+        cand = ctx.sample_ready(rngs[6], node_idx)
         ob.send(want_more & (cand != NO_NODE) & (cand != node_idx), now_t,
                 cand, wire.GIA_NEIGHBOR_CALL,
                 a=(st.capacity * 16.0).astype(I32),
@@ -415,6 +415,9 @@ class GiaLogic:
             s_to=jnp.where(en_to, T_INF, st.s_to))
 
         # periodic search (GIASearchApp::handleTimerEvent)
+        # NODE_LEAVE parks the search timer (leaving nodes stop testing)
+        st = dataclasses.replace(st, t_search=jnp.where(
+            ctx.leaving[node_idx], T_INF, st.t_search))
         en_s = (st.state == READY) & (st.t_search < t_end) & ~st.s_active
         now_s = jnp.maximum(st.t_search, t0)
         victim = ctx.sample_ready(rngs[2])

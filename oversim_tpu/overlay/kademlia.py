@@ -52,6 +52,7 @@ from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import malicious as mal_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -109,11 +110,13 @@ class KademliaLogic:
     def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
                  params: KademliaParams = KademliaParams(),
                  lcfg: lk_mod.LookupConfig | None = None,
-                 app=None):
+                 app=None,
+                 mparams: mal_mod.MaliciousParams = mal_mod.MaliciousParams()):
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg or lk_mod.LookupConfig(merge=True)
         self.app = app or KbrTestApp()
+        self.mp = mparams
         self._pow2 = K.pow2_table(spec)
 
     # -- engine interface ---------------------------------------------------
@@ -393,8 +396,14 @@ class KademliaLogic:
             # FindNodeCall → findNode + sibling flag
             en = v & (m.kind == wire.FINDNODE_CALL)
             res, sib = self._find_node(ctx, st, me_key, node_idx, m.key, rmax)
-            ob.send(en, now, m.src, wire.FINDNODE_RES, key=m.key,
-                    a=m.a, b=m.b, c=sib.astype(I32), nodes=res,
+            # byzantine switches (common/malicious.py; no-op by default).
+            # Only the wire copy is attacked; the honest ``sib`` feeds the
+            # app deliver check below (wrong-node detection)
+            res_atk, sib_atk, respond = mal_mod.attack_findnode(
+                ctx, self.mp, node_idx, res, sib,
+                jax.random.fold_in(rngs[7], r))
+            ob.send(en & respond, now, m.src, wire.FINDNODE_RES, key=m.key,
+                    a=m.a, b=m.b, c=sib_atk.astype(I32), nodes=res_atk,
                     size_b=wire.findnode_res_b(p.redundant_nodes))
 
             # FindNodeResponse → lookup engine + unverified learns
@@ -419,7 +428,7 @@ class KademliaLogic:
         # Kademlia.cc:1027-1081)
         en_j = (st.state == JOINING) & (st.t_join < t_end)
         now_j = jnp.maximum(st.t_join, t0)
-        boot = ctx.sample_ready(rngs[1])
+        boot = ctx.sample_ready(rngs[1], node_idx)
         no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
         alone_start = en_j & (boot == NO_NODE)
         st = self._become_ready(ctx, st, alone_start, now_j, rngs[2])
@@ -468,10 +477,15 @@ class KademliaLogic:
             st, sib_used=jnp.where(start_sib, now_r, st.sib_used))
 
         # app timer
+        # graceful-leave: hand app data to the closest sibling and stop
+        # firing app tests during the grace window (apps/base.py on_leave)
+        st = dataclasses.replace(st, app=app_base.leave_protocol(
+            self.app, st.app, ctx, ob, ev, t0, node_idx, st.sib[0],
+            st.state == READY))
         en_a = (st.state == READY) & (
             self.app.next_event(st.app) < t_end)
         now_a = jnp.maximum(self.app.next_event(st.app), t0)
-        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev, node_idx)
         st = dataclasses.replace(st, app=app)
         seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
                                         rmax)

@@ -1,0 +1,244 @@
+"""MyOverlay — the tutorial overlay skeleton.
+
+Rebuild of src/overlay/myoverlay/ (490 LoC; the website tutorial's
+minimal example, omnetpp.ini MyConfig :502): the smallest complete
+overlay logic the engine accepts, for framework users to copy when
+writing a new protocol.  Ring routing with a single successor pointer:
+
+  * join: draw a bootstrap peer from the oracle and greedy-walk
+    RING_JOIN messages clockwise until the responsible node adopts the
+    joiner (like the tutorial's neighbor exchange);
+  * routing: ``findNode`` returns self when the key falls in
+    (pred, me], else the successor — O(N) hops, deliberately naive;
+  * maintenance: a periodic HELLO to the successor; a silent successor
+    is replaced at the next join retry.
+
+Every engine hook (init/reset/ready_mask/next_event/step) is written in
+the plainest possible style — read this file top to bottom to learn the
+logic interface (engine/logic.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps.dummy import MyApp
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+RING_JOIN = 140     # a=joiner
+RING_JOIN_ACK = 141  # a=your new successor
+RING_HELLO = 142
+
+
+@dataclasses.dataclass(frozen=True)
+class MyOverlayParams:
+    join_delay: float = 10.0
+    hello_interval: float = 20.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MyOverlayState:
+    state: jnp.ndarray   # [N]
+    succ: jnp.ndarray    # [N] the ONE routing pointer
+    pred: jnp.ndarray    # [N]
+    t_join: jnp.ndarray
+    t_hello: jnp.ndarray
+    app: object
+    app_glob: object
+
+
+class MyOverlayLogic:
+    """Tutorial logic (engine interface: engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: MyOverlayParams = MyOverlayParams(), app=None):
+        self.key_spec = spec
+        self.p = params
+        self.app = app or MyApp()
+
+    def stat_spec(self):
+        a = self.app.stat_spec()
+        return stats_mod.StatSpec(
+            scalars=tuple(a["scalars"]) + ("ring_hops",),
+            hists=tuple(a["hists"]),
+            counters=tuple(a["counters"]) + ("ring_joins",))
+
+    def split(self, st):
+        return dataclasses.replace(st, app_glob=None), st.app_glob
+
+    def merge(self, node_part, glob):
+        return dataclasses.replace(node_part, app_glob=glob)
+
+    def post_step(self, ctx, st, events):
+        app, glob = self.app.post_step(ctx, st.app, st.app_glob, events)
+        return dataclasses.replace(st, app=app, app_glob=glob)
+
+    def init(self, rng, n: int) -> MyOverlayState:
+        return MyOverlayState(
+            state=jnp.zeros((n,), I32),
+            succ=jnp.full((n,), NO_NODE, I32),
+            pred=jnp.full((n,), NO_NODE, I32),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_hello=jnp.full((n,), T_INF, I64),
+            app=self.app.init(n),
+            app_glob=self.app.glob_init(rng))
+
+    def reset(self, st, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        glob = st.app_glob
+        st = dataclasses.replace(st, app_glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), app_glob=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, app_glob=glob)
+        jitter = (jax.random.uniform(rng, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st):
+        return st.state == READY
+
+    def next_event(self, st):
+        t = jnp.where(st.state == JOINING, st.t_join, T_INF)
+        t = jnp.minimum(t, jnp.where(st.state == READY, st.t_hello, T_INF))
+        t = jnp.minimum(t, jnp.where(st.state == READY,
+                                     self.app.next_event(st.app), T_INF))
+        return t
+
+    def _is_mine(self, ctx, st, me_key, key):
+        pred_ok = st.pred != NO_NODE
+        pk = ctx.keys[jnp.maximum(st.pred, 0)]
+        return (st.state == READY) & (
+            ~pred_ok | K.is_between_r(key, pk, me_key, self.key_spec))
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, spec = self.p, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        me_key = ctx.keys[node_idx]
+        rngs = jax.random.split(rng, 4)
+        t0, t_end = ctx.t_start, ctx.t_end
+        ev = app_base.AppEvents()
+        joins = jnp.int32(0)
+
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+
+            # RING_JOIN: adopt the joiner as predecessor if its key is
+            # ours to cover, else pass clockwise
+            en = v & (m.kind == RING_JOIN) & (st.state == READY)
+            jk = ctx.keys[jnp.maximum(m.a, 0)]
+            mine = self._is_mine(ctx, st, me_key, jk)
+            adopt = en & mine
+            ob.send(adopt, now, m.a, RING_JOIN_ACK, a=node_idx, b=st.pred,
+                    size_b=16)
+            fwd = en & ~mine & (st.succ != NO_NODE)
+            ob.send(fwd, now, jnp.maximum(st.succ, 0), RING_JOIN, a=m.a,
+                    hops=m.hops + 1, size_b=16)
+            st = dataclasses.replace(
+                st, pred=jnp.where(adopt, m.a, st.pred))
+
+            # RING_JOIN_ACK: my successor is the adopter
+            en = v & (m.kind == RING_JOIN_ACK) & (st.state == JOINING)
+            joins += en.astype(I32)
+            st = dataclasses.replace(
+                st,
+                succ=jnp.where(en, m.src, st.succ),
+                pred=jnp.where(en & (m.b != NO_NODE), m.b, st.pred),
+                state=jnp.where(en, READY, st.state),
+                t_join=jnp.where(en, T_INF, st.t_join),
+                t_hello=jnp.where(en, now, st.t_hello),
+                app=self.app.on_ready(st.app, en, now, rngs[0]))
+            # tell the old predecessor its successor changed
+            ob.send(en & (m.b != NO_NODE), now, jnp.maximum(m.b, 0),
+                    RING_HELLO, a=node_idx, size_b=16)
+
+            # RING_HELLO: adopt a closer successor
+            en = v & (m.kind == RING_HELLO) & (st.state == READY)
+            hk = ctx.keys[jnp.maximum(m.a, 0)]
+            sk = ctx.keys[jnp.maximum(st.succ, 0)]
+            closer = en & (m.a != NO_NODE) & (
+                (st.succ == NO_NODE)
+                | K.is_between(hk, me_key, sk, spec))
+            st = dataclasses.replace(
+                st, succ=jnp.where(closer, m.a, st.succ))
+
+            # routed payload: deliver when responsible (the app checks
+            # the is_sib flag), else forward clockwise
+            en = v & (m.kind == wire.APP_ONEWAY) & (st.state == READY)
+            mine = self._is_mine(ctx, st, me_key, m.key)
+            ev.value("ring_hops", m.hops.astype(jnp.float32), en & mine)
+            ob.send(en & ~mine & (st.succ != NO_NODE), now,
+                    jnp.maximum(st.succ, 0), wire.APP_ONEWAY, key=m.key,
+                    c=m.c, stamp=m.stamp, hops=m.hops + 1, size_b=m.size_b)
+            st = dataclasses.replace(st, app=self.app.on_msg(
+                st.app, m, ctx, ob, ev, mine))
+
+        # join timer
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1], node_idx)
+        alone = en_j & (boot == NO_NODE)
+        joins += alone.astype(I32)
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(alone, READY, st.state),
+            t_hello=jnp.where(alone, now_j, st.t_hello),
+            app=self.app.on_ready(st.app, alone, now_j, rngs[2]),
+            t_join=jnp.where(en_j & ~alone, now_j + jnp.int64(
+                int(p.join_delay * NS)), st.t_join))
+        ob.send(en_j & ~alone, now_j, jnp.maximum(boot, 0), RING_JOIN,
+                a=node_idx, hops=jnp.int32(0), size_b=16)
+
+        # hello timer
+        en_h = (st.state == READY) & (st.t_hello < t_end)
+        now_h = jnp.maximum(st.t_hello, t0)
+        ob.send(en_h & (st.succ != NO_NODE), now_h,
+                jnp.maximum(st.succ, 0), RING_HELLO, a=node_idx, size_b=16)
+        st = dataclasses.replace(st, t_hello=jnp.where(
+            en_h, now_h + jnp.int64(int(p.hello_interval * NS)),
+            st.t_hello))
+
+        # app timer: route the payload clockwise from here
+        st = dataclasses.replace(st, app=app_base.leave_protocol(
+            self.app, st.app, ctx, ob, ev, t0, node_idx, st.succ,
+            st.state == READY))
+        en_a = (st.state == READY) & (self.app.next_event(st.app) < t_end)
+        now_a = jnp.maximum(self.app.next_event(st.app), t0)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3],
+                                     ev, node_idx)
+        st = dataclasses.replace(st, app=app)
+        mine = self._is_mine(ctx, st, me_key, req.key)
+        # local: complete through the app hook; remote: ship clockwise
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=req.want & mine, success=req.want & mine, tag=req.tag,
+                target=req.key,
+                results=jnp.full((4,), NO_NODE, I32).at[0].set(node_idx),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
+        ob.send(req.want & ~mine & (st.succ != NO_NODE), now_a,
+                jnp.maximum(st.succ, 0), wire.APP_ONEWAY, key=req.key,
+                c=ctx.measuring.astype(I32), stamp=now_a,
+                hops=jnp.int32(1), size_b=100)
+
+        events = {"c:ring_joins": joins}
+        ev.finish(events, self.app.hist_map)
+        return st, ob, events

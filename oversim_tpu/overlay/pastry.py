@@ -506,7 +506,7 @@ class PastryLogic:
         # join: lookup own key, then state request to the responsible node
         en_j = (st.state == JOINING) & (st.t_join < t_end)
         now_j = jnp.maximum(st.t_join, t0)
-        boot = ctx.sample_ready(rngs[1])
+        boot = ctx.sample_ready(rngs[1], node_idx)
         no_join_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_JOIN))
         alone_start = en_j & (boot == NO_NODE)
         st = self._become_ready(ctx, st, alone_start, now_j, rngs[2])
@@ -554,9 +554,15 @@ class PastryLogic:
                 int(p.tuning_interval * NS)), st.t_gt))
 
         # app timer
-        en_a = (st.state == READY) & (self.app.next_event(st.app) < t_end)
+        # graceful-leave: hand app data to the clockwise leaf and stop
+        # firing app tests during the grace window (apps/base.py on_leave)
+        st = dataclasses.replace(st, app=app_base.leave_protocol(
+            self.app, st.app, ctx, ob, ev, t0, node_idx, st.leaf_cw[0],
+            st.state == READY))
+        en_a = (st.state == READY) & (
+            self.app.next_event(st.app) < t_end)
         now_a = jnp.maximum(self.app.next_event(st.app), t0)
-        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[5], ev)
+        app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[5], ev, node_idx)
         st = dataclasses.replace(st, app=app)
         seed_a, sib_a, cands_a = self._find_node(ctx, st, me_key, node_idx,
                                                  req.key, rmax)

@@ -1,0 +1,395 @@
+"""Vast — spatial AOI overlay (VON) for games, vectorized.
+
+TPU-native rebuild of the reference Vast (src/overlay/vast/Vast.{h,cc}:
+Voronoi-diagram neighbor discovery with AOI radius — Sites map,
+buildVoronoi Vast.h:98; join via a greedy point query through existing
+neighbors; move/event multicast to AOI neighbors; enclosing-neighbor
+maintenance) driving the SimpleGameClient movement workload
+(apps/movement.py generators).
+
+Engine mapping (no KBR — spatial neighbor logic like GIA's degree
+logic):
+
+  * positions travel ON THE WIRE (2×f32 bitcast into the key field, the
+    ncs piggyback pattern) — no oracle position reads in the protocol;
+  * **join** (Vast::handleJoin): a JOIN carrying the joiner's position
+    greedy-forwards to the neighbor closest to that position until no
+    neighbor is closer than the current node (the reference's point
+    query through the Voronoi), which ACKs with its neighbor list; the
+    joiner HELLOs the listed nodes to exchange positions;
+  * **move** (Vast::handleMove): every ``move_interval`` the position
+    advances (movement generator) and a MOVE multicasts to the current
+    neighbor set; receivers update the mover's stored position, drop it
+    when it leaves the AOI (+hysteresis), and occasionally reply with a
+    HINT listing their own neighbors nearest to the mover — the engine
+    stand-in for enclosing-neighbor discovery (documented deviation: the
+    true Voronoi cell construction is replaced by nearest-K + AOI-disc
+    membership with hint gossip; the published VON behavior without
+    per-node Voronoi tessellation);
+  * neighbors are soft state pruned on silence (``nbr_timeout``).
+
+Stats: joins, moves, position-update deliveries, neighbor count, and
+the mean position error neighbors hold for each node (the game-overlay
+consistency KPI the reference measures via ConnectivityProbeApp/GlobalCoordinator).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import movement as move_mod
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+F32 = jnp.float32
+U32 = jnp.uint32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+# wire kinds (spatial family: 110+)
+V_JOIN = 110        # key=joiner pos, a=joiner slot, hops=greedy hops
+V_JOIN_ACK = 111    # key=acceptor pos, nodes=its neighbors
+V_MOVE = 112        # key=new pos
+V_HINT = 113        # nodes=neighbors near the target
+V_HELLO = 114       # key=pos, a=1 → ack requested
+V_BYE = 115         # graceful neighbor removal
+
+
+@dataclasses.dataclass(frozen=True)
+class VastParams:
+    aoi: float = 100.0            # AOIWidth (Vast.ned)
+    max_nbr: int = 8              # neighbor set bound (D)
+    move_interval: float = 5.0
+    join_delay: float = 10.0
+    nbr_timeout: float = 30.0     # soft-state prune
+    hint_prob: float = 0.25       # HINT reply probability per MOVE
+    join_ttl: int = 16            # greedy-forward bound
+    move: move_mod.MoveParams = move_mod.MoveParams(
+        field=300.0, speed=5.0)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class VastState:
+    state: jnp.ndarray     # [N] i32
+    pos: jnp.ndarray       # [N, 2] f32
+    wp: jnp.ndarray        # [N, 2] f32
+    nbr: jnp.ndarray       # [N, D] i32
+    nbr_pos: jnp.ndarray   # [N, D, 2] f32
+    nbr_seen: jnp.ndarray  # [N, D] i64
+    t_join: jnp.ndarray    # [N] i64
+    t_move: jnp.ndarray    # [N] i64
+    t_prune: jnp.ndarray   # [N] i64
+    seq: jnp.ndarray       # [N] i32
+
+
+def _pack_pos(pos, lanes: int):
+    words = jax.lax.bitcast_convert_type(pos.astype(F32), U32)
+    return jnp.zeros((lanes,), U32).at[:2].set(words)
+
+
+def _unpack_pos(key):
+    return jax.lax.bitcast_convert_type(key[:2], F32)
+
+
+class VastLogic:
+    """Engine logic interface (engine/logic.py docstring)."""
+
+    PREFIX = "vast"    # stat prefix (subclasses: quon)
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: VastParams = VastParams()):
+        self.key_spec = spec
+        self.p = params
+
+    def stat_spec(self) -> stats_mod.StatSpec:
+        x = self.PREFIX
+        return stats_mod.StatSpec(
+            scalars=(f"{x}_nbr_count", f"{x}_pos_err"),
+            hists=(),
+            counters=(f"{x}_joins", f"{x}_moves", f"{x}_updates",
+                      f"{x}_hints", f"{x}_join_fwd"))
+
+    def init(self, rng, n: int) -> VastState:
+        d = self.p.max_nbr
+        pos, wp = move_mod.init_positions(rng, n, self.p.move)
+        return VastState(
+            state=jnp.zeros((n,), I32),
+            pos=pos, wp=wp,
+            nbr=jnp.full((n, d), NO_NODE, I32),
+            nbr_pos=jnp.zeros((n, d, 2), F32),
+            nbr_seen=jnp.zeros((n, d), I64),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_move=jnp.full((n,), T_INF, I64),
+            t_prune=jnp.full((n,), T_INF, I64),
+            seq=jnp.zeros((n,), I32))
+
+    def split(self, st):
+        return st, None
+
+    def merge(self, node_part, glob):
+        return node_part
+
+    def post_step(self, ctx, st, events):
+        return st
+
+    def reset(self, st: VastState, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        r_i, r_j = jax.random.split(rng)
+        fresh = self.init(r_i, n)
+        st = select_tree(clear, fresh, st)
+        jitter = (jax.random.uniform(r_j, (n,)) * 0.1 * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st: VastState):
+        return st.state == READY
+
+    def next_event(self, st: VastState):
+        joining = st.state == JOINING
+        ready = st.state == READY
+        t = jnp.where(joining, st.t_join, T_INF)
+        t = jnp.minimum(t, jnp.where(ready, st.t_move, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, st.t_prune, T_INF))
+        return t
+
+    # -- neighbor set ---------------------------------------------------------
+
+    def _nbr_put(self, st, cands, cand_pos, now, me_pos, node_idx):
+        """Merge candidates into the nearest-D neighbor set (the engine's
+        stand-in for the Voronoi site set: nearest-K ∪ AOI disc)."""
+        d = self.p.max_nbr
+        cands = jnp.where(cands == node_idx, NO_NODE, cands)
+        aug = jnp.concatenate([st.nbr, cands])
+        augp = jnp.concatenate([st.nbr_pos, cand_pos])
+        augs = jnp.concatenate([st.nbr_seen,
+                                jnp.where(cands != NO_NODE, now, 0)])
+        # duplicates: a re-announced neighbor refreshes pos + seen —
+        # candidates override existing entries (candidates come later,
+        # keep LAST occurrence by invalidating earlier dups)
+        rev = aug[::-1]
+        dup_rev = K.dup_mask(rev)
+        dup = dup_rev[::-1]
+        aug = jnp.where(dup, NO_NODE, aug)
+        dist = jnp.sqrt(jnp.sum((augp - me_pos[None, :]) ** 2, axis=-1))
+        dist = jnp.where(aug == NO_NODE, jnp.float32(1e30), dist)
+        order = jnp.argsort(dist)
+        aug, augp, augs = aug[order], augp[order], augs[order]
+        return dataclasses.replace(
+            st, nbr=aug[:d], nbr_pos=augp[:d], nbr_seen=augs[:d])
+
+    def _nbr_drop(self, st, bad):
+        hit = (st.nbr[:, None] == jnp.atleast_1d(bad)[None, :]).any(-1) & (
+            st.nbr != NO_NODE)
+        return dataclasses.replace(
+            st,
+            nbr=jnp.where(hit, NO_NODE, st.nbr),
+            nbr_seen=jnp.where(hit, 0, st.nbr_seen))
+
+    def _closest_to(self, st, target_pos):
+        """(neighbor slot closest to target, its distance)."""
+        dist = jnp.sqrt(jnp.sum(
+            (st.nbr_pos - target_pos[None, :]) ** 2, axis=-1))
+        dist = jnp.where(st.nbr == NO_NODE, jnp.float32(1e30), dist)
+        j = jnp.argmin(dist)
+        return st.nbr[j], dist[j]
+
+    # -- the per-node step ----------------------------------------------------
+
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, spec = self.p, self.key_spec
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        rngs = jax.random.split(rng, 6)
+        t0 = ctx.t_start
+        t_end = ctx.t_end
+        d = p.max_nbr
+
+        joins_cnt = jnp.int32(0)
+        moves_cnt = jnp.int32(0)
+        upd_cnt = jnp.int32(0)
+        hint_cnt = jnp.int32(0)
+        fwd_cnt = jnp.int32(0)
+
+        def pad_nodes(vec):
+            out = jnp.full((rmax,), NO_NODE, I32)
+            k = min(vec.shape[0], rmax)
+            return out.at[:k].set(vec[:k])
+
+        # ------------------------------------------------------- inbox -----
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+            mpos = _unpack_pos(m.key)
+
+            # JOIN: greedy point query (Vast::handleJoinRequest).  Forward
+            # to the neighbor closest to the joiner unless we are closest.
+            en = v & (m.kind == V_JOIN) & (st.state == READY)
+            cn, cd = self._closest_to(st, mpos)
+            my_d = jnp.sqrt(jnp.sum((st.pos - mpos) ** 2))
+            fwd = en & (cn != NO_NODE) & (cd < my_d) & (m.hops < p.join_ttl) \
+                & (cn != m.a)
+            ob.send(fwd, now, cn, V_JOIN, key=m.key, a=m.a,
+                    hops=m.hops + 1, size_b=24)
+            fwd_cnt += fwd.astype(I32)
+            acc = en & ~fwd
+            ob.send(acc, now, m.a, V_JOIN_ACK,
+                    key=_pack_pos(st.pos, spec.lanes),
+                    nodes=pad_nodes(st.nbr), size_b=24 + 6 * d)
+            # the acceptor adopts the joiner too
+            st = select_tree(acc, self._nbr_put(
+                st, m.a[None], mpos[None], now, st.pos, node_idx), st)
+
+            # JOIN_ACK: adopt the acceptor; HELLO its neighbors
+            en = v & (m.kind == V_JOIN_ACK) & (st.state == JOINING)
+            st = select_tree(en, self._nbr_put(
+                st, m.src[None], mpos[None], now, st.pos, node_idx), st)
+            for j in range(d):
+                cand = m.nodes[j]
+                ob.send(en & (cand != NO_NODE) & (cand != node_idx), now,
+                        jnp.maximum(cand, 0), V_HELLO,
+                        key=_pack_pos(st.pos, spec.lanes), a=jnp.int32(1),
+                        size_b=24)
+            joins_cnt += en.astype(I32)
+            st = dataclasses.replace(
+                st,
+                state=jnp.where(en, READY, st.state),
+                t_join=jnp.where(en, T_INF, st.t_join),
+                t_move=jnp.where(en, now + jnp.int64(
+                    int(p.move_interval * NS)), st.t_move),
+                t_prune=jnp.where(en, now + jnp.int64(
+                    int(p.nbr_timeout / 2 * NS)), st.t_prune))
+
+            # HELLO: position exchange; adopt if near
+            en = v & (m.kind == V_HELLO) & (st.state == READY)
+            st = select_tree(en, self._nbr_put(
+                st, m.src[None], mpos[None], now, st.pos, node_idx), st)
+            ob.send(en & (m.a != 0), now, m.src, V_HELLO,
+                    key=_pack_pos(st.pos, spec.lanes), a=jnp.int32(0),
+                    size_b=24)
+
+            # MOVE: refresh the mover; drop if it left the AOI (+50%
+            # hysteresis); occasionally HINT our nearest neighbors
+            en = v & (m.kind == V_MOVE) & (st.state == READY)
+            dist_m = jnp.sqrt(jnp.sum((st.pos - mpos) ** 2))
+            keep = en & (dist_m <= 1.5 * p.aoi)
+            st = select_tree(keep, self._nbr_put(
+                st, m.src[None], mpos[None], now, st.pos, node_idx), st)
+            st = select_tree(en & ~keep, self._nbr_drop(st, m.src), st)
+            upd_cnt += keep.astype(I32)
+            do_hint = keep & (jax.random.uniform(
+                jax.random.fold_in(rngs[4], r), ()) < p.hint_prob)
+            # neighbors nearest to the MOVER (enclosing-discovery hint)
+            hd = jnp.sqrt(jnp.sum((st.nbr_pos - mpos[None, :]) ** 2,
+                                  axis=-1))
+            hd = jnp.where((st.nbr == NO_NODE) | (st.nbr == m.src),
+                           jnp.float32(1e30), hd)
+            order = jnp.argsort(hd)
+            hint_nodes = jnp.where(hd[order] < p.aoi, st.nbr[order],
+                                   NO_NODE)[:4]
+            ob.send(do_hint & jnp.any(hint_nodes != NO_NODE), now, m.src,
+                    V_HINT, nodes=pad_nodes(hint_nodes), size_b=6 * 4)
+            hint_cnt += do_hint.astype(I32)
+
+            # HINT: HELLO unknown hinted nodes
+            en = v & (m.kind == V_HINT) & (st.state == READY)
+            for j in range(4):
+                cand = m.nodes[j]
+                known = jnp.any(st.nbr == cand)
+                ob.send(en & (cand != NO_NODE) & (cand != node_idx)
+                        & ~known, now, jnp.maximum(cand, 0), V_HELLO,
+                        key=_pack_pos(st.pos, spec.lanes), a=jnp.int32(1),
+                        size_b=24)
+
+            # BYE: graceful removal
+            en = v & (m.kind == V_BYE)
+            st = select_tree(en, self._nbr_drop(st, m.src), st)
+
+        # ------------------------------------------------------- timers ----
+        # join (greedy point query seeded at a bootstrap node)
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        boot = ctx.sample_ready(rngs[1], node_idx)
+        alone = en_j & (boot == NO_NODE)
+        joins_cnt += alone.astype(I32)
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(alone, READY, st.state),
+            t_move=jnp.where(alone, now_j + jnp.int64(
+                int(p.move_interval * NS)), st.t_move),
+            t_prune=jnp.where(alone, now_j + jnp.int64(
+                int(p.nbr_timeout / 2 * NS)), st.t_prune),
+            t_join=jnp.where(
+                alone, T_INF,
+                jnp.where(en_j, now_j + jnp.int64(
+                    int(p.join_delay * NS)), st.t_join)))
+        ob.send(en_j & ~alone, now_j, jnp.maximum(boot, 0), V_JOIN,
+                key=_pack_pos(st.pos, spec.lanes), a=node_idx,
+                hops=jnp.int32(0), size_b=24)
+
+        # move + update multicast (Vast::handleMove + movement generator)
+        en_m = (st.state == READY) & (st.t_move < t_end) \
+            & ~ctx.leaving[node_idx]
+        now_m = jnp.maximum(st.t_move, t0)
+        new_pos, new_wp = move_mod.step(st.pos, st.wp,
+                                        jnp.float32(p.move_interval),
+                                        rngs[2], p.move)
+        st = dataclasses.replace(
+            st,
+            pos=jnp.where(en_m, new_pos, st.pos),
+            wp=jnp.where(en_m, new_wp, st.wp),
+            t_move=jnp.where((st.state == READY) & (st.t_move < t_end),
+                             now_m + jnp.int64(int(p.move_interval * NS)),
+                             st.t_move))
+        moves_cnt += en_m.astype(I32)
+        for j in range(d):
+            tgt = st.nbr[j]
+            ob.send(en_m & (tgt != NO_NODE), now_m, jnp.maximum(tgt, 0),
+                    V_MOVE, key=_pack_pos(st.pos, spec.lanes), size_b=24)
+
+        # prune silent neighbors (soft state)
+        en_p = (st.state == READY) & (st.t_prune < t_end)
+        now_p = jnp.maximum(st.t_prune, t0)
+        stale = (st.nbr != NO_NODE) & (
+            st.nbr_seen + jnp.int64(int(p.nbr_timeout * NS)) < now_p)
+        st = dataclasses.replace(
+            st,
+            nbr=jnp.where(en_p & stale, NO_NODE, st.nbr),
+            nbr_seen=jnp.where(en_p & stale, 0, st.nbr_seen),
+            t_prune=jnp.where(en_p, now_p + jnp.int64(
+                int(p.nbr_timeout / 2 * NS)), st.t_prune))
+        # a READY node with no neighbors rejoins (lost the overlay)
+        lost = (st.state == READY) & en_p & ~jnp.any(st.nbr != NO_NODE) \
+            & (ctx.n_ready > 1)
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(lost, JOINING, st.state),
+            t_join=jnp.where(lost, now_p, st.t_join),
+            t_move=jnp.where(lost, T_INF, st.t_move),
+            t_prune=jnp.where(lost, T_INF, st.t_prune))
+
+        # ------------------------------------------------------ events -----
+        nbr_n = jnp.sum((st.nbr != NO_NODE).astype(I32))
+        x = self.PREFIX
+        events = {
+            f"c:{x}_joins": joins_cnt,
+            f"c:{x}_moves": moves_cnt,
+            f"c:{x}_updates": upd_cnt,
+            f"c:{x}_hints": hint_cnt,
+            f"c:{x}_join_fwd": fwd_cnt,
+            f"s:{x}_nbr_count": (nbr_n.astype(F32)[None],
+                                 (st.state == READY)[None]),
+            f"s:{x}_pos_err": (jnp.zeros((1,), F32), jnp.zeros((1,), bool)),
+        }
+        return st, ob, events

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 I64 = jnp.int64
 F32 = jnp.float32
 NS = 1_000_000_000  # ns per second
+T_MAX = jnp.int64(2**62)
 
 # Channel catalogue (reference: src/common/channels.ned:3-34).
 # columns: bandwidth bit/s, access delay s, bit error rate
@@ -57,12 +58,49 @@ class UnderlayParams:
     send_queue_bytes: int = 1_000_000  # default.ini:553 "1MB"
     channel_types: tuple = ("simple_ethernetline",)
     header_bytes: int = 28             # UDP(8) + IP(20), SimpleUDP.cc:291
+    # --- node-type partitions (GlobalNodeList connectionMatrix,
+    # GlobalNodeList.h:232-235 + SimpleUDP.cc:349-358 partition drop;
+    # driven by CONNECT/DISCONNECT_NODETYPES trace events,
+    # simulations/partition.trace) ---
+    num_node_types: int = 1
+    # slots < type_boundaries[0] are type 0, < [1] type 1, ...; the last
+    # type takes the rest (multiple ChurnGenerators = one type each,
+    # ChurnGenerator.h:42-50)
+    type_boundaries: tuple = ()
+    # static schedule: (time_s, type_a, type_b, connect) — applied in
+    # order; the matrix starts fully connected
+    partition_events: tuple = ()
 
     @property
     def channel_table(self):
         """[C, 3] float32 table of (bandwidth, access_delay, ber)."""
         rows = [CHANNELS[c] for c in self.channel_types]
         return jnp.asarray(rows, dtype=F32)
+
+
+def node_types(n: int, p: UnderlayParams) -> jnp.ndarray:
+    """[N] i32 node type per slot from the static boundaries."""
+    t = jnp.zeros((n,), jnp.int32)
+    for b in p.type_boundaries:
+        t = t + (jnp.arange(n) >= b).astype(jnp.int32)
+    return jnp.clip(t, 0, p.num_node_types - 1)
+
+
+def connection_matrix(p: UnderlayParams, t_now) -> jnp.ndarray:
+    """[T, T] bool connectivity at simulated time ``t_now`` (ns scalar),
+    replayed from the static partition schedule each tick (the reference
+    mutates GlobalNodeList::connectionMatrix via trace commands).
+
+    Events are ONE-directional like the reference's connect/
+    disconnectNodeTypes (GlobalNodeList.cc; simulations/partition.trace
+    issues both directions explicitly) — a full split needs (a,b) and
+    (b,a) events."""
+    t = p.num_node_types
+    conn = jnp.ones((t, t), bool)
+    for (ts, a, b, connect) in p.partition_events:
+        en = jnp.int64(int(ts * NS)) <= t_now
+        conn = conn.at[a, b].set(jnp.where(en, bool(connect), conn[a, b]))
+    return conn
 
 
 @jax.tree_util.register_dataclass
@@ -73,6 +111,7 @@ class UnderlayState:
     coords: jnp.ndarray       # [N, D] f32
     channel: jnp.ndarray      # [N] i32 index into channel_table
     tx_finished: jnp.ndarray  # [N] i64 ns — when the send queue drains
+    node_type: jnp.ndarray    # [N] i32 — churn-generator/partition type
 
 
 def init(rng: jax.Array, n: int, p: UnderlayParams) -> UnderlayState:
@@ -84,7 +123,8 @@ def init(rng: jax.Array, n: int, p: UnderlayParams) -> UnderlayState:
         xk, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
     channel = jax.random.randint(ck, (n,), 0, len(p.channel_types), dtype=jnp.int32)
     return UnderlayState(coords=coords, channel=channel,
-                         tx_finished=jnp.zeros((n,), dtype=I64))
+                         tx_finished=jnp.zeros((n,), dtype=I64),
+                         node_type=node_types(n, p))
 
 
 def migrate(state: UnderlayState, mask, rng, p: UnderlayParams) -> UnderlayState:
@@ -95,8 +135,8 @@ def migrate(state: UnderlayState, mask, rng, p: UnderlayParams) -> UnderlayState
         rng, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
     coords = jnp.where(mask[:, None], new_coords, state.coords)
     tx_finished = jnp.where(mask, jnp.int64(0), state.tx_finished)
-    return UnderlayState(coords=coords, channel=state.channel,
-                         tx_finished=tx_finished)
+    return dataclasses.replace(state, coords=coords,
+                               tx_finished=tx_finished)
 
 
 @partial(jax.jit, static_argnames=("p",))
@@ -173,14 +213,22 @@ def send_batch(state: UnderlayState, p: UnderlayParams, rng,
     bit_error = queued & (u < bit_err_p)
     dest_dead = want & ~alive[dst]
 
-    ok = want & ~overrun & ~bit_error & ~dest_dead
+    # node-type partition drop (SimpleUDP.cc:349-358:
+    # !areNodeTypesConnected(src, dst) → numPartitionLost)
+    if p.partition_events:
+        conn = connection_matrix(p, jnp.min(jnp.where(want, t_send, T_MAX)))
+        part_cut = want & ~conn[state.node_type[src], state.node_type[dst]]
+    else:
+        part_cut = jnp.zeros_like(want)
+
+    ok = want & ~overrun & ~bit_error & ~dest_dead & ~part_cut
     t_deliver = jnp.where(self_send, t_send, t_send + total_ns)
 
-    new_state = UnderlayState(coords=state.coords, channel=state.channel,
-                              tx_finished=new_tx_finished)
+    new_state = dataclasses.replace(state, tx_finished=new_tx_finished)
     drops = {
         "queue_lost": jnp.sum(overrun & want),
         "bit_error_lost": jnp.sum(bit_error),
         "dest_unavailable_lost": jnp.sum(dest_dead),
+        "partition_lost": jnp.sum(part_cut),
     }
     return t_deliver, ok, new_state, drops

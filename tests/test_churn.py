@@ -34,6 +34,11 @@ def test_pareto_individual_means_stretch():
                               lifetime_mean=1000.0)
     st = churn_mod.init(jax.random.PRNGKey(2), p)
     l, d = np.asarray(st.l_mean, float), np.asarray(st.d_mean, float)
+    # normalization runs over exactly the participating (drawn) population
+    # (ParetoChurn.cc:98-105); non-participating surplus slots are parked at
+    # T_INF and never exist
+    part = np.asarray(st.t_create, float) < float(churn_mod.T_INF) / 2
+    l, d = l[part], d[part]
     sum_li = (1.0 / (l + d)).sum()
     mean_life = (l / ((l + d) * sum_li)).sum()
     np.testing.assert_allclose(mean_life, 1000.0, rtol=1e-3)
@@ -52,22 +57,29 @@ def test_pareto_equilibrium_population():
 
 
 def test_random_churn_ticks():
+    # graceful delay 0 so the pre-kill → grace → kill pipeline resolves
+    # within the stepped windows (kill lands one step after the pre-kill)
     p = churn_mod.ChurnParams(model="random", target_num=8,
                               init_interval=0.1,
                               churn_change_interval=5.0,
                               creation_probability=0.0,
-                              removal_probability=1.0)
+                              removal_probability=1.0,
+                              graceful_leave_delay=0.0)
     st = churn_mod.init(jax.random.PRNGKey(4), p)
     alive = jnp.zeros((p.num_slots,), bool).at[:8].set(True)
-    # drive three ticks: each must schedule one kill
-    kills = 0
     t = st.t_tick
-    for i in range(3):
-        st, created, killed = churn_mod.step(
+    for i in range(4):
+        # each tick window schedules one removal; a follow-up window over
+        # the scheduled pre-kill/kill events retires it
+        st, created, killed, leaving = churn_mod.step(
             st, p, alive, t, t + jnp.int64(1), jax.random.PRNGKey(10 + i))
         alive = (alive | created) & ~killed
+        t_ev = churn_mod.next_event(st)
+        st, created, killed, leaving = churn_mod.step(
+            st, p, alive, t_ev, t_ev + jnp.int64(1),
+            jax.random.PRNGKey(50 + i))
+        alive = (alive | created) & ~killed
         t = st.t_tick
-    # killed nodes scheduled inside the stepped windows
     assert int(jnp.sum(~alive[:8])) >= 1
 
 

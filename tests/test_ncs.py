@@ -1,0 +1,105 @@
+"""Network-coordinate + NeighborCache unit tests (reference
+src/common/Vivaldi.cc, NeighborCache.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu.common import ncs as ncs_mod
+from oversim_tpu.common import neighborcache as nc_mod
+
+
+def _true_rtts(n, rng):
+    pos = jax.random.uniform(rng, (n, 2), minval=0.0, maxval=0.1)
+    d = pos[:, None, :] - pos[None, :, :]
+    return jnp.sqrt(jnp.sum(d * d, axis=-1)), pos
+
+
+def _embedding_err(st, rtt):
+    n = rtt.shape[0]
+    pred = ncs_mod.distance(st.coords[:, None, :], st.height[:, None],
+                            st.coords[None, :, :], st.height[None, :])
+    mask = ~jnp.eye(n, dtype=bool)
+    return float(jnp.mean(jnp.abs(pred - rtt)[mask]))
+
+
+def test_vivaldi_converges():
+    """Spring relaxation must shrink the mean embedding error by >5x on a
+    synthetic euclidean RTT matrix."""
+    n = 24
+    p = ncs_mod.NcsParams(ncs_type="vivaldi")
+    rng = jax.random.PRNGKey(0)
+    rtt, _ = _true_rtts(n, rng)
+    st = ncs_mod.init(jax.random.PRNGKey(1), n, p)
+    err0 = _embedding_err(st, rtt)
+
+    def one_round(st, r):
+        # every node samples one random peer
+        peers = jax.random.randint(r, (n,), 0, n)
+        me = dict(coords=st.coords, height=st.height, error=st.error,
+                  loss=st.loss)
+        upd = jax.vmap(
+            lambda i, pr: ncs_mod.update(
+                jax.tree.map(lambda x: x[i], me),
+                jnp.where(pr == i, -1.0, rtt[i, pr]),
+                st.coords[pr], st.error[pr], st.height[pr], p))(
+                    jnp.arange(n), peers)
+        return ncs_mod.NcsState(**upd)
+
+    for i in range(300):
+        st = one_round(st, jax.random.PRNGKey(100 + i))
+    err1 = _embedding_err(st, rtt)
+    assert err1 < err0 / 5, (err0, err1)
+    # error estimates must have dropped below the initial 1.0
+    assert float(jnp.mean(st.error)) < 0.5
+
+
+def test_simplencs_is_ground_truth():
+    coords = jnp.asarray([[0.0, 0.0], [30.0, 40.0]])
+    st = ncs_mod.from_underlay(coords, delay_per_unit=0.001)
+    d = ncs_mod.distance(st.coords[0], st.height[0],
+                         st.coords[1], st.height[1])
+    np.testing.assert_allclose(float(d), 0.05, rtol=1e-5)  # 50 coord units
+
+
+def test_neighborcache_timeouts():
+    nc = nc_mod.init(1, nc_mod.NcParams(capacity=4))
+    row = nc_mod.slice_of(nc, 0)
+    # unknown peer → default timeout
+    assert float(nc_mod.node_timeout(row, jnp.int32(5), 1.5)) == 1.5
+    # one sample → mean*1.2*1.3
+    row = nc_mod.insert_rtt(row, jnp.int32(5), jnp.float32(0.1),
+                            jnp.int64(100))
+    t = float(nc_mod.node_timeout(row, jnp.int32(5), 1.5))
+    np.testing.assert_allclose(t, 0.1 * 1.2 * 1.3, rtol=1e-5)
+    # repeated samples tighten toward mean + 4 var
+    for i in range(6):
+        row = nc_mod.insert_rtt(row, jnp.int32(5), jnp.float32(0.1),
+                                jnp.int64(200 + i))
+    t = float(nc_mod.node_timeout(row, jnp.int32(5), 1.5))
+    assert 0.1 < t < 0.3
+    rtt, alive = nc_mod.get_prox(row, jnp.int32(5))
+    np.testing.assert_allclose(float(rtt), 0.1, rtol=1e-4)
+    assert bool(alive)
+
+
+def test_neighborcache_eviction_lru():
+    nc = nc_mod.init(1, nc_mod.NcParams(capacity=2))
+    row = nc_mod.slice_of(nc, 0)
+    row = nc_mod.insert_rtt(row, jnp.int32(1), jnp.float32(0.1), jnp.int64(1))
+    row = nc_mod.insert_rtt(row, jnp.int32(2), jnp.float32(0.2), jnp.int64(2))
+    row = nc_mod.insert_rtt(row, jnp.int32(3), jnp.float32(0.3), jnp.int64(3))
+    r1, _ = nc_mod.get_prox(row, jnp.int32(1))
+    r3, _ = nc_mod.get_prox(row, jnp.int32(3))
+    assert float(r1) == -1.0      # evicted (oldest)
+    assert float(r3) > 0
+
+
+def test_timeout_state():
+    nc = nc_mod.init(1, nc_mod.NcParams(capacity=4))
+    row = nc_mod.slice_of(nc, 0)
+    row = nc_mod.insert_rtt(row, jnp.int32(7), jnp.float32(0.05),
+                            jnp.int64(10))
+    row = nc_mod.set_state(row, jnp.int32(7), nc_mod.S_TIMEOUT)
+    _, alive = nc_mod.get_prox(row, jnp.int32(7))
+    assert not bool(alive)

@@ -1,0 +1,49 @@
+"""P2PNS name service over Chord: register, resolve, cache
+(reference src/tier2/p2pns)."""
+
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.p2pns import P2pnsApp, P2pnsParams
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic
+
+
+N = 16
+
+
+@pytest.fixture(scope="module")
+def p2pns_run():
+    app = P2pnsApp(P2pnsParams(resolve_interval=15.0, keepalive=60.0),
+                   num_slots=N)
+    logic = ChordLogic(app=app)
+    cp = churn_mod.ChurnParams(model="none", target_num=N, init_interval=0.5)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=80.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=17)
+    st = s.run_until(st, 400.0, chunk=512)
+    return s, st
+
+
+def test_resolutions_succeed(p2pns_run):
+    s, st = p2pns_run
+    out = s.summary(st)
+    assert out["p2pns_registers"] >= N, out
+    assert out["p2pns_stored"] >= N, out
+    assert out["p2pns_resolves"] > 50, out
+    answered = out["p2pns_resolve_success"]
+    assert answered / out["p2pns_resolves"] > 0.8, out
+
+
+def test_cache_used(p2pns_run):
+    s, st = p2pns_run
+    out = s.summary(st)
+    # with 16 names and a resolve every 15s, repeats hit the cache
+    assert out["p2pns_cache_hits"] > 5, out
+
+
+def test_no_engine_losses(p2pns_run):
+    s, st = p2pns_run
+    eng = s.summary(st)["_engine"]
+    assert eng["pool_overflow"] == 0
+    assert eng["outbox_overflow"] == 0

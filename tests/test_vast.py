@@ -1,0 +1,77 @@
+"""Vast spatial overlay: join via greedy point query, AOI neighbor
+consistency, move-update delivery (reference src/overlay/vast)."""
+
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.vast import VastLogic, VastParams, READY
+
+
+N = 16
+
+
+@pytest.fixture(scope="module")
+def vast_run():
+    logic = VastLogic(params=VastParams())
+    cp = churn_mod.ChurnParams(model="none", target_num=N, init_interval=0.5)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=60.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=31)
+    st = s.run_until(st, 300.0, chunk=512)
+    return s, st
+
+
+def test_all_ready(vast_run):
+    _, st = vast_run
+    assert (np.asarray(st.logic.state) == READY).all()
+
+
+def test_aoi_neighbors_known(vast_run):
+    """Most pairs within the AOI radius must know each other."""
+    _, st = vast_run
+    p = VastParams()
+    pos = np.asarray(st.logic.pos)
+    nbr = np.asarray(st.logic.nbr)
+    want = have = 0
+    for i in range(N):
+        for j in range(N):
+            if i == j:
+                continue
+            if np.linalg.norm(pos[i] - pos[j]) < p.aoi * 0.8:
+                want += 1
+                if j in nbr[i]:
+                    have += 1
+    assert want > 0
+    assert have / want > 0.7, (have, want)
+
+
+def test_position_updates_flow(vast_run):
+    """Stored neighbor positions must track the real ones within a couple
+    of movement steps."""
+    s, st = vast_run
+    p = VastParams()
+    out = s.summary(st)
+    assert out["vast_moves"] > 100, out
+    assert out["vast_updates"] > 200, out
+    pos = np.asarray(st.logic.pos)
+    nbr = np.asarray(st.logic.nbr)
+    nbr_pos = np.asarray(st.logic.nbr_pos)
+    errs = []
+    for i in range(N):
+        for d in range(nbr.shape[1]):
+            j = nbr[i, d]
+            if j >= 0:
+                errs.append(np.linalg.norm(nbr_pos[i, d] - pos[j]))
+    assert errs
+    # within ~2 movement steps of truth on average
+    assert np.mean(errs) <= 2.5 * p.move.speed * p.move_interval, \
+        np.mean(errs)
+
+
+def test_no_engine_losses(vast_run):
+    s, st = vast_run
+    eng = s.summary(st)["_engine"]
+    assert eng["pool_overflow"] == 0
+    assert eng["outbox_overflow"] == 0
