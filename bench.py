@@ -41,7 +41,8 @@ def _probe_platform() -> str | None:
     env = os.environ.get("OVERSIM_BENCH_PLATFORM")
     if env:
         return None if env in ("axon", "default") else env
-    code = ("import jax; d = jax.devices()[0]; "
+    code = ("import sys; sys.modules['zstandard'] = None; "
+            "import jax; d = jax.devices()[0]; "
             "import jax.numpy as jnp; jnp.zeros(()).block_until_ready(); "
             "print(d.platform)")
     try:
@@ -62,6 +63,8 @@ def _probe_platform() -> str | None:
 
 _PLATFORM = _probe_platform()
 
+import sys
+sys.modules["zstandard"] = None  # zlib cache compression (zstd C ext segfaults here)
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)

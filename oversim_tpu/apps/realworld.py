@@ -1,0 +1,51 @@
+"""Real-network test apps served through the gateway.
+
+Rebuilds of src/applications/realworldtestapp/ (RealWorldTestApp.{h,cc}:
+echoes packets arriving from the real network through the
+singlehost underlay, uppercasing the payload) and src/applications/
+tcpexampleapp/ (TCPExampleApp.{h,cc}: a TCP echo/request-response demo
+over SimpleTCP).
+
+Both collapse to the same sim-side behavior here because the gateway
+(oversim_tpu/gateway.py) normalizes UDP datagrams and TCP frames into
+``EXT_IN`` messages: the app answers every EXT_IN with an EXT_OUT
+carrying the transformed payload word, routed back to the originating
+real peer by the gateway's session table.  The transport difference
+(datagram vs length-prefixed stream) lives entirely in the gateway,
+exactly as the reference keeps it inside the underlay's message
+parsers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps.dummy import TierDummyApp, _Empty
+from oversim_tpu.gateway import EXT_IN, EXT_OUT
+
+I32 = jnp.int32
+
+
+class RealworldEchoApp(TierDummyApp):
+    """EXT_IN → EXT_OUT responder (RealWorldTestApp::handleRealworldPacket
+    semantics: respond to the real peer with a transformed payload)."""
+
+    def __init__(self, transform: int = 1):
+        # the reference uppercases the text payload; the 32-bit payload
+        # word comes back incremented by ``transform`` so tests can
+        # verify the packet actually traversed the simulated node
+        self.transform = transform
+
+    def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        en = m.valid & (m.kind == EXT_IN)
+        ob.send(en, m.t_deliver, m.src, EXT_OUT, a=m.a, b=m.b,
+                c=m.c + self.transform, size_b=16)
+        return app
+
+
+class TcpEchoApp(RealworldEchoApp):
+    """TCPExampleApp equivalent — identical sim-side logic; pair with a
+    gateway constructed with ``tcp_port`` so frames arrive via TCP."""

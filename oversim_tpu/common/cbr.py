@@ -1,0 +1,136 @@
+"""Coordinate-based routing (CBR): topology-aware NodeID assignment.
+
+TPU-native rebuild of src/common/cbr/ (CoordBasedRouting.{h,cc}: the
+global module maps a node's network-coordinate position to a NodeID
+*prefix* so that key-space neighbors are also network-close; CBR-DHT.cc
+then exploits the mapping for proximity-aware replica placement).
+
+The reference loads a precomputed area tree from XML
+(`areaCoordinateSource`, parseSource CoordBasedRouting.cc:66-118): a
+binary space partition of the d-dimensional coordinate field where each
+leaf area carries the NodeID prefix of its partition path.  The rebuild
+generates the same structure directly — a balanced k-d partition of
+``depth`` alternating-axis halvings — and evaluates it as pure tensor
+arithmetic, so assigning IDs to a whole [N, D] coordinate batch is one
+vectorized call (no per-node tree walk):
+
+  * ``prefix_bits(coords)``  — quantize each axis to ``depth/d`` bits
+    and interleave them along the partition order (axis ``i % d`` is
+    split at step ``i``), giving exactly the leaf prefix the
+    reference's getPrefix() tree walk returns for a balanced source;
+  * ``node_id(coords, rng)`` — prefix bits between ``start_at_digit``
+    and ``stop_at_digit`` (CBRstartAtDigit/CBRstopAtDigit params,
+    CoordBasedRouting.h:102-104), remaining bits randomized
+    (getNodeId :150 "Non-prefix bits are currently randomized");
+  * ``key_to_center(key)`` — inverse mapping: decode a key's prefix to
+    its area's center coordinates
+    (getEuclidianDistanceByKeyAndCoords :180 uses this to estimate the
+    network distance to a key's responsible region).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.core import keys as keys_mod
+
+F32 = jnp.float32
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@dataclasses.dataclass(frozen=True)
+class CbrParams:
+    """CoordBasedRouting.ned params (defaults per default.ini CBR off)."""
+
+    dims: int = 2                 # xmlDimensions
+    depth: int = 12               # leaf count = 2^depth areas
+    field_min: float = 0.0
+    field_max: float = 150.0      # matches SimpleUnderlay fieldSize
+    start_at_digit: int = 0       # CBRstartAtDigit
+    stop_at_digit: int = 12       # CBRstopAtDigit (prefix length, bits
+                                  # at bpd=1)
+
+
+def prefix_bits(coords, p: CbrParams):
+    """[..., D] f32 coords → [...] u32 area prefix of ``p.depth`` bits.
+
+    Bit i of the prefix is the side of the step-i median split along
+    axis i % D — identical leaf labels to the reference's balanced
+    area XML (parseSource builds the same alternating halving)."""
+    d = p.dims
+    span = p.field_max - p.field_min
+    # per-axis bit budget: axis a gets ceil((depth - a)/d) bits
+    unit = (coords - p.field_min) / span
+    unit = jnp.clip(unit, 0.0, 1.0 - 1e-7)
+    out = jnp.zeros(coords.shape[:-1], U32)
+    # walking the splits: at step i the active cell along axis (i%d)
+    # halves; the chosen side is bit (depth-1-i) — equivalent to
+    # interleaving the axes' fixed-point expansions
+    nbits = [0] * d
+    for i in range(p.depth):
+        a = i % d
+        bit = (jnp.floor(unit[..., a] * (1 << (nbits[a] + 1))).astype(U32)
+               >> U32(0)) & U32(1)
+        out = (out << U32(1)) | bit
+        nbits[a] += 1
+    return out
+
+
+def node_id(coords, rng, p: CbrParams,
+            spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+    """[N, D] coords → [N, KL] topology-aware NodeIDs.
+
+    The area prefix occupies bits start_at_digit..stop_at_digit from
+    the top of the key; everything else is random (getNodeId)."""
+    n = coords.shape[0]
+    pre = prefix_bits(coords, p)                      # [N] u32
+    nbits = min(p.depth, p.stop_at_digit - p.start_at_digit)
+    pre = pre >> U32(p.depth - nbits)
+    rand = keys_mod.random_keys(rng, (n,), spec)      # [N, KL]
+    # splice the prefix into the top lane(s) below start_at_digit bits
+    shift = spec.bits - p.start_at_digit - nbits
+    prefix_key = jax.vmap(
+        lambda b: keys_mod.shl_const(
+            jnp.zeros((spec.lanes,), U32).at[-1].set(b), shift,
+            spec))(pre)
+    # zero the bits the prefix occupies, then OR it in
+    ones = (1 << nbits) - 1
+    mask_key = keys_mod.shl_const(
+        jnp.zeros((spec.lanes,), U32).at[-1].set(U32(ones)), shift, spec)
+    keep = ~jnp.broadcast_to(mask_key, rand.shape)
+    return (rand & keep) | prefix_key
+
+
+def key_to_center(key, p: CbrParams,
+                  spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+    """[KL] key → [D] f32 center of its prefix area
+    (getEuclidianDistanceByKeyAndCoords's area lookup)."""
+    nbits = min(p.depth, p.stop_at_digit - p.start_at_digit)
+    shift = spec.bits - p.start_at_digit - nbits
+    pre = keys_mod.shr_const(key, shift, spec)[..., -1] & U32(
+        (1 << nbits) - 1)
+    d = p.dims
+    span = p.field_max - p.field_min
+    lo = [jnp.zeros(pre.shape, F32) for _ in range(d)]
+    size = [jnp.full(pre.shape, 1.0, F32) for _ in range(d)]
+    for i in range(nbits):
+        a = i % d
+        bit = (pre >> U32(nbits - 1 - i)) & U32(1)
+        size[a] = size[a] * 0.5
+        lo[a] = lo[a] + bit.astype(F32) * size[a]
+    center = jnp.stack([lo[a] + size[a] * 0.5 for a in range(d)],
+                       axis=-1)
+    return p.field_min + center * span
+
+
+def distance_key_coords(key, coords, p: CbrParams,
+                        spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC):
+    """Euclidean distance between a key's area center and coords
+    (CoordBasedRouting::getEuclidianDistanceByKeyAndCoords)."""
+    c = key_to_center(key, p, spec)
+    d = c - coords
+    return jnp.sqrt(jnp.sum(d * d, axis=-1))

@@ -3,12 +3,12 @@
 TPU-native rebuild of the reference's IterativeLookup
 (src/common/IterativeLookup.{h,cc}): per lookup, a frontier of candidate
 next-hops is maintained and FindNode RPCs are issued to the closest
-unvisited candidate until a node answers with its sibling flag set
+unvisited candidates until a node answers with its sibling flag set
 (BaseOverlay::findNodeRpc sets `siblings` when the responder
 isSiblingFor the key, BaseOverlay.cc:1866-1871; a flagged non-empty
 response finishes the path, IterativeLookup.cc:893-902).
 
-Semantics implemented (default OverSim configuration, default.ini:420-433):
+Semantics implemented (configuration flags read at BaseOverlay.cc:140-160):
   * lookupRedundantNodes=1, lookupParallelPaths=1, lookupParallelRpcs=1,
     lookupMerge=false — each FindNodeResponse *replaces* the frontier
     (IterativePathLookup::handleResponse clears nextHops when !merge,
@@ -16,6 +16,21 @@ Semantics implemented (default OverSim configuration, default.ini:420-433):
   * merge=true (Kademlia style) — response nodes are merged into the
     frontier, kept sorted by a pluggable distance metric, capacity F
     (BaseKeySortedVector semantics, NodeVector.h:40-44).
+  * parallel RPCs — up to R = parallelPaths x parallelRpcs FindNode
+    calls in flight per lookup against the shared sorted frontier.  The
+    reference tracks paths as separate IterativePathLookup objects with
+    a shared visited set (IterativeLookup.h:219, IterativeLookup.cc:529
+    addPath); in the vectorized engine the disjoint-path bookkeeping
+    collapses into in-flight width R over one frontier — same RPC
+    fan-out and the same shared-visited pruning, without per-path
+    object state.  Pair R>1 with merge=true (as Kademlia does).
+  * retries — a timed-out FindNodeCall is re-sent to the same node up
+    to `retries` times before the node is marked failed (BaseRpc
+    retry counter, RpcState.numRetries / BaseRpc.cc:435-449).
+  * exhaustive-iterative — sibling-flagged responses do not finish the
+    lookup; discovered siblings accumulate and the lookup completes
+    when the frontier is exhausted (EXHAUSTIVE_ITERATIVE_ROUTING,
+    IterativeLookup.cc:219-226 appends all, stops on empty frontier).
   * lookupVisitOnlyOnce=true — a bounded visited ring buffer skips
     re-queries (IterativePathLookup::sendRpc visited check).
   * RPC timeout (rpcUdpTimeout=1.5s, default.ini:483) marks the queried
@@ -68,7 +83,9 @@ class LookupConfig:
     frontier: int = 8       # F — candidate set width
     visited: int = 16       # V — visited ring capacity
     merge: bool = False     # lookupMerge
-    retries: int = 0        # lookupRetries... cut: fail directly
+    parallel_rpcs: int = 1  # R — lookupParallelPaths x lookupParallelRpcs
+    retries: int = 0        # per-RPC re-sends before fail (BaseRpc retries)
+    exhaustive: bool = False  # EXHAUSTIVE_ITERATIVE_ROUTING
     rpc_timeout_ns: int = RPC_TIMEOUT_NS
     deadline_ns: int = LOOKUP_TIMEOUT_NS
     # opaque per-lookup extension words threaded through every FindNode
@@ -93,8 +110,10 @@ class LookupState:
     fr_flags: jnp.ndarray     # [L, F] i32 F_* flags
     visited: jnp.ndarray      # [L, V] i32
     vis_n: jnp.ndarray        # [L] i32 visited write cursor
-    pending_dst: jnp.ndarray  # [L] i32 (NO_NODE = no RPC in flight)
-    t_to: jnp.ndarray         # [L] i64 — pending RPC timeout
+    pending_dst: jnp.ndarray  # [L, R] i32 (NO_NODE = free RPC slot)
+    t_to: jnp.ndarray         # [L, R] i64 — per-RPC timeout
+    retry: jnp.ndarray        # [L, R] i32 — re-sends used on this RPC
+    refire: jnp.ndarray       # [L, R] bool — timed out, re-send pending
     deadline: jnp.ndarray     # [L] i64 — whole-lookup timeout
     hops: jnp.ndarray         # [L] i32
     t0: jnp.ndarray           # [L] i64 — start time
@@ -104,12 +123,13 @@ class LookupState:
     results: jnp.ndarray      # [L, F] i32 — full final sibling set (the
                               # FindNodeResponse payload; DHT replica puts
                               # need numReplica siblings, DHT.cc:504)
+    res_n: jnp.ndarray        # [L] i32 — accumulated siblings (exhaustive)
     t_done: jnp.ndarray       # [L] i64 — completion time (next_event wake)
     ext: jnp.ndarray          # [L, EW] i32 — opaque per-lookup extension
 
 
 def init(cfg: LookupConfig, kl: int) -> LookupState:
-    l, f, v = cfg.slots, cfg.frontier, cfg.visited
+    l, f, v, r = cfg.slots, cfg.frontier, cfg.visited, cfg.parallel_rpcs
     return LookupState(
         active=jnp.zeros((l,), bool),
         purpose=jnp.zeros((l,), I32),
@@ -120,8 +140,10 @@ def init(cfg: LookupConfig, kl: int) -> LookupState:
         fr_flags=jnp.zeros((l, f), I32),
         visited=jnp.full((l, v), NO_NODE, I32),
         vis_n=jnp.zeros((l,), I32),
-        pending_dst=jnp.full((l,), NO_NODE, I32),
-        t_to=jnp.full((l,), T_INF, I64),
+        pending_dst=jnp.full((l, r), NO_NODE, I32),
+        t_to=jnp.full((l, r), T_INF, I64),
+        retry=jnp.zeros((l, r), I32),
+        refire=jnp.zeros((l, r), bool),
         deadline=jnp.full((l,), T_INF, I64),
         hops=jnp.zeros((l,), I32),
         t0=jnp.zeros((l,), I64),
@@ -129,6 +151,7 @@ def init(cfg: LookupConfig, kl: int) -> LookupState:
         success=jnp.zeros((l,), bool),
         result=jnp.full((l,), NO_NODE, I32),
         results=jnp.full((l, f), NO_NODE, I32),
+        res_n=jnp.zeros((l,), I32),
         t_done=jnp.full((l,), T_INF, I64),
         ext=jnp.zeros((l, cfg.ext_words), I32),
     )
@@ -155,6 +178,7 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
     path fails).
     """
     f = lk.frontier.shape[1]
+    r = lk.pending_dst.shape[1]
     slot = jnp.where(en, slot, jnp.int32(lk.active.shape[0]))  # OOB drop
     seed = seed_nodes[:f]
     return dataclasses.replace(
@@ -170,8 +194,11 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
         visited=lk.visited.at[slot].set(
             jnp.full((lk.visited.shape[1],), NO_NODE, I32), mode="drop"),
         vis_n=lk.vis_n.at[slot].set(0, mode="drop"),
-        pending_dst=lk.pending_dst.at[slot].set(NO_NODE, mode="drop"),
-        t_to=lk.t_to.at[slot].set(T_INF, mode="drop"),
+        pending_dst=lk.pending_dst.at[slot].set(
+            jnp.full((r,), NO_NODE, I32), mode="drop"),
+        t_to=lk.t_to.at[slot].set(jnp.full((r,), T_INF, I64), mode="drop"),
+        retry=lk.retry.at[slot].set(jnp.zeros((r,), I32), mode="drop"),
+        refire=lk.refire.at[slot].set(jnp.zeros((r,), bool), mode="drop"),
         deadline=lk.deadline.at[slot].set(now + cfg.deadline_ns, mode="drop"),
         hops=lk.hops.at[slot].set(0, mode="drop"),
         t0=lk.t0.at[slot].set(now, mode="drop"),
@@ -180,6 +207,7 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
         result=lk.result.at[slot].set(NO_NODE, mode="drop"),
         results=lk.results.at[slot].set(
             jnp.full((f,), NO_NODE, I32), mode="drop"),
+        res_n=lk.res_n.at[slot].set(0, mode="drop"),
         t_done=lk.t_done.at[slot].set(T_INF, mode="drop"),
         ext=lk.ext.at[slot].set(
             jnp.zeros((cfg.ext_words,), I32) if ext is None else ext,
@@ -187,10 +215,13 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
     )
 
 
-def _is_visited(lk: LookupState, l, node):
-    """node [F] i32 → [F] bool membership in slot l's visited ring."""
-    return jnp.any(lk.visited[l][None, :] == node[:, None], axis=1) & (
-        node != NO_NODE)
+def _visited_mask(visited, frontier):
+    """[L, F] bool: frontier entry already in its slot's visited ring."""
+    l_dim = frontier.shape[0]
+    return jax.vmap(
+        lambda li: jnp.any(
+            visited[li][None, :] == frontier[li][:, None], axis=1) &
+        (frontier[li] != NO_NODE))(jnp.arange(l_dim))
 
 
 def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
@@ -202,40 +233,64 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
 
     Returns lk'.  Completion (sibling-flagged response) is recorded in
     done/success/result (IterativeLookup.cc:893-902: flagged non-empty
-    response → path finished, returned nodes are the siblings).
+    response → path finished, returned nodes are the siblings); in
+    exhaustive mode the siblings accumulate in results/res_n instead.
     """
-    l = jnp.clip(msg.a, 0, lk.active.shape[0] - 1)
+    l_dim = lk.active.shape[0]
+    l = jnp.clip(msg.a, 0, l_dim - 1)
+    match = (lk.pending_dst[l] == msg.src) & (msg.src != NO_NODE)   # [R]
     ok = (msg.valid & lk.active[l] & (lk.gen[l] == msg.b) &
-          (lk.pending_dst[l] == msg.src) & ~lk.done[l])
+          jnp.any(match) & ~lk.done[l])
+    j = jnp.argmax(match).astype(I32)
 
     f = lk.frontier.shape[1]
     resp_nodes = msg.nodes[:f]
     has_nodes = jnp.any(resp_nodes != NO_NODE)
     is_sib = (msg.c != 0) & has_nodes
 
-    # clear pending state; count the hop (IterativeLookup.cc:825 hops++)
+    # clear the matched pending RPC; count the hop (IterativeLookup.cc:825)
+    row = jnp.where(ok, l, l_dim)
     lk = dataclasses.replace(
         lk,
-        pending_dst=lk.pending_dst.at[jnp.where(ok, l, lk.active.shape[0])].set(
-            NO_NODE, mode="drop"),
-        t_to=lk.t_to.at[jnp.where(ok, l, lk.active.shape[0])].set(
-            T_INF, mode="drop"),
-        hops=lk.hops.at[jnp.where(ok, l, lk.active.shape[0])].add(
-            1, mode="drop"))
+        pending_dst=lk.pending_dst.at[row, j].set(NO_NODE, mode="drop"),
+        t_to=lk.t_to.at[row, j].set(T_INF, mode="drop"),
+        retry=lk.retry.at[row, j].set(0, mode="drop"),
+        refire=lk.refire.at[row, j].set(False, mode="drop"),
+        hops=lk.hops.at[row].add(1, mode="drop"))
 
-    # finished: responder was a sibling → result = first returned node
-    fin = ok & is_sib
-    slot_fin = jnp.where(fin, l, lk.active.shape[0])
-    lk = dataclasses.replace(
-        lk,
-        done=lk.done.at[slot_fin].set(True, mode="drop"),
-        success=lk.success.at[slot_fin].set(True, mode="drop"),
-        result=lk.result.at[slot_fin].set(resp_nodes[0], mode="drop"),
-        results=lk.results.at[slot_fin].set(resp_nodes, mode="drop"),
-        t_done=lk.t_done.at[slot_fin].set(msg.t_deliver, mode="drop"))
+    if not cfg.exhaustive:
+        # finished: responder was a sibling → result = first returned node
+        fin = ok & is_sib
+        slot_fin = jnp.where(fin, l, l_dim)
+        lk = dataclasses.replace(
+            lk,
+            done=lk.done.at[slot_fin].set(True, mode="drop"),
+            success=lk.success.at[slot_fin].set(True, mode="drop"),
+            result=lk.result.at[slot_fin].set(resp_nodes[0], mode="drop"),
+            results=lk.results.at[slot_fin].set(resp_nodes, mode="drop"),
+            t_done=lk.t_done.at[slot_fin].set(msg.t_deliver, mode="drop"))
+        upd = ok & ~is_sib
+    else:
+        # exhaustive: accumulate the responder's sibling set and keep going
+        # (IterativeLookup.cc EXHAUSTIVE branch appends to the
+        # key-distance-sorted siblings NodeVector — keep the set sorted
+        # by the metric so results[0] is always the closest found)
+        acc = ok & is_sib
+        cur = jnp.concatenate([lk.results[l], resp_nodes])
+        dup = keys_mod.dup_mask(cur) | (cur == NO_NODE)
+        cur = jnp.where(dup, NO_NODE, cur)
+        sdist = metric_fn(cur, lk.target[l])
+        sdist = jnp.where(dup[:, None], jnp.uint32(0xFFFFFFFF), sdist)
+        _, (packed_full,) = keys_mod.sort_by_distance(sdist, (cur,))
+        packed = packed_full[:f]
+        slot_acc = jnp.where(acc, l, l_dim)
+        lk = dataclasses.replace(
+            lk,
+            results=lk.results.at[slot_acc].set(packed, mode="drop"),
+            res_n=lk.res_n.at[slot_acc].set(
+                jnp.sum(packed != NO_NODE, dtype=I32), mode="drop"))
+        upd = ok   # frontier always advances; exhaustion completes the lookup
 
-    # not finished: update the frontier
-    upd = ok & ~is_sib
     if cfg.merge:
         # sorted union of old frontier + response, cap F, drop visited dups
         cand = jnp.concatenate([lk.frontier[l], resp_nodes])
@@ -260,7 +315,7 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         new_frontier = jnp.where(has_nodes, new_frontier, lk.frontier[l])
         new_flags = jnp.where(has_nodes, new_flags, lk.fr_flags[l])
 
-    slot_upd = jnp.where(upd, l, lk.active.shape[0])
+    slot_upd = jnp.where(upd, l, l_dim)
     lk = dataclasses.replace(
         lk,
         frontier=lk.frontier.at[slot_upd].set(new_frontier, mode="drop"),
@@ -276,22 +331,29 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
 def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
     """Expire pending RPCs / deadlines due strictly before ``t_end``.
 
-    Returns (lk', failed_nodes [L] i32) — failed_nodes lists the timed-out
-    query targets (NO_NODE where none) so the overlay can run its
-    handleFailedNode repair (BaseOverlay.cc:1697-1729 RPC timeout →
-    handleFailedNode; IterativePathLookup::handleTimeout).
+    An expired RPC with retries left is queued for re-send (``refire``,
+    BaseRpc.cc:435-449 retry path); otherwise the queried node is
+    reported failed.  Returns (lk', failed_nodes [L*R] i32) — failed
+    nodes feed the overlay's handleFailedNode repair
+    (BaseOverlay.cc:1697-1729; IterativePathLookup::handleTimeout).
     """
-    l = lk.active.shape[0]
-    rpc_to = lk.active & (lk.pending_dst != NO_NODE) & (lk.t_to < t_end)
-    failed_nodes = jnp.where(rpc_to, lk.pending_dst, NO_NODE)
+    act = lk.active[:, None]
+    exp = act & (lk.pending_dst != NO_NODE) & (lk.t_to < t_end)
+    can_retry = exp & (lk.retry < cfg.retries)
+    final = exp & ~can_retry
+    failed_nodes = jnp.where(final, lk.pending_dst, NO_NODE).reshape(-1)
 
-    # mark the failed node in the frontier
-    fmask = rpc_to[:, None] & (lk.frontier == lk.pending_dst[:, None])
+    # mark finally-failed nodes in the frontier
+    fmask = jnp.any(final[:, None, :] &
+                    (lk.frontier[:, :, None] == lk.pending_dst[:, None, :]),
+                    axis=2)
     fr_flags = jnp.where(fmask, F_FAILED, lk.fr_flags)
-    pending_dst = jnp.where(rpc_to, NO_NODE, lk.pending_dst)
-    t_to = jnp.where(rpc_to, T_INF, lk.t_to)
-    # a timed-out round still counts as a hop attempt
-    hops = lk.hops + rpc_to.astype(I32)
+    pending_dst = jnp.where(final, NO_NODE, lk.pending_dst)
+    t_to = jnp.where(exp, T_INF, lk.t_to)
+    refire = lk.refire | can_retry
+    retry = lk.retry + can_retry.astype(I32)
+    # a finally timed-out round still counts as a hop attempt
+    hops = lk.hops + jnp.sum(final, axis=1, dtype=I32)
 
     # whole-lookup deadline (only for not-yet-done active lookups)
     dead = lk.active & ~lk.done & (lk.deadline < t_end)
@@ -300,61 +362,108 @@ def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
 
     return dataclasses.replace(
         lk, fr_flags=fr_flags, pending_dst=pending_dst, t_to=t_to,
-        hops=hops, done=done, t_done=t_done), failed_nodes
+        retry=retry, refire=refire, hops=hops, done=done,
+        t_done=t_done), failed_nodes
 
 
 def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
          cfg: LookupConfig, *, num_siblings: int = 1,
          num_redundant: int = 1):
-    """Fire the next FindNodeCall for every active slot with no RPC in
-    flight; exhausted slots complete as failed.
+    """Fire FindNodeCalls for every active slot with free RPC capacity
+    (up to R in flight); re-send timed-out RPCs with retries left;
+    exhausted slots complete (as failed, or — exhaustive mode — with
+    the accumulated sibling set).
 
     Mirrors IterativePathLookup::sendRpc: pick the first unvisited,
-    not-failed frontier entry; if none and nothing pending, the path fails.
+    not-failed frontier entries; if none and nothing pending, the path
+    finishes.
     """
     del rng
     l_dim, f = lk.frontier.shape
-    idle = lk.active & ~lk.done & (lk.pending_dst == NO_NODE)
+    r_dim = lk.pending_dst.shape[1]
+    call_size = wire.findnode_call_b() + 4 * cfg.ext_words
 
-    # candidate choice per slot: first frontier entry with flag F_NEW that
-    # is not in the visited set and not ourselves... (self entries are
-    # queried "locally" by the owner before seeding, so skip them here)
-    cand_ok = (lk.frontier != NO_NODE) & (lk.fr_flags == F_NEW)
-    vis = jax.vmap(lambda li: _is_visited(lk, li, lk.frontier[li]))(
-        jnp.arange(l_dim))
-    cand_ok = cand_ok & ~vis & (lk.frontier != node_idx)
+    # ---- re-sends (BaseRpc retry): same destination, fresh timeout ----
+    # refire is statically impossible with retries == 0 (the default):
+    # skip tracing the L×R send fan-out entirely in that case
+    if cfg.retries:
+        t_to = jnp.where(lk.refire, now + cfg.rpc_timeout_ns, lk.t_to)
+        for li in range(l_dim):
+            for rj in range(r_dim):
+                outbox.send(
+                    lk.refire[li, rj], now, lk.pending_dst[li, rj],
+                    wire.FINDNODE_CALL,
+                    key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
+                    c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
+                    nodes=lk.ext[li] if cfg.ext_words else None,
+                    size_b=call_size)
+        lk = dataclasses.replace(
+            lk, t_to=t_to, refire=jnp.zeros_like(lk.refire))
+
+    # ---- new fires: fill free RPC slots from the frontier ----
+    frontier, fr_flags = lk.frontier, lk.fr_flags
+    visited, vis_n = lk.visited, lk.vis_n
+    pending_dst, t_to = lk.pending_dst, lk.t_to
+    retry = lk.retry
+    fired_any = jnp.zeros((l_dim,), bool)
+    for _ in range(r_dim):
+        cand_ok = (frontier != NO_NODE) & (fr_flags == F_NEW)
+        cand_ok = cand_ok & ~_visited_mask(visited, frontier) & (
+            frontier != node_idx)
+        has_cand = jnp.any(cand_ok, axis=1)
+        first = jnp.argmax(cand_ok, axis=1).astype(I32)
+        cand = jnp.take_along_axis(frontier, first[:, None], axis=1)[:, 0]
+
+        free_col_ok = pending_dst == NO_NODE
+        has_free = jnp.any(free_col_ok, axis=1)
+        col = jnp.argmax(free_col_ok, axis=1).astype(I32)
+
+        idle = lk.active & ~lk.done
+        fire = idle & has_cand & has_free & (lk.hops < MAX_HOPS)
+
+        rows = jnp.where(fire, jnp.arange(l_dim, dtype=I32), l_dim)
+        vcol = vis_n % visited.shape[1]
+        visited = visited.at[rows, vcol].set(cand, mode="drop")
+        vis_n = vis_n + fire.astype(I32)
+        fr_flags = fr_flags.at[rows, first].set(F_PENDING, mode="drop")
+        pending_dst = pending_dst.at[rows, col].set(cand, mode="drop")
+        t_to = t_to.at[rows, col].set(now + cfg.rpc_timeout_ns, mode="drop")
+        retry = retry.at[rows, col].set(0, mode="drop")
+        fired_any = fired_any | fire
+
+        for li in range(l_dim):
+            outbox.send(
+                fire[li], now, cand[li], wire.FINDNODE_CALL,
+                key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
+                c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
+                nodes=lk.ext[li] if cfg.ext_words else None,
+                size_b=call_size)
+
+    # ---- exhaustion: nothing in flight and nothing left to query ----
+    cand_ok = (frontier != NO_NODE) & (fr_flags == F_NEW)
+    cand_ok = cand_ok & ~_visited_mask(visited, frontier) & (
+        frontier != node_idx)
     has_cand = jnp.any(cand_ok, axis=1)
-    first = jnp.argmax(cand_ok, axis=1).astype(I32)
-    cand = jnp.take_along_axis(lk.frontier, first[:, None], axis=1)[:, 0]
+    inflight = jnp.any(pending_dst != NO_NODE, axis=1)
+    fail = (lk.active & ~lk.done & ~inflight &
+            (~has_cand | (lk.hops >= MAX_HOPS)))
 
-    fire = idle & has_cand & (lk.hops < MAX_HOPS)
-    fail = idle & (~has_cand | (lk.hops >= MAX_HOPS))
-
-    # visited ring append + flag update + pending bookkeeping
-    rows = jnp.where(fire, jnp.arange(l_dim, dtype=I32), l_dim)
-    vcol = lk.vis_n % lk.visited.shape[1]
-    visited = lk.visited.at[rows, vcol].set(cand, mode="drop")
-    vis_n = lk.vis_n + fire.astype(I32)
-    fr_flags = lk.fr_flags.at[rows, first].set(F_PENDING, mode="drop")
-    pending_dst = jnp.where(fire, cand, lk.pending_dst)
-    t_to = jnp.where(fire, now + cfg.rpc_timeout_ns, lk.t_to)
+    if cfg.exhaustive:
+        # exhaustion IS the completion; success = found any sibling
+        success = jnp.where(fail, lk.res_n > 0, lk.success)
+        result = jnp.where(fail & (lk.res_n > 0), lk.results[:, 0],
+                           lk.result)
+    else:
+        success, result = lk.success, lk.result
 
     done = lk.done | fail
     t_done = jnp.where(fail, now, lk.t_done)
 
     lk = dataclasses.replace(
-        lk, visited=visited, vis_n=vis_n, fr_flags=fr_flags,
-        pending_dst=pending_dst, t_to=t_to, done=done, t_done=t_done)
-
-    # emit the FindNodeCalls (static loop over L slots)
-    for li in range(l_dim):
-        outbox.send(
-            fire[li], now, cand[li], wire.FINDNODE_CALL,
-            key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
-            c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
-            nodes=lk.ext[li] if cfg.ext_words else None,
-            size_b=wire.findnode_call_b() + 4 * cfg.ext_words)
-    return lk, fire
+        lk, frontier=frontier, fr_flags=fr_flags, visited=visited,
+        vis_n=vis_n, pending_dst=pending_dst, t_to=t_to, retry=retry,
+        success=success, result=result, done=done, t_done=t_done)
+    return lk, fired_any
 
 
 def take_completions(lk: LookupState, t_end):
@@ -367,12 +476,15 @@ def take_completions(lk: LookupState, t_end):
     comp = dict(taken=taken, success=lk.success & taken, result=lk.result,
                 results=lk.results, purpose=lk.purpose, aux=lk.aux,
                 hops=lk.hops, t0=lk.t0, target=lk.target)
+    t2 = taken[:, None]
     lk = dataclasses.replace(
         lk,
         active=lk.active & ~taken,
         done=lk.done & ~taken,
-        pending_dst=jnp.where(taken, NO_NODE, lk.pending_dst),
-        t_to=jnp.where(taken, T_INF, lk.t_to),
+        pending_dst=jnp.where(t2, NO_NODE, lk.pending_dst),
+        t_to=jnp.where(t2, T_INF, lk.t_to),
+        retry=jnp.where(t2, 0, lk.retry),
+        refire=jnp.where(t2, False, lk.refire),
         deadline=jnp.where(taken, T_INF, lk.deadline),
         t_done=jnp.where(taken, T_INF, lk.t_done))
     return lk, comp
@@ -380,7 +492,12 @@ def take_completions(lk: LookupState, t_end):
 
 def next_event(lk: LookupState):
     """Earliest timeout/completion wake-up for this node's lookups ([L]→scalar)."""
-    t = jnp.minimum(jnp.where(lk.active, lk.t_to, T_INF),
-                    jnp.where(lk.active & ~lk.done, lk.deadline, T_INF))
+    act = lk.active[:, None]
+    t = jnp.min(jnp.where(act, lk.t_to, T_INF), axis=1)
+    t = jnp.minimum(t, jnp.where(lk.active & ~lk.done, lk.deadline, T_INF))
     t = jnp.minimum(t, jnp.where(lk.done, lk.t_done, T_INF))
+    # a queued re-send must wake the node immediately (refire can only
+    # be set when retries are in play; the engine passes no cfg here so
+    # the cheap mask-any stays — it folds to False when never set)
+    t = jnp.where(jnp.any(lk.refire & act, axis=1), jnp.int64(0), t)
     return jnp.min(t)

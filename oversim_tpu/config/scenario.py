@@ -65,8 +65,22 @@ def build_churn(ini: IniFile, config: str) -> churn_mod.ChurnParams:
         model=model, target_num=target, init_interval=init_interval, **kw)
 
 
-def build_underlay(ini: IniFile, config: str) -> underlay_mod.UnderlayParams:
-    return underlay_mod.UnderlayParams(
+def build_underlay(ini: IniFile, config: str):
+    """(params, module) — the ``network`` line picks the underlay family
+    (reference default.ini:16 SimpleUnderlayNetwork vs omnetpp.ini
+    InetUnderlayNetwork/ReaSEUnderlayNetwork configs)."""
+    net = str(_value(ini.get("network", config), "")).lower()
+    if "inet" in net or "rease" in net:
+        from oversim_tpu.underlay import inet as inet_mod
+        params = inet_mod.InetUnderlayParams(
+            topology="rease" if "rease" in net else "inet",
+            routers=int(_value(
+                ini.get("**.accessRouterNum", config), 16)),
+            send_queue_bytes=int(_value(
+                ini.get("**.sendQueueLength", config), 1_000_000)),
+        )
+        return params, inet_mod
+    params = underlay_mod.UnderlayParams(
         field_size=float(_value(ini.get("**.fieldSize", config), 150.0)),
         send_queue_bytes=int(_value(
             ini.get("**.sendQueueLength", config), 1_000_000)),
@@ -75,6 +89,7 @@ def build_underlay(ini: IniFile, config: str) -> underlay_mod.UnderlayParams:
         use_coordinate_based_delay=bool(_value(
             ini.get("**.useCoordinateBasedDelay", config), True)),
     )
+    return params, underlay_mod
 
 
 def build_app(ini: IniFile, config: str, spec: K.KeySpec, trace=None):
@@ -130,8 +145,22 @@ def build_malicious(ini: IniFile, config: str):
 def build_lookup_config(ini: IniFile, config: str, proto: str,
                         merge_default: bool) -> lk_mod.LookupConfig:
     ns = f"overlay.{proto}"
+    paths = int(_get(ini, config, f"{ns}.lookupParallelPaths", 1))
+    rpcs = int(_get(ini, config, f"{ns}.lookupParallelRpcs", 1))
     return lk_mod.LookupConfig(
         merge=bool(_get(ini, config, f"{ns}.lookupMerge", merge_default)),
+        # reference tracks paths as separate objects sharing one visited
+        # set (IterativeLookup.cc:529); the vectorized engine expresses
+        # paths x rpcs as total in-flight width R (lookup.py docstring)
+        parallel_rpcs=max(1, paths * rpcs),
+        # per-RPC re-send count.  The reference passes retries as a
+        # lookup() call argument (AbstractLookup.h), not an ini param
+        # (lookupFailedNodeRpcs is the unrelated failed-node-notice
+        # bool) — `lookupRetries` is this framework's ini extension
+        retries=int(_get(ini, config, f"{ns}.lookupRetries", 0)),
+        exhaustive=str(_value(
+            ini.get(f"**.routingType", config), "iterative")
+            ).strip('"') == "exhaustive-iterative",
         rpc_timeout_ns=int(float(_value(
             ini.get("**.rpcUdpTimeout", config), 1.5)) * 1e9),
     )
@@ -148,7 +177,7 @@ def build_simulation(ini: IniFile, config: str = "General",
     (reference GlobalTraceManager)."""
     overlay_type = str(_value(ini.get("**.overlayType", config), ""))
     spec = K.KeySpec(int(_value(ini.get("**.keyLength", config), 160)))
-    up = build_underlay(ini, config)
+    up, ul_mod = build_underlay(ini, config)
     workload = None
     if trace_events is not None:
         from oversim_tpu import trace as trace_mod
@@ -303,7 +332,41 @@ def build_simulation(ini: IniFile, config: str = "General",
                 ini, config, "overlay.gia.tokenWaitTime", 1.0)),
         )
         logic = GiaLogic(spec, params)
+    elif "nice" in overlay_type.lower():
+        from oversim_tpu.overlay.nice import NiceLogic, NiceParams
+        params = NiceParams(
+            k=int(_get(ini, config, "overlay.nice.k", 3)),
+            hb_interval=float(_get(
+                ini, config, "overlay.nice.heartbeatInterval", 5.0)),
+            maint_interval=float(_get(
+                ini, config, "overlay.nice.maintenanceInterval", 3.3)),
+            query_interval=float(_get(
+                ini, config, "overlay.nice.queryInterval", 2.0)),
+            peer_timeout_hbs=float(_get(
+                ini, config, "overlay.nice.peerTimeoutHeartbeats", 3.0)),
+        )
+        logic = NiceLogic(spec, params)
+    elif "pubsub" in overlay_type.lower():
+        from oversim_tpu.overlay.pubsubmmog import (PubSubMMOGLogic,
+                                                    PubSubParams)
+        params = PubSubParams(
+            field=float(_get(
+                ini, config, "overlay.pubsubmmog.areaDimension", 1000.0)),
+            grid=int(_get(
+                ini, config, "overlay.pubsubmmog.numSubspaces", 4)),
+            aoi=float(_get(
+                ini, config, "overlay.pubsubmmog.AOIWidth", 100.0)),
+            move_rate=float(_get(
+                ini, config, "overlay.pubsubmmog.movementRate", 2.0)),
+            parent_timeout=float(_get(
+                ini, config, "overlay.pubsubmmog.parentTimeout", 2.0)),
+            max_move_delay=float(_get(
+                ini, config, "overlay.pubsubmmog.maxMoveDelay", 1.0)),
+            max_children=int(_get(
+                ini, config, "overlay.pubsubmmog.maxChildren", 12)),
+        )
+        logic = PubSubMMOGLogic(spec, params)
     else:
         raise ScenarioError(f"unsupported overlayType: {overlay_type!r}")
 
-    return sim_mod.Simulation(logic, cp, up, ep)
+    return sim_mod.Simulation(logic, cp, up, ep, underlay_module=ul_mod)

@@ -91,11 +91,17 @@ class Simulation:
     """Host-side driver binding logic + underlay + churn params."""
 
     def __init__(self, logic, churn_params: churn_mod.ChurnParams,
-                 underlay_params: underlay_mod.UnderlayParams | None = None,
-                 engine_params: EngineParams | None = None):
+                 underlay_params=None,
+                 engine_params: EngineParams | None = None,
+                 underlay_module=None):
+        # the underlay is a strategy module (init/migrate/send_batch/
+        # connection_matrix): underlay.simple (SimpleUnderlay, default)
+        # or underlay.inet (InetUnderlay/ReaSEUnderlay router topology)
+        self.ul = underlay_module or underlay_mod
         self.logic = logic
         self.cp = churn_params
-        self.up = underlay_params or underlay_mod.UnderlayParams()
+        self.up = (self.ul.UnderlayParams()
+                   if underlay_params is None else underlay_params)
         self.ep = engine_params or EngineParams()
         self.n = churn_params.num_slots
         self.spec = logic.key_spec
@@ -114,7 +120,7 @@ class Simulation:
             rng=r_run,
             alive=jnp.zeros((n,), bool),
             node_keys=node_keys,
-            underlay=underlay_mod.init(r_ul, n, self.up),
+            underlay=self.ul.init(r_ul, n, self.up),
             pool=pool_mod.empty(self.ep.pool_factor * n, self.spec.lanes,
                                 self.ep.rmax),
             churn=churn_mod.init(r_churn, self.cp),
@@ -160,7 +166,7 @@ class Simulation:
         node_keys = jnp.where(
             created[:, None], keys_mod.random_keys(r_keys, (n,), self.spec),
             s.node_keys)
-        ul_state = underlay_mod.migrate(s.underlay, created, r_mig, up)
+        ul_state = self.ul.migrate(s.underlay, created, r_mig, up)
         # clear both created and killed slots; created ones schedule a join
         logic_state = logic.reset(s.logic, created | killed, created, t_next,
                                   r_reset)
@@ -196,7 +202,7 @@ class Simulation:
         # partition support: per-type ready cumsums + live conn matrix
         # (GlobalNodeList per-type bootstrap vectors + connectionMatrix)
         if up.num_node_types > 1:
-            conn = underlay_mod.connection_matrix(up, t_next)
+            conn = self.ul.connection_matrix(up, t_next)
             tmask = (ul_state.node_type[None, :]
                      == jnp.arange(up.num_node_types)[:, None])
             ready_cum_t = jnp.cumsum(
@@ -226,7 +232,7 @@ class Simulation:
 
         # 5. free delivered, send outbox through the underlay
         new_pool = pool_mod.free(s.pool, delivered | to_dead)
-        t_del, ok, ul_state, drops = underlay_mod.send_batch(
+        t_del, ok, ul_state, drops = self.ul.send_batch(
             ul_state, up, r_send, jnp.broadcast_to(node_idx[:, None],
                                                  out_fields["dst"].shape),
             out_fields["dst"], out_fields["size_b"], out_fields["t_send"],

@@ -1,0 +1,242 @@
+"""Real-network gateway: the SingleHostUnderlay equivalent.
+
+The reference's singlehostunderlay (src/underlay/singlehostunderlay/:
+SingleHostUnderlayConfigurator + realtimescheduler.h:38-163) runs ONE
+overlay node whose UDP/TUN gates are wired to the real network, paced
+by a realtime scheduler so simulated time tracks wall-clock time.
+
+The TPU rebuild keeps the whole simulated overlay and bridges a chosen
+*gateway node slot* to real sockets instead:
+
+  * inbound datagrams are injected into the message pool as ``EXT_IN``
+    messages addressed to the gateway slot (pool.alloc, the same path
+    the underlay writes its outbox with — the reference's message
+    parsers live in singlehostunderlay/*messageparser*);
+  * any ``EXT_OUT`` message a node sends to the gateway slot is
+    intercepted after the tick, serialized and transmitted to the real
+    peer it answers (matched by the ext-session nonce);
+  * ``run_realtime`` steps the simulation so that simulated time never
+    runs ahead of wall-clock time (realtimescheduler.cc: the scheduler
+    blocks on the socket until the next event is due, here a
+    poll+sleep loop with the same bound).
+
+UDP datagrams map 1:1 onto messages.  TCP connections (the reference's
+SimpleTCP / TCPExampleApp path) are framed by a 4-byte big-endian
+length prefix; each frame becomes one ``EXT_IN`` message and each
+``EXT_OUT`` reply one frame, so a sim app serves real TCP clients.
+
+Wire format of an external frame (network byte order):
+    u32 kind_tag | u32 a | u32 b | u32 c | payload bytes (≤ key width)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu.engine import pool as pool_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+NO_NODE = jnp.int32(-1)
+
+EXT_IN = 150    # real network → gateway node (a=session, b=tag, c=word)
+EXT_OUT = 151   # gateway node → real network (same fields echoed)
+
+_HDR = struct.Struct("!IIII")
+
+
+class RealtimeGateway:
+    """Bridges one simulation node slot to real UDP/TCP sockets."""
+
+    def __init__(self, sim, state, gw_slot: int = 0,
+                 udp_port: int = 0, tcp_port: int | None = None,
+                 host: str = "127.0.0.1"):
+        self.sim = sim
+        self.state = state
+        self.gw = gw_slot
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind((host, udp_port))
+        self.udp.setblocking(False)
+        self.udp_port = self.udp.getsockname()[1]
+        self.tcp = None
+        self.tcp_port = None
+        self._tcp_conns: dict = {}      # session id -> (sock, rx buffer)
+        if tcp_port is not None:
+            self.tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self.tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self.tcp.bind((host, tcp_port))
+            self.tcp.listen(8)
+            self.tcp.setblocking(False)
+            self.tcp_port = self.tcp.getsockname()[1]
+        self._sessions: dict = {}       # session id -> (addr | conn key)
+        self._next_session = 1
+        self._seen_pool = None          # pool validity snapshot
+
+    # ------------------------------------------------ injection --------
+    def inject(self, kind: int, a: int = 0, b: int = 0, c: int = 0,
+               key=None, dst: int | None = None, src: int | None = None):
+        """Write one message into the pool, delivered immediately."""
+        s = self.state
+        rmax = s.pool.nodes.shape[1]
+        lanes = s.pool.key.shape[1]
+        out = dict(
+            t_deliver=jnp.asarray([s.t_now + 1], I64),
+            src=jnp.asarray([self.gw if src is None else src], I32),
+            dst=jnp.asarray([self.gw if dst is None else dst], I32),
+            kind=jnp.asarray([kind], I32),
+            key=(jnp.zeros((1, lanes), jnp.uint32) if key is None
+                 else jnp.asarray(key, jnp.uint32)[None, :]),
+            nonce=jnp.zeros((1,), I32),
+            hops=jnp.zeros((1,), I32),
+            a=jnp.asarray([a], I32), b=jnp.asarray([b], I32),
+            c=jnp.asarray([c], I32), d=jnp.zeros((1,), I32),
+            nodes=jnp.full((1, rmax), NO_NODE, I32),
+            size_b=jnp.asarray([_HDR.size], I32),
+            stamp=jnp.asarray([s.t_now], I64),
+        )
+        new_pool, _ = pool_mod.alloc(s.pool, out, jnp.asarray([True]))
+        self.state = dataclasses.replace(s, pool=new_pool)
+
+    # ------------------------------------------------ socket pumps -----
+    def _poll_udp(self):
+        while True:
+            try:
+                data, addr = self.udp.recvfrom(65536)
+            except BlockingIOError:
+                return
+            except OSError:
+                return
+            if len(data) < _HDR.size:
+                continue
+            kind_tag, a, b, c = _HDR.unpack_from(data)
+            sid = self._next_session
+            self._next_session += 1
+            self._sessions[sid] = ("udp", addr)
+            self.inject(EXT_IN, a=sid, b=b, c=c)
+
+    def _poll_tcp(self):
+        if self.tcp is None:
+            return
+        while True:
+            try:
+                conn, addr = self.tcp.accept()
+            except (BlockingIOError, OSError):
+                break
+            conn.setblocking(False)
+            sid = self._next_session
+            self._next_session += 1
+            self._tcp_conns[sid] = (conn, bytearray())
+            self._sessions[sid] = ("tcp", sid)
+        dead = []
+        for sid, (conn, buf) in self._tcp_conns.items():
+            try:
+                chunk = conn.recv(65536)
+                if chunk == b"":
+                    dead.append(sid)
+                    continue
+                buf.extend(chunk)
+            except BlockingIOError:
+                pass
+            except OSError:
+                dead.append(sid)
+                continue
+            # length-prefixed frames (SimpleTCP stream framing)
+            while len(buf) >= 4:
+                ln = int.from_bytes(buf[:4], "big")
+                if len(buf) < 4 + ln or ln < _HDR.size:
+                    break
+                frame = bytes(buf[4:4 + ln])
+                del buf[:4 + ln]
+                kind_tag, a, b, c = _HDR.unpack_from(frame)
+                self.inject(EXT_IN, a=sid, b=b, c=c)
+        for sid in dead:
+            self._tcp_conns.pop(sid, None)
+            self._sessions.pop(sid, None)
+
+    def _drain_ext_out(self):
+        """Transmit EXT_OUT messages addressed to the gateway slot."""
+        pool = self.state.pool
+        valid = np.asarray(pool.valid)
+        kind = np.asarray(pool.kind)
+        dst = np.asarray(pool.dst)
+        hits = np.nonzero(valid & (kind == EXT_OUT) & (dst == self.gw))[0]
+        if len(hits) == 0:
+            return
+        a = np.asarray(pool.a)
+        b = np.asarray(pool.b)
+        c = np.asarray(pool.c)
+        for i in hits:
+            sid = int(a[i])
+            payload = _HDR.pack(EXT_OUT, sid, int(b[i]), int(c[i]))
+            sess = self._sessions.get(sid)
+            if sess is None:
+                continue
+            if sess[0] == "udp":
+                try:
+                    self.udp.sendto(payload, sess[1])
+                except OSError:
+                    pass
+            else:
+                entry = self._tcp_conns.get(sid)
+                if entry is not None:
+                    try:
+                        entry[0].sendall(
+                            len(payload).to_bytes(4, "big") + payload)
+                    except OSError:
+                        pass
+        # free the transmitted slots
+        mask = jnp.zeros(pool.valid.shape, bool).at[
+            jnp.asarray(hits, I32)].set(True)
+        self.state = dataclasses.replace(
+            self.state, pool=pool_mod.free(pool, mask))
+
+    # ------------------------------------------------ the loop ---------
+    def pump(self, sim_seconds: float = 0.1):
+        """Poll sockets, inject, advance the simulation, transmit.
+
+        Steps tick by tick and drains EXT_OUT *between* ticks — an
+        EXT_OUT self-send would otherwise be delivered back into the
+        gateway node's inbox (and consumed) on the very next tick."""
+        self._poll_udp()
+        self._poll_tcp()
+        target = int(self.state.t_now) + int(sim_seconds * NS)
+        while int(self.state.t_now) < target:
+            prev = int(self.state.t_now)
+            self.state = self.sim.step(self.state)
+            self._drain_ext_out()
+            if int(self.state.t_now) == prev and not bool(
+                    np.asarray(self.state.pool.valid).any()):
+                break   # nothing scheduled anywhere: idle sim
+
+    def run_realtime(self, duration_s: float, slice_s: float = 0.05):
+        """Realtime pacing: simulated time tracks wall-clock time
+        (realtimescheduler.cc waits on the socket until the next event)."""
+        t0_wall = time.monotonic()
+        t0_sim = int(self.state.t_now) / NS
+        while True:
+            elapsed = time.monotonic() - t0_wall
+            if elapsed >= duration_s:
+                return
+            ahead = (int(self.state.t_now) / NS - t0_sim) - elapsed
+            if ahead > slice_s:
+                time.sleep(min(ahead, slice_s))
+                continue
+            self.pump(slice_s)
+
+    def close(self):
+        self.udp.close()
+        if self.tcp is not None:
+            self.tcp.close()
+        for conn, _ in self._tcp_conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass

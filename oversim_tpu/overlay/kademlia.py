@@ -334,19 +334,29 @@ class KademliaLogic:
         return out, is_sib
 
     def _handle_failed(self, ctx, st, me_key, node_idx, failed):
-        """handleFailedNode (Kademlia.cc:979): drop sibling / stale+evict."""
-        en = failed != NO_NODE
+        """handleFailedNode (Kademlia.cc:979): drop sibling / stale+evict.
+
+        ``failed`` may be a scalar or a [K] batch — the whole tick's
+        failure list is folded in one sort + one bucket sweep (each
+        occurrence of a node in the batch counts one stale strike, like
+        the reference's one call per RPC timeout)."""
+        failed = jnp.atleast_1d(jnp.asarray(failed, I32))
+        en = jnp.any(failed != NO_NODE)
         # sibling drop + re-sort
-        hit = st.sib == failed
+        hit = jnp.any(st.sib[:, None] == failed[None, :], axis=-1) & (
+            st.sib != NO_NODE)
         sib_masked = jnp.where(hit, NO_NODE, st.sib)
         d = self._xor_to(ctx, sib_masked, me_key)
         (sib_s,) = K.sort_by_distance(d, (sib_masked,))[1]
         st = dataclasses.replace(
             st, sib=jnp.where(en, sib_s, st.sib))
-        # bucket stale increment + eviction
-        bhit = en & (st.buckets == failed)
-        stale = st.b_stale + bhit.astype(I32)
-        evict = bhit & (stale > self.p.max_stale)
+        # bucket stale increment (one strike per batch occurrence)
+        strikes = jnp.sum(
+            st.buckets[..., None] == failed[None, None, :], axis=-1,
+            dtype=I32)
+        strikes = jnp.where(st.buckets != NO_NODE, strikes, 0)
+        stale = st.b_stale + strikes
+        evict = (strikes > 0) & (stale > self.p.max_stale)
         return dataclasses.replace(
             st,
             buckets=jnp.where(evict, NO_NODE, st.buckets),
@@ -517,9 +527,8 @@ class KademliaLogic:
         # ------------------------------------------------ lookup timeouts --
         new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
-        for li in range(lcfg.slots):
-            st = self._handle_failed(ctx, st, me_key, node_idx,
-                                     failed_nodes[li])
+        # one batched repair for the tick's [L * parallel_rpcs] failures
+        st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes)
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)

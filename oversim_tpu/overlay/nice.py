@@ -1,0 +1,687 @@
+"""NICE — hierarchical cluster-based application-layer multicast.
+
+TPU-native rebuild of src/overlay/nice/ (Nice.{h,cc} 3.8k LoC; the
+SIGCOMM'02 "Scalable Application Layer Multicast" protocol): nodes form
+layered clusters of size k..3k-1 (Nice.h:157 `k`, default.ini:363 k=3);
+every cluster elects a leader which is also a member of the next layer
+up, so layer membership is a prefix 0..h and leaders form the multicast
+backbone.  Data sent into any cluster is re-forwarded by each receiver
+into every OTHER cluster it belongs to (Nice.cc:1385
+handleNiceMulticast), flooding the whole hierarchy in O(log N) cluster
+hops.
+
+Redesigned for the vectorized engine as structure-of-arrays state:
+
+  * cluster membership is a dense [N, LMAX, CMAX] member table plus a
+    [N, LMAX] in-layer prefix mask — no per-cluster heap objects
+    (NiceCluster.h std::set) and no gate messages;
+  * the rendezvous point (Nice.h:105 RendevouzPoint) is an elected
+    global scalar maintained by the un-vmapped post_step (LogicBase
+    discipline) instead of a configured static address: the
+    lowest-slot READY node is RP, and nodes that lose their cluster
+    re-join through it (the reference's rpPollTimer partition healing,
+    Nice.cc:1478 handleNicePollRp);
+  * the join descent (BasicJoinLayer/Query/QueryResponse,
+    Nice.cc:555-622,1506) keeps the reference's RTT-probe shape:
+    QUERY(layer) returns the responder's cluster members, the joiner
+    probes them (handleNiceJoineval echo, Nice.cc:1348-1383), picks the
+    nearest and descends until the target layer's leader admits it
+    (JoinCluster, Nice.cc:1670);
+  * heartbeats (sendHeartbeats, Nice.cc:1757) are member HBs for
+    liveness plus authoritative LEADER_HB member lists (the reference's
+    NiceLeaderHeartbeat with membership piggyback); eviction after
+    peerTimeoutHeartbeats missed intervals (cleanPeers, Nice.cc:2150);
+  * maintenance (Nice.cc:2352): leaders split clusters larger than
+    3k-1 (ClusterSplit :2621 — the reference minimizes cluster radii
+    over all member bipartitions via combination.h, which needs the
+    full pairwise-RTT matrix; here the split is a deterministic
+    balanced bipartition in slot order — same size invariants, no
+    pairwise-RTT state) and merge clusters smaller than k into a
+    sibling leader's cluster (ClusterMerge :2866);
+  * the ALMTest-style workload (publish into all own clusters, count
+    deliveries/dups — src/applications/almtest/ALMTest.cc) is folded
+    into the logic like GIA's search app, since multicast group = the
+    whole overlay in NICE.
+
+Omitted vs the reference (which itself ships !WORK_IN_PROGRESS!): the
+graph-center leader-refinement heuristic (CLUSTERLEADERBOUND transfer,
+Nice.cc:2456-2618) — it needs the continuous pairwise-RTT estimates the
+scalar build piggybacks on every heartbeat; structural invariants and
+dissemination do not depend on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+BIG = jnp.int32(2**30)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+# join-descent stages
+J_IDLE, J_QUERY, J_PROBE, J_JOIN = 0, 1, 2, 3
+
+NICE_QUERY = 110       # a=layer (-1 = your top layer)
+NICE_QUERY_RES = 111   # a=layer, b=cluster leader, nodes=members
+NICE_PROBE = 112       # RTT probe (stamp echoed back)
+NICE_PROBE_RES = 113
+NICE_JOIN = 114        # a=layer — admit me to your layer-a cluster
+NICE_JOIN_ACK = 115    # a=layer, nodes=members
+NICE_HB = 116          # a=layer — member liveness heartbeat
+NICE_LEADER_HB = 117   # a=layer, nodes=authoritative member list
+NICE_SPLIT = 118       # a=layer, b=new leader, c=upper anchor, nodes=half
+NICE_MERGE = 119       # a=layer, nodes=members to absorb
+NICE_MCAST = 120       # a=cluster layer, b=seq, c=origin
+
+
+@dataclasses.dataclass(frozen=True)
+class NiceParams:
+    """Reference defaults: default.ini:357-366."""
+
+    k: int = 3                      # cluster parameter
+    layers: int = 4                 # maxLayers (Nice.h:62 uses 10; 4 covers
+                                    # (3k)^4 ≈ 6.5k nodes at k=3)
+    hb_interval: float = 5.0        # heartbeatInterval
+    maint_interval: float = 3.3     # maintenanceInterval
+    query_interval: float = 2.0     # queryInterval (join retry)
+    probe_wait: float = 1.0         # RTT-eval window (query_compare gate)
+    peer_timeout_hbs: float = 3.0   # peerTimeoutHeartbeats
+    join_delay: float = 1.0
+    pub_interval: float = 20.0      # ALMTest sender period
+    seen: int = 16                  # duplicate-suppression ring size
+
+    @property
+    def cmax(self) -> int:
+        return 3 * self.k + 2       # split fires at >3k-1; +2 admit slack
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NiceState:
+    """[N, ...] at rest; step() sees one node's slice (no leading N)."""
+
+    state: jnp.ndarray       # [N] DEAD/JOINING/READY
+    in_layer: jnp.ndarray    # [N, LMAX] bool (prefix mask)
+    leader: jnp.ndarray      # [N, LMAX] i32 — my cluster's leader
+    member: jnp.ndarray      # [N, LMAX, CMAX] i32 — my cluster view (incl self)
+    hb_seen: jnp.ndarray     # [N, LMAX, CMAX] i64 — last HB per member
+    t_hb: jnp.ndarray        # [N] i64
+    t_maint: jnp.ndarray     # [N] i64
+    t_pub: jnp.ndarray       # [N] i64 — ALM workload sender
+    # join/rejoin descent
+    jn_stage: jnp.ndarray    # [N] i32 J_*
+    jn_layer: jnp.ndarray    # [N] i32 — layer of the cluster being probed
+    jn_target: jnp.ndarray   # [N] i32 — layer we want to join
+    jn_cands: jnp.ndarray    # [N, CMAX] i32
+    jn_rtt: jnp.ndarray      # [N, CMAX] i64
+    jn_sent: jnp.ndarray     # [N] bool — probes fired for this round
+    jn_deadline: jnp.ndarray  # [N] i64
+    # ALM workload
+    seq: jnp.ndarray         # [N] i32 publish counter
+    seen: jnp.ndarray        # [N, S] i64 (origin<<32 | seq) dup ring
+    seen_n: jnp.ndarray      # [N] i32
+    fw_h: jnp.ndarray        # [N] i64 — pending forward (hash; 0 = none)
+    fw_src: jnp.ndarray      # [N] i32
+    fw_origin: jnp.ndarray   # [N] i32
+    fw_seq: jnp.ndarray      # [N] i32
+    fw_layer: jnp.ndarray    # [N] i32 — arrival layer (-1 = own publish)
+    fw_hops: jnp.ndarray     # [N] i32
+    rp: object               # glob: i32 scalar — elected rendezvous point
+
+
+class NiceLogic:
+    """Engine logic (interface: engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: NiceParams = NiceParams()):
+        self.key_spec = spec
+        self.p = params
+
+    def stat_spec(self):
+        return stats_mod.StatSpec(
+            scalars=("nice_hops", "nice_layers"),
+            hists=(),
+            counters=("nice_joins", "nice_pub", "nice_recv", "nice_dup",
+                      "nice_splits", "nice_merges", "nice_evicts",
+                      "nice_fwd_drop"))
+
+    # ------------------------------------------------ LogicBase glue ---
+    def split(self, st):
+        return dataclasses.replace(st, rp=None), st.rp
+
+    def merge(self, node_part, glob):
+        return dataclasses.replace(node_part, rp=glob)
+
+    def post_step(self, ctx, st, events):
+        del events
+        ready = (st.state == READY) & ctx.alive
+        rp = st.rp
+        ok = (rp != NO_NODE) & ready[jnp.maximum(rp, 0)]
+        fallback = jnp.where(jnp.any(ready),
+                             jnp.argmax(ready).astype(I32), NO_NODE)
+        return dataclasses.replace(st, rp=jnp.where(ok, rp, fallback))
+
+    # ------------------------------------------------ engine hooks -----
+    def init(self, rng, n: int) -> NiceState:
+        p = self.p
+        l, c = p.layers, p.cmax
+        return NiceState(
+            state=jnp.zeros((n,), I32),
+            in_layer=jnp.zeros((n, l), bool),
+            leader=jnp.full((n, l), NO_NODE, I32),
+            member=jnp.full((n, l, c), NO_NODE, I32),
+            hb_seen=jnp.zeros((n, l, c), I64),
+            t_hb=jnp.full((n,), T_INF, I64),
+            t_maint=jnp.full((n,), T_INF, I64),
+            t_pub=jnp.full((n,), T_INF, I64),
+            jn_stage=jnp.zeros((n,), I32),
+            jn_layer=jnp.zeros((n,), I32),
+            jn_target=jnp.zeros((n,), I32),
+            jn_cands=jnp.full((n, c), NO_NODE, I32),
+            jn_rtt=jnp.full((n, c), T_INF, I64),
+            jn_sent=jnp.zeros((n,), bool),
+            jn_deadline=jnp.full((n,), T_INF, I64),
+            seq=jnp.zeros((n,), I32),
+            seen=jnp.zeros((n, p.seen), I64),
+            seen_n=jnp.zeros((n,), I32),
+            fw_h=jnp.zeros((n,), I64),
+            fw_src=jnp.full((n,), NO_NODE, I32),
+            fw_origin=jnp.full((n,), NO_NODE, I32),
+            fw_seq=jnp.zeros((n,), I32),
+            fw_layer=jnp.zeros((n,), I32),
+            fw_hops=jnp.zeros((n,), I32),
+            rp=NO_NODE)
+
+    def reset(self, st, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        glob = st.rp
+        st = dataclasses.replace(st, rp=None)
+        fresh = dataclasses.replace(self.init(rng, n), rp=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, rp=glob)
+        jitter = (jax.random.uniform(rng, (n,)) *
+                  self.p.join_delay * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            jn_stage=jnp.where(join, J_IDLE, st.jn_stage),
+            jn_target=jnp.where(join, 0, st.jn_target),
+            jn_deadline=jnp.where(join, t_now + jitter, st.jn_deadline))
+
+    def ready_mask(self, st):
+        return st.state == READY
+
+    def next_event(self, st):
+        ready = st.state == READY
+        t = jnp.where(st.state == JOINING, st.jn_deadline, T_INF)
+        t = jnp.minimum(t, jnp.where(ready, st.jn_deadline, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, st.t_hb, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, st.t_maint, T_INF))
+        t = jnp.minimum(t, jnp.where(ready, st.t_pub, T_INF))
+        # a pending forward / unsent probe round must run this tick
+        t = jnp.where((st.fw_h != 0) |
+                      ((st.jn_stage == J_PROBE) & ~st.jn_sent),
+                      jnp.int64(0), t)
+        return t
+
+    # ------------------------------------------------ helpers ----------
+    def _become_root(self, st, en, now, node_idx):
+        """First node (or healed partition head): single-member layer 0."""
+        p = self.p
+        mem0 = jnp.full((p.cmax,), NO_NODE, I32).at[0].set(node_idx)
+        row = jnp.where(en, 0, p.layers)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(en, READY, st.state),
+            in_layer=st.in_layer.at[row].set(True, mode="drop"),
+            leader=st.leader.at[row].set(node_idx, mode="drop"),
+            member=st.member.at[row].set(mem0, mode="drop"),
+            jn_stage=jnp.where(en, J_IDLE, st.jn_stage),
+            jn_deadline=jnp.where(en, T_INF, st.jn_deadline),
+            t_hb=jnp.where(en, now + jnp.int64(int(p.hb_interval * NS)),
+                           st.t_hb),
+            t_maint=jnp.where(
+                en, now + jnp.int64(int(p.maint_interval * NS)),
+                st.t_maint),
+            t_pub=jnp.where(en, now + jnp.int64(int(p.pub_interval * NS)),
+                            st.t_pub))
+
+    def _seen_push(self, st, en, h):
+        col = st.seen_n % st.seen.shape[-1]
+        return dataclasses.replace(
+            st,
+            seen=st.seen.at[jnp.where(en, col, st.seen.shape[-1])].set(
+                h, mode="drop"),
+            seen_n=st.seen_n + en.astype(I32))
+
+    # ------------------------------------------------ the step ---------
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, spec = self.p, self.key_spec
+        lmax, cmax = p.layers, p.cmax
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        del rng
+        t0, t_end = ctx.t_start, ctx.t_end
+        ev = app_base.AppEvents()
+        layer_idx = jnp.arange(lmax, dtype=I32)
+        c_joins = jnp.int32(0)
+        c_pub = jnp.int32(0)
+        c_recv = jnp.int32(0)
+        c_dup = jnp.int32(0)
+        c_splits = jnp.int32(0)
+        c_merges = jnp.int32(0)
+        c_evicts = jnp.int32(0)
+        c_fwdrop = jnp.int32(0)
+        hb_ns = jnp.int64(int(p.hb_interval * NS))
+        list_b = 16 + 25 * cmax   # NODEHANDLE_B * cmax payload
+
+        # ========================================= inbox handlers ======
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+            is_ready = st.state == READY
+
+            # ---- QUERY: return my layer-a cluster (a=-1 → my top) ----
+            en = v & (m.kind == NICE_QUERY) & is_ready
+            h = jnp.max(jnp.where(st.in_layer, layer_idx, -1))
+            l_eff = jnp.clip(jnp.where(m.a < 0, h, jnp.minimum(m.a, h)),
+                             0, lmax - 1)
+            ob.send(en & (h >= 0), now, m.src, NICE_QUERY_RES,
+                    a=l_eff, b=st.leader[l_eff], nodes=st.member[l_eff],
+                    size_b=list_b)
+
+            # ---- QUERY_RES: descend or converge --------------------
+            en = v & (m.kind == NICE_QUERY_RES) & (st.jn_stage == J_QUERY)
+            at_target = en & (m.a <= st.jn_target) & (m.b != NO_NODE)
+            # target layer reached: ask the actual leader to admit us
+            ob.send(at_target, now, jnp.maximum(m.b, 0), NICE_JOIN,
+                    a=st.jn_target, size_b=16)
+            descend = en & ~at_target
+            st = dataclasses.replace(
+                st,
+                jn_stage=jnp.where(at_target, J_JOIN,
+                                   jnp.where(descend, J_PROBE,
+                                             st.jn_stage)),
+                jn_layer=jnp.where(descend, m.a, st.jn_layer),
+                jn_cands=jnp.where(descend, m.nodes[:cmax], st.jn_cands),
+                jn_rtt=jnp.where(descend, T_INF, st.jn_rtt),
+                jn_sent=jnp.where(descend, False, st.jn_sent),
+                jn_deadline=jnp.where(
+                    at_target,
+                    now + jnp.int64(int(p.query_interval * NS)),
+                    st.jn_deadline))
+
+            # ---- PROBE: echo for RTT measurement -------------------
+            en = v & (m.kind == NICE_PROBE)
+            ob.send(en, now, m.src, NICE_PROBE_RES, stamp=m.stamp,
+                    size_b=8)
+
+            en = v & (m.kind == NICE_PROBE_RES) & (st.jn_stage == J_PROBE)
+            hit = en & jnp.any(st.jn_cands == m.src)
+            ci = jnp.argmax(st.jn_cands == m.src).astype(I32)
+            st = dataclasses.replace(st, jn_rtt=st.jn_rtt.at[
+                jnp.where(hit, ci, cmax)].set(now - m.stamp, mode="drop"))
+
+            # ---- JOIN: leader admits a member ----------------------
+            l = jnp.clip(m.a, 0, lmax - 1)
+            en = (v & (m.kind == NICE_JOIN) & is_ready &
+                  st.in_layer[l] & (st.leader[l] == node_idx))
+            mem = st.member[l]
+            have = jnp.any(mem == m.src)
+            slot = jnp.where(have, jnp.argmax(mem == m.src),
+                             jnp.argmax(mem == NO_NODE)).astype(I32)
+            adm = en & (have | jnp.any(mem == NO_NODE))
+            row = jnp.where(adm, l, lmax)
+            st = dataclasses.replace(
+                st,
+                member=st.member.at[row, slot].set(m.src, mode="drop"),
+                hb_seen=st.hb_seen.at[row, slot].set(now, mode="drop"))
+            ob.send(adm, now, m.src, NICE_JOIN_ACK, a=l,
+                    nodes=st.member[l], size_b=list_b)
+
+            # ---- JOIN_ACK: we are in -------------------------------
+            l = jnp.clip(m.a, 0, lmax - 1)
+            en = v & (m.kind == NICE_JOIN_ACK) & (st.jn_stage == J_JOIN)
+            c_joins += (en & (st.state == JOINING)).astype(I32)
+            row = jnp.where(en, l, lmax)
+            now_row = jnp.zeros((cmax,), I64) + now
+            st = dataclasses.replace(
+                st,
+                in_layer=st.in_layer.at[row].set(True, mode="drop"),
+                leader=st.leader.at[row].set(m.src, mode="drop"),
+                member=st.member.at[row].set(m.nodes[:cmax], mode="drop"),
+                hb_seen=st.hb_seen.at[row].set(now_row, mode="drop"),
+                jn_stage=jnp.where(en, J_IDLE, st.jn_stage),
+                jn_target=jnp.where(en, 0, st.jn_target),
+                jn_deadline=jnp.where(en, T_INF, st.jn_deadline),
+                state=jnp.where(en, READY, st.state),
+                t_hb=jnp.where(en & (st.t_hb == T_INF), now + hb_ns,
+                               st.t_hb),
+                t_maint=jnp.where(
+                    en & (st.t_maint == T_INF),
+                    now + jnp.int64(int(p.maint_interval * NS)),
+                    st.t_maint),
+                t_pub=jnp.where(
+                    en & (st.t_pub == T_INF),
+                    now + jnp.int64(int(p.pub_interval * NS)), st.t_pub))
+
+            # ---- HB: member liveness -------------------------------
+            l = jnp.clip(m.a, 0, lmax - 1)
+            en = v & (m.kind == NICE_HB) & is_ready & st.in_layer[l]
+            hit = en & jnp.any(st.member[l] == m.src)
+            mi = jnp.argmax(st.member[l] == m.src).astype(I32)
+            st = dataclasses.replace(st, hb_seen=st.hb_seen.at[
+                jnp.where(hit, l, lmax), mi].set(now, mode="drop"))
+
+            # ---- LEADER_HB: authoritative membership ---------------
+            l = jnp.clip(m.a, 0, lmax - 1)
+            en = v & (m.kind == NICE_LEADER_HB) & is_ready
+            inlist = jnp.any(m.nodes[:cmax] == node_idx)
+            adopt = en & inlist
+            row = jnp.where(adopt, l, lmax)
+            now_row = jnp.zeros((cmax,), I64) + now
+            st = dataclasses.replace(
+                st,
+                in_layer=st.in_layer.at[row].set(True, mode="drop"),
+                leader=st.leader.at[row].set(m.src, mode="drop"),
+                member=st.member.at[row].set(m.nodes[:cmax], mode="drop"),
+                hb_seen=st.hb_seen.at[row].set(now_row, mode="drop"))
+            # evicted by my own leader → drop the layer; layer-0 rejoins
+            evict = en & ~inlist & st.in_layer[l] & (st.leader[l] == m.src)
+            rejoin0 = evict & (l == 0)
+            st = dataclasses.replace(
+                st,
+                in_layer=st.in_layer & ~(evict & (layer_idx >= l)),
+                jn_stage=jnp.where(rejoin0, J_IDLE, st.jn_stage),
+                jn_target=jnp.where(rejoin0, 0, st.jn_target),
+                jn_deadline=jnp.where(rejoin0, now, st.jn_deadline))
+
+            # ---- SPLIT: my cluster was bipartitioned ---------------
+            l = jnp.clip(m.a, 0, lmax - 1)
+            en = v & (m.kind == NICE_SPLIT) & is_ready
+            adopt = en & jnp.any(m.nodes[:cmax] == node_idx)
+            row = jnp.where(adopt, l, lmax)
+            now_row = jnp.zeros((cmax,), I64) + now
+            st = dataclasses.replace(
+                st,
+                leader=st.leader.at[row].set(m.b, mode="drop"),
+                member=st.member.at[row].set(m.nodes[:cmax], mode="drop"),
+                hb_seen=st.hb_seen.at[row].set(now_row, mode="drop"))
+            # the new leader joins the upper anchor's cluster at l+1
+            promo = adopt & (m.b == node_idx) & (m.c != NO_NODE) & (
+                m.c != node_idx) & (l + 1 < lmax)
+            ob.send(promo, now, jnp.maximum(m.c, 0), NICE_JOIN,
+                    a=jnp.minimum(l + 1, lmax - 1), size_b=16)
+
+            # ---- MERGE: absorb a dissolving sibling cluster --------
+            l = jnp.clip(m.a, 0, lmax - 1)
+            en = (v & (m.kind == NICE_MERGE) & is_ready & st.in_layer[l] &
+                  (st.leader[l] == node_idx))
+            mem = st.member[l]
+            for ci in range(cmax):
+                nd = m.nodes[ci]
+                put = (en & (nd != NO_NODE) & ~jnp.any(mem == nd) &
+                       jnp.any(mem == NO_NODE))
+                slot = jnp.argmax(mem == NO_NODE).astype(I32)
+                mem = mem.at[jnp.where(put, slot, cmax)].set(
+                    nd, mode="drop")
+            row = jnp.where(en, l, lmax)
+            now_row = jnp.zeros((cmax,), I64) + now
+            st = dataclasses.replace(
+                st,
+                member=st.member.at[row].set(mem, mode="drop"),
+                hb_seen=st.hb_seen.at[row].set(now_row, mode="drop"))
+            c_merges += en.astype(I32)
+
+            # ---- MCAST: deliver once, queue the re-forward ---------
+            en = v & (m.kind == NICE_MCAST) & is_ready
+            h = (m.c.astype(I64) << 32) | m.b.astype(I64)
+            dup = jnp.any(st.seen == h)
+            fresh = en & ~dup
+            c_recv += fresh.astype(I32)
+            c_dup += (en & dup).astype(I32)
+            ev.value("nice_hops", m.hops.astype(jnp.float32), fresh)
+            st = self._seen_push(st, fresh, h)
+            # queue ONE re-forward per tick (extra distinct arrivals in
+            # the same 10-20ms window are counted, not re-forwarded —
+            # publish periods are seconds apart so collisions are rare)
+            c_fwdrop += (fresh & (st.fw_h != 0)).astype(I32)
+            take = fresh & (st.fw_h == 0)
+            st = dataclasses.replace(
+                st,
+                fw_h=jnp.where(take, h, st.fw_h),
+                fw_src=jnp.where(take, m.src, st.fw_src),
+                fw_origin=jnp.where(take, m.c, st.fw_origin),
+                fw_seq=jnp.where(take, m.b, st.fw_seq),
+                fw_layer=jnp.where(take, m.a, st.fw_layer),
+                fw_hops=jnp.where(take, m.hops + 1, st.fw_hops))
+
+        # ========================================= timers ==============
+        rp = ctx.glob if ctx.glob is not None else NO_NODE
+        is_ready = st.state == READY
+
+        # ---- join / rejoin descent driver -----------------------------
+        want = (st.state == JOINING) | (
+            is_ready & ((st.jn_stage != J_IDLE) |
+                        (st.jn_deadline < T_INF)))
+        due = want & (st.jn_deadline < t_end)
+        now_j = jnp.maximum(st.jn_deadline, t0)
+        alone = due & ((rp == NO_NODE) | (rp == node_idx)) & (
+            st.state == JOINING)
+        st = self._become_root(st, alone, now_j, node_idx)
+        c_joins += alone.astype(I32)
+
+        # probe-round evaluation: deadline passed while PROBING
+        eval_p = due & (st.jn_stage == J_PROBE) & st.jn_sent
+        got = jnp.any(st.jn_rtt < T_INF)
+        best_node = st.jn_cands[jnp.argmin(st.jn_rtt)]
+        go_down = eval_p & got & (best_node != NO_NODE)
+        nl = jnp.maximum(st.jn_layer - 1, st.jn_target)
+        ob.send(go_down, now_j, jnp.maximum(best_node, 0), NICE_QUERY,
+                a=nl, size_b=16)
+        st = dataclasses.replace(
+            st,
+            jn_stage=jnp.where(go_down, J_QUERY,
+                               jnp.where(eval_p & ~got, J_IDLE,
+                                         st.jn_stage)),
+            jn_deadline=jnp.where(
+                due & ~alone,
+                now_j + jnp.int64(int(p.query_interval * NS)),
+                st.jn_deadline))
+
+        # (re)start of the descent: IDLE but wanting a layer → query RP
+        lost0 = ~st.in_layer[0]
+        restart = (due & ~alone & (st.jn_stage == J_IDLE) &
+                   ((st.state == JOINING) | lost0 | (st.jn_target > 0)))
+        ob.send(restart & (rp != NO_NODE), now_j, jnp.maximum(rp, 0),
+                NICE_QUERY, a=jnp.int32(-1), size_b=16)
+        st = dataclasses.replace(
+            st, jn_stage=jnp.where(restart, J_QUERY, st.jn_stage))
+
+        # fresh probe round: fire the probes (out of the inbox loop so
+        # the CMAX-wide fan-out is traced once per tick, not per slot)
+        fire_p = (st.jn_stage == J_PROBE) & ~st.jn_sent & (
+            st.state != DEAD)
+        for ci in range(cmax):
+            nd = st.jn_cands[ci]
+            ob.send(fire_p & (nd != NO_NODE) & (nd != node_idx), t0,
+                    jnp.maximum(nd, 0), NICE_PROBE, stamp=t0, size_b=8)
+        st = dataclasses.replace(
+            st,
+            jn_sent=jnp.where(fire_p, True, st.jn_sent),
+            jn_deadline=jnp.where(
+                fire_p, t0 + jnp.int64(int(p.probe_wait * NS)),
+                st.jn_deadline))
+
+        # ---- heartbeats ----------------------------------------------
+        is_ready = st.state == READY
+        en_hb = is_ready & (st.t_hb < t_end)
+        now_h = jnp.maximum(st.t_hb, t0)
+        for l in range(lmax):
+            lead = st.in_layer[l] & (st.leader[l] == node_idx)
+            memb = st.in_layer[l] & ~lead
+            for ci in range(cmax):
+                nd = st.member[l, ci]
+                okd = (nd != NO_NODE) & (nd != node_idx)
+                ob.send(en_hb & lead & okd, now_h, jnp.maximum(nd, 0),
+                        NICE_LEADER_HB, a=jnp.int32(l),
+                        nodes=st.member[l], size_b=list_b)
+                ob.send(en_hb & memb & okd, now_h, jnp.maximum(nd, 0),
+                        NICE_HB, a=jnp.int32(l), size_b=16)
+        st = dataclasses.replace(
+            st, t_hb=jnp.where(en_hb, now_h + hb_ns, st.t_hb))
+
+        # ---- maintenance: evict / split / merge ----------------------
+        en_mt = is_ready & (st.t_maint < t_end)
+        now_m = jnp.maximum(st.t_maint, t0)
+        timeout = jnp.int64(int(p.peer_timeout_hbs * p.hb_interval * NS))
+        for l in range(lmax):
+            act = en_mt & st.in_layer[l]
+            lead = act & (st.leader[l] == node_idx)
+            mem = st.member[l]
+            valid = mem != NO_NODE
+            stale = (valid & (mem != node_idx) &
+                     (now_m - st.hb_seen[l] > timeout))
+            # leader loses members → clear their slots
+            c_evicts += jnp.sum(stale & lead, dtype=I32)
+            row = jnp.where(lead, l, lmax)
+            st = dataclasses.replace(st, member=st.member.at[row].set(
+                jnp.where(stale, NO_NODE, mem), mode="drop"))
+            # member loses its leader → rejoin this layer through RP
+            lhit = jnp.any(mem == st.leader[l])
+            li = jnp.argmax(mem == st.leader[l]).astype(I32)
+            lost = (act & ~lead & lhit &
+                    (now_m - st.hb_seen[l, li] > timeout))
+            st = dataclasses.replace(
+                st,
+                in_layer=st.in_layer.at[
+                    jnp.where(lost, l, lmax)].set(False, mode="drop"),
+                jn_stage=jnp.where(lost, J_IDLE, st.jn_stage),
+                jn_target=jnp.where(lost, l, st.jn_target),
+                jn_deadline=jnp.where(lost, now_m, st.jn_deadline))
+
+            # ---- split (> 3k-1 members; ClusterSplit Nice.cc:2621) ----
+            mem = st.member[l]
+            size = jnp.sum(mem != NO_NODE, dtype=I32)
+            do_split = lead & (size > 3 * p.k - 1)
+            c_splits += do_split.astype(I32)
+            others = jnp.sort(jnp.where(
+                (mem == NO_NODE) | (mem == node_idx), BIG, mem))
+            others = jnp.where(others == BIG, NO_NODE, others)
+            n_oth = jnp.sum(others != NO_NODE, dtype=I32)
+            keep = size // 2 - 1               # others staying with me
+            pos = jnp.arange(cmax, dtype=I32)
+            half1 = jnp.where(pos == 0, node_idx,
+                              jnp.where(pos - 1 < keep,
+                                        jnp.take(others, jnp.clip(
+                                            pos - 1, 0, cmax - 1)),
+                                        NO_NODE))
+            h2 = jnp.take(others, jnp.clip(pos + keep, 0, cmax - 1))
+            half2 = jnp.where(pos < n_oth - keep, h2, NO_NODE)
+            new_leader = half2[0]
+            lup = min(l + 1, lmax - 1)
+            has_up = st.in_layer[lup] if l + 1 < lmax else jnp.bool_(False)
+            anchor = jnp.where(has_up, st.leader[lup], node_idx)
+            for ci in range(cmax):
+                nd = half2[ci]
+                ob.send(do_split & (nd != NO_NODE), now_m,
+                        jnp.maximum(nd, 0), NICE_SPLIT, a=jnp.int32(l),
+                        b=new_leader, c=anchor, nodes=half2,
+                        size_b=list_b)
+            row = jnp.where(do_split, l, lmax)
+            st = dataclasses.replace(
+                st, member=st.member.at[row].set(half1, mode="drop"))
+            # I was the top leader: a fresh upper cluster forms around me
+            mkup = do_split & ~has_up & (l + 1 < lmax)
+            memup = jnp.full((cmax,), NO_NODE, I32).at[0].set(node_idx)
+            rowu = jnp.where(mkup, lup, lmax)
+            st = dataclasses.replace(
+                st,
+                in_layer=st.in_layer.at[rowu].set(True, mode="drop"),
+                leader=st.leader.at[rowu].set(node_idx, mode="drop"),
+                member=st.member.at[rowu].set(memup, mode="drop"),
+                hb_seen=st.hb_seen.at[rowu].set(
+                    jnp.zeros((cmax,), I64) + now_m, mode="drop"))
+
+            # ---- merge (< k members; ClusterMerge Nice.cc:2866) ----
+            mem = st.member[l]
+            size = jnp.sum(mem != NO_NODE, dtype=I32)
+            up_mem = st.member[lup]
+            peer_ok = (up_mem != NO_NODE) & (up_mem != node_idx)
+            peer = up_mem[jnp.argmax(peer_ok)]
+            do_merge = (lead & (size < p.k) & (l + 1 < lmax) &
+                        st.in_layer[lup] & jnp.any(peer_ok))
+            ob.send(do_merge, now_m, jnp.maximum(peer, 0), NICE_MERGE,
+                    a=jnp.int32(l), nodes=mem, size_b=list_b)
+            # demote: the absorbing peer owns the merged cluster; we
+            # stay a plain member of layer l and leave the layers above
+            row = jnp.where(do_merge, l, lmax)
+            st = dataclasses.replace(
+                st,
+                leader=st.leader.at[row].set(peer, mode="drop"),
+                in_layer=st.in_layer & ~(do_merge & (layer_idx > l)))
+        st = dataclasses.replace(
+            st, t_maint=jnp.where(
+                en_mt, now_m + jnp.int64(int(p.maint_interval * NS)),
+                st.t_maint))
+
+        # ---- ALM workload: publish into all own clusters --------------
+        is_ready = st.state == READY
+        fw = st.fw_h != 0
+        pub_due = is_ready & (st.t_pub < t_end)
+        en_pub = pub_due & ctx.measuring & ~fw
+        now_pb = jnp.maximum(st.t_pub, t0)
+        seq = st.seq + en_pub.astype(I32)
+        h = (node_idx.astype(I64) << 32) | seq.astype(I64)
+        c_pub += en_pub.astype(I32)
+        st = self._seen_push(st, en_pub, h)
+        st = dataclasses.replace(
+            st, seq=seq,
+            t_pub=jnp.where(
+                pub_due, now_pb + jnp.int64(int(p.pub_interval * NS)),
+                st.t_pub))
+        nlayers = jnp.sum(st.in_layer, dtype=I32)
+        ev.value("nice_layers", nlayers.astype(jnp.float32), en_pub)
+
+        # ---- unified dissemination fan-out ----------------------------
+        # one fan-out per tick: either my own publish (arrival layer -1)
+        # or the queued re-forward from the inbox sweep
+        go = fw | en_pub
+        g_origin = jnp.where(fw, st.fw_origin, node_idx)
+        g_seq = jnp.where(fw, st.fw_seq, seq)
+        g_src = jnp.where(fw, st.fw_src, node_idx)
+        g_layer = jnp.where(fw, st.fw_layer, -1)
+        g_hops = jnp.where(fw, st.fw_hops, 0)
+        now_f = jnp.where(fw, t0, now_pb)
+        for l in range(lmax):
+            into = go & st.in_layer[l] & (l != g_layer)
+            for ci in range(cmax):
+                nd = st.member[l, ci]
+                ob.send(into & (nd != NO_NODE) & (nd != node_idx) &
+                        (nd != g_src), now_f, jnp.maximum(nd, 0),
+                        NICE_MCAST, a=jnp.int32(l), b=g_seq, c=g_origin,
+                        hops=g_hops, size_b=60)
+        st = dataclasses.replace(
+            st,
+            fw_h=jnp.where(fw, 0, st.fw_h),
+            fw_src=jnp.where(fw, NO_NODE, st.fw_src))
+
+        events = {"c:nice_joins": c_joins, "c:nice_pub": c_pub,
+                  "c:nice_recv": c_recv, "c:nice_dup": c_dup,
+                  "c:nice_splits": c_splits, "c:nice_merges": c_merges,
+                  "c:nice_evicts": c_evicts, "c:nice_fwd_drop": c_fwdrop}
+        ev.finish(events, {})
+        return st, ob, events

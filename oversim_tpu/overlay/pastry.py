@@ -31,9 +31,13 @@ numberOfLeaves=16 (Bamboo 8)).  State is structure-of-arrays:
     (`globalTuningInterval`); Pastry's reactive leafset repair
     (handleFailedNode → state request to the farthest leaf) rides the
     same exchange message;
-  * proximity neighbor selection (PNS ping-before-adopt,
-    BasePastry.cc:439-570) and the neighborhood set are TODO
-    (NeighborCache integration).
+  * proximity neighbor selection (PNS, BasePastry.cc:439-570
+    pingNodes/proximity compare): every state exchange carries an RTT
+    stamp; the responder's measured RTT gates routing-table adoption —
+    a measured-closer candidate replaces an occupied slot (rt_rtt
+    table), unmeasured candidates only fill empty slots.  The
+    neighborhood set (purely a PNS seed cache in the reference) is
+    subsumed by the same RTT table.
 
 Routing mode defaults to SEMI_RECURSIVE with per-hop ACKs — the
 reference's Pastry configuration (default.ini:245-246 routeMsgAcks=true,
@@ -66,6 +70,7 @@ NS = 1_000_000_000
 T_INF = jnp.int64(2**62)
 NO_NODE = jnp.int32(-1)
 UMAX = jnp.uint32(0xFFFFFFFF)
+RTT_INF = jnp.int32(2**30)
 
 DEAD, JOINING, READY = 0, 1, 2
 
@@ -104,6 +109,8 @@ class PastryState:
     leaf_cw: jnp.ndarray    # [N, L/2] i32 clockwise (successor side)
     leaf_ccw: jnp.ndarray   # [N, L/2] i32 counter-clockwise
     rt: jnp.ndarray         # [N, ROWS, COLS] i32
+    rt_rtt: jnp.ndarray     # [N, ROWS, COLS] i32 RTT ms of each entry
+                            # (PNS state, BasePastry.cc:439-570 pingNodes)
     t_join: jnp.ndarray     # [N] i64
     t_ls: jnp.ndarray       # [N] i64 leafset maintenance
     t_gt: jnp.ndarray       # [N] i64 global tuning
@@ -155,6 +162,7 @@ class PastryLogic:
             leaf_cw=jnp.full((n, p.half), NO_NODE, I32),
             leaf_ccw=jnp.full((n, p.half), NO_NODE, I32),
             rt=jnp.full((n, p.rows, p.cols), NO_NODE, I32),
+            rt_rtt=jnp.full((n, p.rows, p.cols), RTT_INF, I32),
             t_join=jnp.full((n,), T_INF, I64),
             t_ls=jnp.full((n,), T_INF, I64),
             t_gt=jnp.full((n,), T_INF, I64),
@@ -220,27 +228,36 @@ class PastryLogic:
             leaf_ccw=self._half_sorted(ctx, me_key, node_idx, all_ccw,
                                        False))
 
-    def _rt_add(self, ctx, st, me_key, node_idx, cands, en):
-        """Insert candidates into empty routing-table slots
-        (PastryRoutingTable::mergeNode; no PNS yet → first one wins)."""
+    def _rt_add(self, ctx, st, me_key, node_idx, cands, en, rtt=None):
+        """Insert candidates into routing-table slots with proximity
+        neighbor selection (PastryRoutingTable::mergeNode + the PNS
+        ping-before-adopt comparison, BasePastry.cc:439-570: a measured
+        closer candidate replaces an occupied slot; unmeasured
+        candidates only fill empty slots)."""
         p = self.p
-        rt = st.rt
+        rt, rt_rtt = st.rt, st.rt_rtt
         for i in range(cands.shape[0]):
             c = jnp.where(en[i] & (cands[i] != node_idx), cands[i], NO_NODE)
+            c_rtt = RTT_INF if rtt is None else rtt[i]
             ck = ctx.keys[jnp.maximum(c, 0)]
             row = jnp.minimum(
                 K.shared_prefix_digits(me_key, ck, p.bits_per_digit,
                                        self.key_spec), p.rows - 1)
             col = K.digit(ck, row, p.bits_per_digit, self.key_spec)
             empty = rt[row, col] == NO_NODE
-            do = (c != NO_NODE) & empty
+            same = rt[row, col] == c
+            closer = c_rtt < rt_rtt[row, col]
+            do = (c != NO_NODE) & (empty | closer | same)
             r = jnp.where(do, row, p.rows)
             rt = rt.at[r, col].set(c, mode="drop")
-        return dataclasses.replace(st, rt=rt)
+            rt_rtt = rt_rtt.at[r, col].set(
+                jnp.where(same & ~closer, rt_rtt[row, col],
+                          jnp.asarray(c_rtt, I32)), mode="drop")
+        return dataclasses.replace(st, rt=rt, rt_rtt=rt_rtt)
 
-    def _learn(self, ctx, st, me_key, node_idx, cands, en):
+    def _learn(self, ctx, st, me_key, node_idx, cands, en, rtt=None):
         st = self._leaf_merge(ctx, st, me_key, node_idx, cands, en)
-        return self._rt_add(ctx, st, me_key, node_idx, cands, en)
+        return self._rt_add(ctx, st, me_key, node_idx, cands, en, rtt)
 
     def _leafset_nodes(self, st, node_idx):
         """Own state payload: self + both halves (PastryStateMessage)."""
@@ -351,14 +368,15 @@ class PastryLogic:
             jnp.ones((2 * self.p.half,), bool))
         st = select_tree(any_failed, st2, st)
         st = dataclasses.replace(
-            st, rt=jnp.where(hit(st.rt), NO_NODE, st.rt))
+            st, rt=jnp.where(hit(st.rt), NO_NODE, st.rt),
+            rt_rtt=jnp.where(hit(st.rt), RTT_INF, st.rt_rtt))
         # repair: ask the farthest remaining leaf for its state
         repair_tgt = jnp.where(st.leaf_cw[-1] != NO_NODE, st.leaf_cw[-1],
                                st.leaf_cw[0])
         fire = any_failed & lost_leaf & (repair_tgt != NO_NODE) & (
             st.state == READY)
         ob.send(fire, now, repair_tgt, wire.PASTRY_STATE_CALL,
-                size_b=wire.BASE_CALL_B)
+                stamp=now, size_b=wire.BASE_CALL_B)
         return st
 
     def _become_ready(self, ctx, st, en, now, rng):
@@ -480,12 +498,17 @@ class PastryLogic:
                 st.state == READY)
             ob.send(en, now, m.src, wire.PASTRY_STATE_RES,
                     nodes=pad_nodes(self._leafset_nodes(st, node_idx)),
-                    size_b=wire.BASE_CALL_B
+                    stamp=m.stamp, size_b=wire.BASE_CALL_B
                     + wire.NODEHANDLE_B * (p.num_leaves + 1))
             en = v & (m.kind == wire.PASTRY_STATE_RES)
+            rtt_ms = jnp.clip((now - m.stamp) // 1_000_000, 0,
+                              RTT_INF - 1).astype(I32)
+            rtt_vec = jnp.full((rmax,), RTT_INF, I32).at[0].set(
+                jnp.where(m.stamp > 0, rtt_ms, RTT_INF))
             st = select_tree(
                 en, self._learn(ctx, st, me_key, node_idx,
-                                m.nodes[:rmax], m.nodes[:rmax] != NO_NODE),
+                                m.nodes[:rmax], m.nodes[:rmax] != NO_NODE,
+                                rtt=rtt_vec),
                 st)
             # joining node: first state response completes the join
             got_state = en & (st.state == JOINING)
@@ -532,7 +555,7 @@ class PastryLogic:
         tgt = leafs[order[jnp.minimum(pick, leafs.shape[0] - 1)]]
         fire_l = en_l & (tgt != NO_NODE)
         ob.send(fire_l, now_l, tgt, wire.PASTRY_STATE_CALL,
-                size_b=wire.BASE_CALL_B)
+                stamp=now_l, size_b=wire.BASE_CALL_B)
         st = dataclasses.replace(st, t_ls=jnp.where(
             en_l, now_l + jnp.int64(int(p.leafset_interval * NS)), st.t_ls))
 
@@ -651,7 +674,7 @@ class PastryLogic:
             # join lookup done → request state from the responsible node
             enj = en & (pur == P_JOIN)
             ob.send(enj & suc, t0, res, wire.PASTRY_STATE_CALL,
-                    size_b=wire.BASE_CALL_B)
+                    stamp=t0, size_b=wire.BASE_CALL_B)
             # join lookup failed → retry
             st = dataclasses.replace(st, t_join=jnp.where(
                 enj & ~suc, t0 + jnp.int64(int(p.join_delay * NS)),

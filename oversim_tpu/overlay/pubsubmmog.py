@@ -1,0 +1,505 @@
+"""PubSubMMOG — grid-subspace publish/subscribe game overlay.
+
+TPU-native rebuild of src/overlay/pubsubmmog/ (PubSubMMOG.{h,cc} 2.2k
+LoC + PubSubLobby.{h,cc}): the play field is an
+``numSubspaces x numSubspaces`` grid of subspaces
+(PubSubSubspaceId.h:57); a central lobby server assigns one
+*responsible node* per active subspace (PubSubLobby::handleRespCall,
+PubSubLobby.cc) and players subscribe to every subspace overlapping
+their AOI square (PubSubMMOG::handleMove AOI scan).  Movement updates
+go to the current subspace's responsible node, which aggregates each
+timeslot's moves (movementRate slots/s) and disseminates the move list
+to all subscribers (PubSubMoveListMessage; sendMessageToChildren); a
+move list older than maxMoveDelay counts as a wrong-timeslot delivery
+(numEventsWrongTimeslot).
+
+Redesign notes (vectorized engine, LogicBase gather/scatter):
+
+  * **the lobby is global state, not a host** — the reference's lobby
+    is an always-on server every player knows (PubSubMMOG.h:117
+    ``lobbyServer``); here its player/duty maps live in the logic's
+    glob part: a ``resp[S]`` responsible-node table maintained by the
+    un-vmapped post_step from per-node "want" events.  Assignment
+    latency collapses from one RPC round-trip to one tick (~the same
+    10-20 ms), and lobby failure is out of scope in both builds.
+  * **failure recovery via the lobby's global view**: the reference
+    keeps a backup node per subspace plus ping/replacement chatter
+    (PubSubBackupCall/PubSubReplacementMessage); here post_step sees
+    ``ctx.alive`` directly (the same information the reference lobby
+    re-learns through timeouts) and clears dead responsibles, so the
+    next want-event reassigns the duty.  Children notice a silent
+    parent after parentTimeout and re-request (handleParentTimeout).
+  * **no intermediate load-balancing tree**: the reference inserts
+    intermediate fan-out nodes above maxChildren subscribers
+    (PubSubIntermediateCall, maxChildren default.ini:324).  Here a
+    responsible node serves at most CH children and *rejects* further
+    subscriptions (the player retries; the lobby may hand the duty of
+    a neighboring subspace to someone else) — the per-node bandwidth
+    cap the tree protects is modeled by the underlay's send queue, and
+    the bounded-children reject keeps the same cap without tree
+    state.  Subspace move lists ride one message per child per slot.
+
+Wire mapping: a=subspace id, b=timeslot, nodes=mover slots.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps import movement as move_mod
+from oversim_tpu.core import keys as K
+from oversim_tpu.engine.logic import Outbox, select_tree
+
+I32 = jnp.int32
+I64 = jnp.int64
+F32 = jnp.float32
+NS = 1_000_000_000
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+DEAD, JOINING, READY = 0, 1, 2
+
+PS_SUB_CALL = 125    # a=subspace — subscribe me
+PS_SUB_RES = 126     # a=subspace, c=1 ok / 0 rejected (children full)
+PS_UNSUB = 127       # a=subspace
+PS_MOVE = 128        # a=subspace, b=timeslot, stamp=send time
+PS_MOVELIST = 129    # a=subspace, b=timeslot, nodes=movers, stamp=slot t0
+
+
+@dataclasses.dataclass(frozen=True)
+class PubSubParams:
+    """Reference params: PubSubMMOG.ned:30-39 + default.ini:321-326."""
+
+    field: float = 1000.0        # areaDimension
+    grid: int = 4                # numSubspaces (per direction)
+    aoi: float = 100.0           # AOIWidth
+    move_rate: float = 2.0       # movementRate (timeslots per second)
+    speed: float = 5.0           # movementSpeed (units/s)
+    join_delay: float = 1.0      # joinDelay
+    parent_timeout: float = 2.0  # parentTimeout
+    max_move_delay: float = 1.0  # maxMoveDelay
+    max_children: int = 12       # maxChildren (also the CH array cap)
+    duties: int = 4              # subspace duties one node may hold
+    subs: int = 4                # subscription slots (AOI ≤ subspace →
+                                 # at most 4 overlapping subspaces)
+    generator: str = "randomRoaming"
+
+    @property
+    def nsub(self) -> int:
+        return self.grid * self.grid
+
+    @property
+    def sub_size(self) -> float:
+        return self.field / self.grid
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PubSubGlob:
+    resp: jnp.ndarray        # [S] i32 responsible node per subspace
+    age: jnp.ndarray         # [S] i32 ticks since assignment (grace for
+                             # the assignee to adopt the duty)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PubSubState:
+    """[N, ...] at rest; step() sees one node's slice."""
+
+    state: jnp.ndarray       # [N]
+    pos: jnp.ndarray         # [N, 2] f32
+    wp: jnp.ndarray          # [N, 2] f32 waypoint
+    # subscriber side
+    sub_id: jnp.ndarray      # [N, SB] i32 subspace ids (-1 free)
+    sub_ok: jnp.ndarray      # [N, SB] bool — subscription confirmed
+    sub_seen: jnp.ndarray    # [N, SB] i64 — last move list from parent
+    want: jnp.ndarray        # [N] i32 — subspace asked from the lobby
+    # responsible side
+    duty: jnp.ndarray        # [N, D] i32 subspace ids (-1 free)
+    child: jnp.ndarray       # [N, D, CH] i32 subscribers
+    mover: jnp.ndarray       # [N, D, CH] i32 — this slot's movers
+    mv_n: jnp.ndarray       # [N, D] i32
+    t_join: jnp.ndarray      # [N] i64
+    t_slot: jnp.ndarray      # [N] i64 — next timeslot boundary
+    slot_no: jnp.ndarray     # [N] i32
+    glob: object             # PubSubGlob
+
+
+class PubSubMMOGLogic:
+    """Engine logic (interface: engine/logic.py docstring)."""
+
+    def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
+                 params: PubSubParams = PubSubParams()):
+        self.key_spec = spec
+        self.p = params
+        self.mp = move_mod.MoveParams(generator=params.generator,
+                                      field=params.field,
+                                      speed=params.speed)
+
+    def stat_spec(self):
+        return stats_mod.StatSpec(
+            scalars=("ps_children",),
+            hists=(),
+            counters=("ps_joins", "ps_moves", "ps_lists_sent",
+                      "ps_lists_recv", "ps_events_ok", "ps_events_late",
+                      "ps_lost_lists", "ps_rejects"))
+
+    # ------------------------------------------------ LogicBase glue ---
+    def split(self, st):
+        return dataclasses.replace(st, glob=None), st.glob
+
+    def merge(self, node_part, glob):
+        return dataclasses.replace(node_part, glob=glob)
+
+    def post_step(self, ctx, st, events):
+        """The lobby: clear dead responsibles, assign wanted subspaces.
+
+        Mirrors PubSubLobby::handleRespCall (assign on demand, prefer
+        the requester) + failedNode (drop duties of dead nodes)."""
+        g: PubSubGlob = st.glob
+        s = g.resp.shape[0]
+        alive_resp = (g.resp != NO_NODE) & ctx.alive[
+            jnp.maximum(g.resp, 0)]
+        # an assignee that never adopted the duty (moved away before the
+        # assignment landed) is dropped after a short grace
+        held = jnp.any(
+            st.duty[jnp.maximum(g.resp, 0)] ==
+            jnp.arange(s, dtype=I32)[:, None], axis=-1)
+        keep = alive_resp & (held | (g.age < 100))
+        resp = jnp.where(keep, g.resp, NO_NODE)
+        age = jnp.where(keep, g.age + 1, 0)
+        want = events.get("g:ps_want")
+        if want is not None:
+            n = want.shape[0]
+            # last requester per subspace wins the vacant duty
+            cand = jnp.full((s,), NO_NODE, I32).at[
+                jnp.where(want >= 0, jnp.clip(want, 0, s - 1), s)].set(
+                    jnp.arange(n, dtype=I32), mode="drop")
+            assign = (resp == NO_NODE) & (cand != NO_NODE)
+            resp = jnp.where(assign, cand, resp)
+            age = jnp.where(assign, 0, age)
+        return dataclasses.replace(st, glob=PubSubGlob(resp=resp,
+                                                       age=age))
+
+    # ------------------------------------------------ engine hooks -----
+    def init(self, rng, n: int) -> PubSubState:
+        p = self.p
+        pos, wp = move_mod.init_positions(rng, n, self.mp)
+        return PubSubState(
+            state=jnp.zeros((n,), I32),
+            pos=pos, wp=wp,
+            sub_id=jnp.full((n, p.subs), NO_NODE, I32),
+            sub_ok=jnp.zeros((n, p.subs), bool),
+            sub_seen=jnp.zeros((n, p.subs), I64),
+            want=jnp.full((n,), NO_NODE, I32),
+            duty=jnp.full((n, p.duties), NO_NODE, I32),
+            child=jnp.full((n, p.duties, p.max_children), NO_NODE, I32),
+            mover=jnp.full((n, p.duties, p.max_children), NO_NODE, I32),
+            mv_n=jnp.zeros((n, p.duties), I32),
+            t_join=jnp.full((n,), T_INF, I64),
+            t_slot=jnp.full((n,), T_INF, I64),
+            slot_no=jnp.zeros((n,), I32),
+            glob=PubSubGlob(resp=jnp.full((p.nsub,), NO_NODE, I32),
+                            age=jnp.zeros((p.nsub,), I32)))
+
+    def reset(self, st, clear, join, t_now, rng):
+        n = st.state.shape[0]
+        glob = st.glob
+        st = dataclasses.replace(st, glob=None)
+        fresh = dataclasses.replace(self.init(rng, n), glob=None)
+        st = select_tree(clear, fresh, st)
+        st = dataclasses.replace(st, glob=glob)
+        jitter = (jax.random.uniform(rng, (n,)) *
+                  self.p.join_delay * NS).astype(I64)
+        return dataclasses.replace(
+            st,
+            state=jnp.where(join, JOINING, st.state),
+            t_join=jnp.where(join, t_now + jitter, st.t_join))
+
+    def ready_mask(self, st):
+        return st.state == READY
+
+    def next_event(self, st):
+        t = jnp.where(st.state == JOINING, st.t_join, T_INF)
+        t = jnp.minimum(t, jnp.where(st.state == READY, st.t_slot, T_INF))
+        return t
+
+    # ------------------------------------------------ helpers ----------
+    def _subspace_of(self, pos):
+        """[2] f32 → i32 grid cell id."""
+        p = self.p
+        c = jnp.clip((pos / p.sub_size).astype(I32), 0, p.grid - 1)
+        return c[0] * p.grid + c[1]
+
+    def _aoi_subspaces(self, pos):
+        """[SB] i32: ids of the ≤4 subspaces the AOI square overlaps
+        (the reference scans currentRegion ± AOIWidth)."""
+        p = self.p
+        half = p.aoi / 2.0
+        ids = []
+        for dx, dy in ((-1, -1), (-1, 1), (1, -1), (1, 1)):
+            q = pos + jnp.asarray([dx * half, dy * half], F32)
+            q = jnp.clip(q, 0.0, p.field - 1e-3)
+            c = jnp.clip((q / p.sub_size).astype(I32), 0, p.grid - 1)
+            ids.append(c[0] * p.grid + c[1])
+        out = jnp.stack(ids)
+        # dedupe (corners may share a cell): later duplicates → -1
+        dup = jnp.zeros((4,), bool)
+        for i in range(1, 4):
+            dup = dup.at[i].set(jnp.any(out[:i] == out[i]))
+        return jnp.where(dup, NO_NODE, out)
+
+    # ------------------------------------------------ the step ---------
+    def step(self, ctx, st, msgs, rng, node_idx, *, outbox_slots, rmax):
+        p, spec = self.p, self.key_spec
+        d_max, ch, sb = p.duties, p.max_children, p.subs
+        ob = Outbox(outbox_slots, spec.lanes, rmax)
+        t0, t_end = ctx.t_start, ctx.t_end
+        ev = app_base.AppEvents()
+        glob: PubSubGlob = ctx.glob
+        slot_ns = jnp.int64(int(NS / p.move_rate))
+        c_joins = jnp.int32(0)
+        c_moves = jnp.int32(0)
+        c_sent = jnp.int32(0)
+        c_recv = jnp.int32(0)
+        c_ok = jnp.int32(0)
+        c_late = jnp.int32(0)
+        c_rej = jnp.int32(0)
+        want_out = jnp.int32(NO_NODE)
+
+        # ========================================= inbox handlers ======
+        for r in range(msgs.valid.shape[0]):
+            m = msgs.slot(r)
+            now = m.t_deliver
+            v = m.valid
+            is_ready = st.state == READY
+
+            # ---- SUB_CALL: adopt a child for subspace a ------------
+            di_ok = st.duty == m.a
+            di = jnp.argmax(di_ok).astype(I32)
+            en = v & (m.kind == PS_SUB_CALL) & is_ready & jnp.any(di_ok)
+            crow = st.child[di]
+            have = jnp.any(crow == m.src)
+            free = jnp.any(crow == NO_NODE)
+            slot = jnp.where(have, jnp.argmax(crow == m.src),
+                             jnp.argmax(crow == NO_NODE)).astype(I32)
+            adopt = en & (have | free)
+            c_rej += (en & ~have & ~free).astype(I32)
+            st = dataclasses.replace(st, child=st.child.at[
+                jnp.where(adopt, di, d_max), slot].set(
+                    m.src, mode="drop"))
+            ob.send(en, now, m.src, PS_SUB_RES, a=m.a,
+                    c=adopt.astype(I32), size_b=16)
+
+            # ---- SUB_RES: subscription outcome ---------------------
+            si_ok = st.sub_id == m.a
+            si = jnp.argmax(si_ok).astype(I32)
+            en = v & (m.kind == PS_SUB_RES) & jnp.any(si_ok)
+            ok = en & (m.c != 0)
+            fail = en & (m.c == 0)
+            st = dataclasses.replace(
+                st,
+                sub_ok=st.sub_ok.at[jnp.where(ok, si, sb)].set(
+                    True, mode="drop"),
+                sub_seen=st.sub_seen.at[jnp.where(ok, si, sb)].set(
+                    now, mode="drop"),
+                # rejected: drop the slot; the AOI scan re-requests later
+                sub_id=st.sub_id.at[jnp.where(fail, si, sb)].set(
+                    NO_NODE, mode="drop"))
+
+            # ---- UNSUB: drop the child -----------------------------
+            di_ok = st.duty == m.a
+            di = jnp.argmax(di_ok).astype(I32)
+            en = v & (m.kind == PS_UNSUB) & jnp.any(di_ok)
+            crow = st.child[di]
+            ci = jnp.argmax(crow == m.src).astype(I32)
+            hit = en & jnp.any(crow == m.src)
+            st = dataclasses.replace(st, child=st.child.at[
+                jnp.where(hit, di, d_max), ci].set(NO_NODE, mode="drop"))
+
+            # ---- MOVE: collect the mover into this timeslot --------
+            di_ok = st.duty == m.a
+            di = jnp.argmax(di_ok).astype(I32)
+            en = v & (m.kind == PS_MOVE) & is_ready & jnp.any(di_ok)
+            c_moves += en.astype(I32)
+            mrow = st.mover[di]
+            have = jnp.any(mrow == m.src)
+            slot = jnp.where(have, jnp.argmax(mrow == m.src),
+                             jnp.argmax(mrow == NO_NODE)).astype(I32)
+            put = en & (have | jnp.any(mrow == NO_NODE))
+            st = dataclasses.replace(
+                st,
+                mover=st.mover.at[jnp.where(put, di, d_max), slot].set(
+                    m.src, mode="drop"),
+                mv_n=st.mv_n.at[jnp.where(put & ~have, di, d_max)].add(
+                    1, mode="drop"))
+
+            # ---- MOVELIST: the subspace's slot digest --------------
+            si_ok = st.sub_id == m.a
+            si = jnp.argmax(si_ok).astype(I32)
+            en = v & (m.kind == PS_MOVELIST) & is_ready & jnp.any(si_ok)
+            c_recv += en.astype(I32)
+            nmv = jnp.sum(m.nodes[:ch] != NO_NODE, dtype=I32)
+            late = now - m.stamp > jnp.int64(int(p.max_move_delay * NS))
+            c_ok += jnp.where(en & ~late, nmv, 0)
+            c_late += jnp.where(en & late, nmv, 0)
+            st = dataclasses.replace(st, sub_seen=st.sub_seen.at[
+                jnp.where(en, si, sb)].set(now, mode="drop"))
+
+        # ========================================= timers ==============
+        # ---- join: enter the field at the next slot boundary ----------
+        en_j = (st.state == JOINING) & (st.t_join < t_end)
+        now_j = jnp.maximum(st.t_join, t0)
+        c_joins += en_j.astype(I32)
+        st = dataclasses.replace(
+            st,
+            state=jnp.where(en_j, READY, st.state),
+            t_slot=jnp.where(en_j, now_j + slot_ns, st.t_slot))
+
+        # ---- timeslot: move, publish, AOI upkeep, duty digest ---------
+        is_ready = st.state == READY
+        en_s = is_ready & (st.t_slot < t_end)
+        now_s = jnp.maximum(st.t_slot, t0)
+        rng_wp, _ = jax.random.split(rng)
+
+        # advance the position toward the waypoint (movement.py family)
+        dt = jnp.where(en_s, 1.0 / p.move_rate, 0.0).astype(F32)
+        delta = st.wp - st.pos
+        dist = jnp.maximum(jnp.linalg.norm(delta), 1e-6)
+        step_len = jnp.minimum(dist, p.speed * dt)
+        pos = st.pos + delta / dist * step_len
+        arrived = en_s & (dist <= p.speed * dt)
+        wp = jnp.where(arrived, move_mod.draw_waypoints(
+            rng_wp, pos, self.mp), st.wp)
+        st = dataclasses.replace(st, pos=pos, wp=wp)
+
+        cur = self._subspace_of(st.pos)
+        aoi = self._aoi_subspaces(st.pos)           # [4] ids (-1 dups)
+
+        # publish my move to the current subspace's responsible node
+        resp_cur = glob.resp[jnp.clip(cur, 0, p.nsub - 1)]
+        ob.send(en_s & (resp_cur != NO_NODE) & ctx.measuring, now_s,
+                jnp.maximum(resp_cur, 0), PS_MOVE, a=cur, b=st.slot_no,
+                stamp=now_s, size_b=40)
+
+        # subscription upkeep: want AOI subspaces, drop stale ones
+        # one new subscription request per slot (bounded signalling)
+        in_aoi = jnp.zeros((sb,), bool)
+        for ai in range(4):
+            in_aoi = in_aoi | (st.sub_id == aoi[ai])
+        # unsubscribe subspaces that left the AOI
+        for si in range(sb):
+            go = en_s & (st.sub_id[si] != NO_NODE) & ~in_aoi[si]
+            rs = glob.resp[jnp.clip(st.sub_id[si], 0, p.nsub - 1)]
+            ob.send(go & (rs != NO_NODE), now_s, jnp.maximum(rs, 0),
+                    PS_UNSUB, a=st.sub_id[si], size_b=16)
+            st = dataclasses.replace(
+                st,
+                sub_id=st.sub_id.at[jnp.where(go, si, sb)].set(
+                    NO_NODE, mode="drop"),
+                sub_ok=st.sub_ok.at[jnp.where(go, si, sb)].set(
+                    False, mode="drop"))
+        # parent timeout: confirmed subspace silent → re-request
+        pto = jnp.int64(int(p.parent_timeout * NS))
+        for si in range(sb):
+            stale = (en_s & st.sub_ok[si] & (st.sub_id[si] != NO_NODE) &
+                     (now_s - st.sub_seen[si] > pto))
+            st = dataclasses.replace(
+                st, sub_ok=st.sub_ok.at[jnp.where(stale, si, sb)].set(
+                    False, mode="drop"))
+        # adopt one missing AOI subspace into a free slot
+        missing = jnp.full((1,), NO_NODE, I32)[0]
+        for ai in range(4):
+            known = jnp.any(st.sub_id == aoi[ai])
+            missing = jnp.where((missing == NO_NODE) & (aoi[ai] >= 0) &
+                                ~known, aoi[ai], missing)
+        free_ok = jnp.any(st.sub_id == NO_NODE)
+        fsi = jnp.argmax(st.sub_id == NO_NODE).astype(I32)
+        put = en_s & (missing != NO_NODE) & free_ok
+        st = dataclasses.replace(st, sub_id=st.sub_id.at[
+            jnp.where(put, fsi, sb)].set(missing, mode="drop"))
+        # (re)subscribe one unconfirmed slot: to the responsible if the
+        # lobby has one, else raise a want-event so post_step assigns it
+        # (PubSubLobby handleRespCall; the requester becomes responsible)
+        need = (st.sub_id != NO_NODE) & ~st.sub_ok
+        ni = jnp.argmax(need).astype(I32)
+        has_need = en_s & jnp.any(need)
+        ns_id = st.sub_id[jnp.clip(ni, 0, sb - 1)]
+        rs = glob.resp[jnp.clip(ns_id, 0, p.nsub - 1)]
+        ob.send(has_need & (rs != NO_NODE), now_s, jnp.maximum(rs, 0),
+                PS_SUB_CALL, a=ns_id, size_b=16)
+        want_out = jnp.where(has_need & (rs == NO_NODE), ns_id, NO_NODE)
+        st = dataclasses.replace(
+            st, sub_seen=st.sub_seen.at[
+                jnp.where(has_need & (rs != NO_NODE), ni, sb)].set(
+                    now_s, mode="drop"))
+
+        # duty upkeep: if the lobby now lists me for a subspace I track,
+        # nothing to do; if I hold a duty the lobby reassigned away,
+        # drop it.  Adopt duties the lobby handed me (resp[s] == me).
+        for di in range(d_max):
+            sid = st.duty[di]
+            lost = en_s & (sid != NO_NODE) & (
+                glob.resp[jnp.clip(sid, 0, p.nsub - 1)] != node_idx)
+            st = dataclasses.replace(
+                st,
+                duty=st.duty.at[jnp.where(lost, di, d_max)].set(
+                    NO_NODE, mode="drop"),
+                child=st.child.at[jnp.where(lost, di, d_max)].set(
+                    jnp.full((ch,), NO_NODE, I32), mode="drop"),
+                mover=st.mover.at[jnp.where(lost, di, d_max)].set(
+                    jnp.full((ch,), NO_NODE, I32), mode="drop"))
+        # adopt: scan the grid row that could name me (bounded: check
+        # the AOI subspaces + current — the lobby only assigns duties
+        # for subspaces we asked about)
+        cand_ids = jnp.concatenate([aoi, cur[None]])
+        for k in range(5):
+            sid = cand_ids[k]
+            mine = is_ready & (sid >= 0) & (
+                glob.resp[jnp.clip(sid, 0, p.nsub - 1)] == node_idx)
+            known = jnp.any(st.duty == sid)
+            dfree = jnp.any(st.duty == NO_NODE)
+            ddi = jnp.argmax(st.duty == NO_NODE).astype(I32)
+            put = mine & ~known & dfree
+            st = dataclasses.replace(st, duty=st.duty.at[
+                jnp.where(put, ddi, d_max)].set(sid, mode="drop"))
+
+        # duty digest: flush each duty's mover list to the children
+        for di in range(d_max):
+            act = en_s & (st.duty[di] != NO_NODE) & ctx.measuring
+            has_mv = st.mv_n[di] > 0
+            nch = jnp.sum(st.child[di] != NO_NODE, dtype=I32)
+            ev.value("ps_children", nch.astype(F32), act)
+            for ci in range(ch):
+                cd = st.child[di, ci]
+                snd = act & has_mv & (cd != NO_NODE)
+                c_sent += snd.astype(I32)
+                ob.send(snd, now_s, jnp.maximum(cd, 0), PS_MOVELIST,
+                        a=st.duty[di], b=st.slot_no,
+                        nodes=st.mover[di], stamp=now_s,
+                        size_b=16 + 4 * ch)
+            row = jnp.where(en_s, di, d_max)
+            st = dataclasses.replace(
+                st,
+                mover=st.mover.at[row].set(
+                    jnp.full((ch,), NO_NODE, I32), mode="drop"),
+                mv_n=st.mv_n.at[row].set(0, mode="drop"))
+
+        st = dataclasses.replace(
+            st,
+            slot_no=st.slot_no + en_s.astype(I32),
+            t_slot=jnp.where(en_s, now_s + slot_ns, st.t_slot))
+
+        events = {"c:ps_joins": c_joins, "c:ps_moves": c_moves,
+                  "c:ps_lists_sent": c_sent, "c:ps_lists_recv": c_recv,
+                  "c:ps_events_ok": c_ok, "c:ps_events_late": c_late,
+                  "c:ps_lost_lists": jnp.int32(0),
+                  "c:ps_rejects": c_rej,
+                  "g:ps_want": want_out}
+        ev.finish(events, {})
+        return st, ob, events

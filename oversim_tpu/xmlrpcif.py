@@ -1,0 +1,188 @@
+"""XML-RPC front-end: drive a running simulation from real clients.
+
+Rebuild of src/tier3/xmlrpcinterface/ (XmlRpcInterface.h:102-166 — an
+XML-RPC server on the singlehost node exposing the KBR/DHT Common API
+to external tools: local_lookup, lookup, register/resolve, put/get,
+dump_dht).  The TPU equivalent serves the same surface over Python's
+stdlib XML-RPC server, executing against a live Simulation + state:
+
+  * ``local_lookup(key_hex, num)`` — closest READY nodes to the key
+    from the global node table (the reference answers from the local
+    routing table without network traffic; the engine's oracle is the
+    natural equivalent — BaseOverlay::local_lookup semantics);
+  * ``put(key_hex, value, ttl)`` / ``get(key_hex)`` — issue the real
+    tier-1 DHT RPCs (common/wire.py DHT_PUT_CALL/DHT_GET_CALL — the
+    same messages DHT.cc exchanges) from a host-injected call to each
+    replica holder, then run the simulation until the responses land;
+  * ``stats()`` — GlobalStatistics scalars (XmlRpcInterface has no
+    direct equivalent; exposed because every external driver wants it);
+  * ``advance(seconds)`` — step simulated time (the singlehost build
+    advances in realtime instead; see gateway.RealtimeGateway).
+
+Responses are observed in the message pool between ticks (the gateway
+drain pattern): DHT_PUT_RES/DHT_GET_RES addressed to the injector slot
+are collected and freed before the app layer would mis-consume them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from xmlrpc.server import SimpleXMLRPCServer
+
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu.common import wire
+from oversim_tpu.core import keys as keys_mod
+from oversim_tpu.engine import pool as pool_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NS = 1_000_000_000
+NO_NODE = -1
+
+
+class XmlRpcInterface:
+    """Method container; also usable directly (no server) in tests."""
+
+    def __init__(self, sim, state, injector_slot: int = 0):
+        self.sim = sim
+        self.state = state
+        self.slot = injector_slot
+
+    # ------------------------------------------------ helpers ----------
+    def _key(self, key_hex: str):
+        return keys_mod.from_int(int(key_hex, 16), self.sim.spec)
+
+    def _closest_ready(self, key, num: int):
+        st = self.state
+        ready = np.asarray(st.alive) & np.asarray(
+            self.sim.logic.ready_mask(st.logic))
+        kt = np.asarray(st.node_keys, dtype=np.uint64)
+        tgt = np.asarray(key, dtype=np.uint64)
+        # big-endian lane compare == ring xor-free distance on the key
+        # table; python bignum per node is fine host-side
+        lanes = kt.shape[1]
+        ints = np.zeros(kt.shape[0], object)
+        for l in range(lanes):
+            ints = ints * (1 << 32) + kt[:, l]
+        t_int = 0
+        for l in range(lanes):
+            t_int = (t_int << 32) + int(tgt[l])
+        bits = self.sim.spec.bits
+        mod = 1 << bits
+        dist = np.array([min((int(i) - t_int) % mod,
+                             (t_int - int(i)) % mod) for i in ints],
+                        object)
+        dist[~ready] = mod
+        order = np.argsort([int(d) for d in dist])
+        return [int(i) for i in order[:num] if ready[i]]
+
+    def _inject(self, dst: int, kind: int, key, a=0, b=0, stamp=0):
+        s = self.state
+        rmax = s.pool.nodes.shape[1]
+        out = dict(
+            t_deliver=jnp.asarray([s.t_now + 1], I64),
+            src=jnp.asarray([self.slot], I32),
+            dst=jnp.asarray([dst], I32),
+            kind=jnp.asarray([kind], I32),
+            key=jnp.asarray(key)[None, :],
+            nonce=jnp.zeros((1,), I32),
+            hops=jnp.zeros((1,), I32),
+            a=jnp.asarray([a], I32), b=jnp.asarray([b], I32),
+            c=jnp.zeros((1,), I32), d=jnp.zeros((1,), I32),
+            nodes=jnp.full((1, rmax), NO_NODE, I32),
+            size_b=jnp.asarray([wire.BASE_CALL_B + 28], I32),
+            stamp=jnp.asarray([stamp], I64),
+        )
+        new_pool, _ = pool_mod.alloc(s.pool, out, jnp.asarray([True]))
+        self.state = dataclasses.replace(s, pool=new_pool)
+
+    def _collect(self, kinds, nonce, max_ticks: int = 400):
+        """Step until responses with our nonce arrive (drained between
+        ticks so the injector node's app never sees them)."""
+        got = []
+        for _ in range(max_ticks):
+            self.state = self.sim.step(self.state)
+            pool = self.state.pool
+            valid = np.asarray(pool.valid)
+            kind = np.asarray(pool.kind)
+            dst = np.asarray(pool.dst)
+            b = np.asarray(pool.b)
+            hits = np.nonzero(valid & np.isin(kind, kinds) &
+                              (dst == self.slot) & (b == nonce))[0]
+            if len(hits):
+                a = np.asarray(pool.a)
+                for i in hits:
+                    got.append((int(kind[i]), int(a[i])))
+                mask = jnp.zeros(pool.valid.shape, bool).at[
+                    jnp.asarray(hits, I32)].set(True)
+                self.state = dataclasses.replace(
+                    self.state, pool=pool_mod.free(pool, mask))
+                return got
+        return got
+
+    # ------------------------------------------------ RPC surface ------
+    def stats(self):
+        out = self.sim.summary(self.state)
+        clean = {}
+        for k, v in out.items():
+            if isinstance(v, dict):
+                clean[k] = {kk: float(vv) for kk, vv in v.items()}
+            elif isinstance(v, (list, tuple)):
+                clean[k] = [float(x) for x in v]
+            else:
+                clean[k] = float(v)
+        return clean
+
+    def advance(self, seconds: float):
+        t = (int(self.state.t_now) / NS) + float(seconds)
+        self.state = self.sim.run_until(self.state, t)
+        return int(self.state.t_now)
+
+    def local_lookup(self, key_hex: str, num: int = 4):
+        """Closest READY nodes (XmlRpcInterface::localLookup)."""
+        return self._closest_ready(self._key(key_hex), num)
+
+    def put(self, key_hex: str, value: int, ttl: float = 300.0):
+        """DHT put: DHTPutCall to each replica holder; returns the
+        number of acks (XmlRpcInterface::put → DHTputCAPI)."""
+        key = self._key(key_hex)
+        nrep = getattr(getattr(self.sim.logic, "app", None), "p",
+                       None)
+        num = nrep.num_replica if nrep is not None and hasattr(
+            nrep, "num_replica") else 4
+        holders = self._closest_ready(key, num)
+        nonce = (int(self.state.t_now) // 1000) % (2**30) + 7
+        expire = int(self.state.t_now) + int(ttl * NS)
+        for h in holders:
+            self._inject(h, wire.DHT_PUT_CALL, key, a=int(value),
+                         b=nonce, stamp=expire)
+        acks = self._collect([int(wire.DHT_PUT_RES)], nonce)
+        return len(acks)
+
+    def get(self, key_hex: str):
+        """DHT get: DHTGetCall to the closest holder; returns the value
+        id or -1 (XmlRpcInterface::get → DHTgetCAPI)."""
+        key = self._key(key_hex)
+        holders = self._closest_ready(key, 1)
+        if not holders:
+            return -1
+        nonce = (int(self.state.t_now) // 1000) % (2**30) + 13
+        self._inject(holders[0], wire.DHT_GET_CALL, key, b=nonce)
+        got = self._collect([int(wire.DHT_GET_RES)], nonce)
+        return got[0][1] if got else -1
+
+
+def serve(iface: XmlRpcInterface, host: str = "127.0.0.1",
+          port: int = 0):
+    """Start the XML-RPC server on a daemon thread; returns (server,
+    port).  Mirrors XmlRpcInterface's abyss-server setup (:102)."""
+    server = SimpleXMLRPCServer((host, port), allow_none=True,
+                                logRequests=False)
+    for name in ("stats", "advance", "local_lookup", "put", "get"):
+        server.register_function(getattr(iface, name), name)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, server.server_address[1]

@@ -1,0 +1,88 @@
+"""10k-node scale smoke (VERDICT r1 next-step #10).
+
+Single-chip Kademlia (or Chord) at 10k nodes under LifetimeChurn:
+proves the static bounds (EngineParams pool/outbox/inbox, LookupConfig
+frontier/visited) hold at driver-config scale before the 100k/1M runs
+(BASELINE.md rows).  Prints a JSON line with the bound counters — all
+overflow counters must be zero, deferral counts sane.
+
+Usage:  python scripts/scale_smoke.py [--n 10000] [--overlay kademlia]
+        [--t 600] [--platform cpu|axon]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000)
+    ap.add_argument("--overlay", default="kademlia",
+                    choices=["kademlia", "chord"])
+    ap.add_argument("--t", type=float, default=600.0)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--churn", default="lifetime")
+    args = ap.parse_args()
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import sys as _sys
+    _sys.modules["zstandard"] = None
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+    from oversim_tpu.engine import sim as sim_mod
+
+    app = KbrTestApp(KbrTestParams(test_interval=60.0))
+    if args.overlay == "kademlia":
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app)
+    else:
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app)
+
+    cp = churn_mod.ChurnParams(
+        model=args.churn, target_num=args.n,
+        lifetime_mean=10_000.0, init_interval=10.0 / args.n)
+    ep = sim_mod.EngineParams(window=0.050, transition_time=120.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+
+    t0 = time.time()
+    st = s.init(seed=1)
+    st = s.run_until(st, args.t, chunk=256)
+    wall = time.time() - t0
+    out = s.summary(st)
+    eng = out["_engine"]
+
+    result = {
+        "n": args.n,
+        "overlay": args.overlay,
+        "t_sim": out["_t_sim"],
+        "wall_s": round(wall, 1),
+        "alive": out["_alive"],
+        "sent": int(out.get("kbr_sent", 0)),
+        "delivered": int(out.get("kbr_delivered", 0)),
+        "pool_overflow": eng["pool_overflow"],
+        "outbox_overflow": eng["outbox_overflow"],
+        "inbox_deferred": eng["inbox_deferred"],
+        "queue_lost": eng["queue_lost"],
+    }
+    print(json.dumps(result))
+    bad = result["pool_overflow"] or result["outbox_overflow"]
+    if bad:
+        print("FAIL: static bounds overflowed at scale", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,13 @@ importing jax (last update wins) to keep unit tests local and fast.
 """
 
 import os
+import sys
+
+# jax's persistent compile cache compresses with the zstandard C
+# extension when importable; that extension segfaulted mid-write on
+# this box (put_executable_and_time → zstandard.backend_c).  Poisoning
+# the import BEFORE jax loads makes the cache fall back to zlib.
+sys.modules["zstandard"] = None
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")

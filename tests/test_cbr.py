@@ -1,0 +1,56 @@
+"""Coordinate-based routing: coords → prefix NodeIDs round trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu.common import cbr
+from oversim_tpu.core import keys as K
+
+
+P = cbr.CbrParams(dims=2, depth=12, field_max=150.0, stop_at_digit=12)
+
+
+def test_same_area_same_prefix():
+    c = jnp.asarray([[10.0, 10.0], [10.01, 10.02],   # same tiny cell
+                     [140.0, 140.0]], jnp.float32)
+    pre = np.asarray(cbr.prefix_bits(c, P))
+    assert pre[0] == pre[1]
+    assert pre[0] != pre[2]
+
+
+def test_node_id_carries_prefix_and_randomness():
+    rng = jax.random.PRNGKey(0)
+    c = jnp.asarray([[10.0, 10.0]] * 8, jnp.float32)
+    ids = cbr.node_id(c, rng, P)
+    # same area → identical top prefix bits
+    tops = [K.to_int(k) >> (K.DEFAULT_SPEC.bits - P.depth)
+            for k in np.asarray(ids)]
+    assert len(set(tops)) == 1
+    # ...but the rest is randomized (getNodeId: non-prefix bits random)
+    fulls = [K.to_int(k) for k in np.asarray(ids)]
+    assert len(set(fulls)) == len(fulls)
+
+
+def test_key_center_round_trip():
+    rng = jax.random.PRNGKey(1)
+    coords = jax.random.uniform(rng, (64, 2), jnp.float32, 0.0, 150.0)
+    ids = cbr.node_id(coords, rng, P)
+    centers = jax.vmap(lambda k: cbr.key_to_center(k, P))(ids)
+    # each axis gets depth/d = 6 bits → cell size 150/64 ≈ 2.35; the
+    # area center is within half a cell diagonal of the true coords
+    err = np.linalg.norm(np.asarray(centers) - np.asarray(coords),
+                         axis=-1)
+    cell = 150.0 / (1 << (P.depth // 2))
+    assert (err <= cell * np.sqrt(2)).all(), err.max()
+
+
+def test_distance_key_coords_orders_by_proximity():
+    rng = jax.random.PRNGKey(2)
+    target = jnp.asarray([20.0, 20.0], jnp.float32)
+    key = cbr.node_id(target[None, :], rng, P)[0]
+    near = jnp.asarray([22.0, 21.0], jnp.float32)
+    far = jnp.asarray([120.0, 130.0], jnp.float32)
+    dn = float(cbr.distance_key_coords(key, near, P))
+    df = float(cbr.distance_key_coords(key, far, P))
+    assert dn < df

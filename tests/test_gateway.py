@@ -1,0 +1,133 @@
+"""Real-network gateway (SingleHostUnderlay equivalent) + XML-RPC.
+
+Loopback tests: a real UDP datagram / TCP frame must traverse the
+simulated gateway node (RealworldEchoApp transforms the payload word)
+and come back on the wire; the XML-RPC surface must answer
+local_lookup/put/get against a live DHT simulation."""
+
+import socket
+import struct
+import xmlrpc.client
+
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.dht import DhtApp, DhtParams
+from oversim_tpu.apps.realworld import RealworldEchoApp, TcpEchoApp
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.gateway import EXT_IN, RealtimeGateway, _HDR
+from oversim_tpu.overlay.chord import ChordLogic
+from oversim_tpu.overlay.myoverlay import MyOverlayLogic, MyOverlayParams
+from oversim_tpu.xmlrpcif import XmlRpcInterface, serve
+
+
+def _ring_sim(app, n=4, seed=9):
+    logic = MyOverlayLogic(params=MyOverlayParams(), app=app)
+    cp = churn_mod.ChurnParams(model="none", target_num=n,
+                               init_interval=0.2)
+    ep = sim_mod.EngineParams(window=0.020)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    state = s.init(seed=seed)
+    state = s.run_until(state, 10.0)
+    return s, state
+
+
+def test_udp_echo_through_sim():
+    s, state = _ring_sim(RealworldEchoApp(transform=5))
+    gw = RealtimeGateway(s, state, gw_slot=0)
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    client.settimeout(0.3)
+    try:
+        client.sendto(_HDR.pack(EXT_IN, 0, 42, 1000),
+                      ("127.0.0.1", gw.udp_port))
+        for _ in range(50):
+            gw.pump(0.2)
+            try:
+                data, _ = client.recvfrom(4096)
+                break
+            except socket.timeout:
+                continue
+        else:
+            raise AssertionError("no echo from the gateway")
+        kind, sid, b, c = _HDR.unpack_from(data)
+        assert b == 42
+        assert c == 1000 + 5, "payload must traverse the sim-side app"
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_tcp_echo_through_sim():
+    s, state = _ring_sim(TcpEchoApp(transform=7), seed=10)
+    gw = RealtimeGateway(s, state, gw_slot=0, tcp_port=0)
+    client = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    client.settimeout(0.3)
+    try:
+        client.connect(("127.0.0.1", gw.tcp_port))
+        frame = _HDR.pack(EXT_IN, 0, 7, 100)
+        client.sendall(len(frame).to_bytes(4, "big") + frame)
+        buf = b""
+        for _ in range(50):
+            gw.pump(0.2)
+            try:
+                chunk = client.recv(4096)
+            except socket.timeout:
+                continue
+            buf += chunk
+            if len(buf) >= 4:
+                ln = int.from_bytes(buf[:4], "big")
+                if len(buf) >= 4 + ln:
+                    break
+        else:
+            raise AssertionError("no TCP echo")
+        kind, sid, b, c = _HDR.unpack_from(buf[4:])
+        assert b == 7 and c == 107
+    finally:
+        client.close()
+        gw.close()
+
+
+@pytest.fixture(scope="module")
+def dht_sim():
+    # same shape as tests/test_dht.py so the compile cache is shared
+    app = DhtApp(DhtParams(test_interval=20.0, num_test_keys=16,
+                           test_ttl=600.0))
+    logic = ChordLogic(app=app)
+    cp = churn_mod.ChurnParams(model="none", target_num=8,
+                               init_interval=1.0)
+    ep = sim_mod.EngineParams(window=0.010, transition_time=20.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=31)
+    st = s.run_until(st, 60.0, chunk=512)
+    return s, st
+
+
+def test_xmlrpc_local_lookup_put_get(dht_sim):
+    s, st = dht_sim
+    iface = XmlRpcInterface(s, st, injector_slot=0)
+    key = "ab" * (s.spec.bits // 8)
+    near = iface.local_lookup(key, 3)
+    assert 1 <= len(near) <= 3
+    alive = np.asarray(st.alive)
+    assert all(alive[i] for i in near)
+    acks = iface.put(key, value=777, ttl=600.0)
+    assert acks >= 1, "no replica acked the external DHT put"
+    got = iface.get(key)
+    assert got == 777
+
+
+def test_xmlrpc_over_the_wire(dht_sim):
+    s, st = dht_sim
+    iface = XmlRpcInterface(s, st, injector_slot=0)
+    server, port = serve(iface)
+    try:
+        proxy = xmlrpc.client.ServerProxy(f"http://127.0.0.1:{port}/",
+                                          allow_none=True)
+        stats = proxy.stats()
+        assert isinstance(stats, dict) and len(stats) > 0
+        key = "cd" * (s.spec.bits // 8)
+        near = proxy.local_lookup(key, 2)
+        assert len(near) >= 1
+    finally:
+        server.shutdown()

@@ -1,0 +1,126 @@
+"""SimpleUnderlay send-queue serialization: quantify the window
+approximation (VERDICT r1 weak #7).
+
+simple.send_batch serializes a tick's messages from the FIRST message's
+queue start ("monotone approx", simple.py:181).  These tests pin the
+model: exact when the batch shares one send time (the common case — a
+node's timers fire at one instant), and bounded by the tick window when
+send times differ, since |t_send_i - t_send_0| < window by
+construction of the engine's event horizon."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu.underlay import simple as ul
+
+NS = 1_000_000_000
+
+
+def _mk_state(n=4):
+    p = ul.UnderlayParams(jitter=0.0)
+    st = ul.init(jax.random.PRNGKey(0), n, p)
+    return st, p
+
+
+def _send(st, p, src, dst, size, t_send, want):
+    rng = jax.random.PRNGKey(1)
+    alive = jnp.ones(st.coords.shape[0], bool)
+    return ul.send_batch(st, p, rng, src, dst, size, t_send, want, alive)
+
+
+def test_equal_send_times_serialize_exactly():
+    """All messages sent at the same instant: finish_j must equal
+    t0 + sum_{i<=j} bits_i/bw — the exact per-message queue model
+    (SimpleNodeEntry::calcDelay accumulation)."""
+    st, p = _mk_state()
+    m = 5
+    size = jnp.full((4, m), 1000, jnp.int32)
+    t0 = jnp.full((4, m), 7 * NS, jnp.int64)
+    src = jnp.zeros((4, m), jnp.int32) + jnp.arange(4)[:, None]
+    dst = jnp.full((4, m), 3, jnp.int32)
+    want = jnp.ones((4, m), bool).at[3].set(False)  # 3 = receiver only
+    t_del, ok, st2, drops = _send(st, p, src, dst, size, t0, want)
+    t_del = np.asarray(t_del)
+    bw = float(p.channel_table[0, 0])
+    bits = (1000 + p.header_bytes) * 8
+    ser = bits / bw * NS
+    # sender 0's messages are spaced exactly one serialization apart
+    gaps = np.diff(t_del[0])
+    assert np.allclose(gaps, ser, rtol=1e-5), gaps
+    assert int(drops["queue_lost"]) == 0
+
+
+def test_unequal_send_times_bounded_by_window():
+    """Messages with staggered send times inside one window: the
+    approximation charges them all from the first start — each deliver
+    time deviates from the exact sequential model by < the stagger."""
+    st, p = _mk_state()
+    m = 4
+    window_ns = int(0.010 * NS)
+    stagger = jnp.arange(m, dtype=jnp.int64) * (window_ns // m)
+    t0 = (jnp.full((4, m), 3 * NS, jnp.int64) + stagger[None, :])
+    size = jnp.full((4, m), 500, jnp.int32)
+    src = jnp.zeros((4, m), jnp.int32) + jnp.arange(4)[:, None]
+    dst = jnp.full((4, m), 2, jnp.int32)
+    want = jnp.ones((4, m), bool).at[2].set(False)
+    t_del, ok, st2, _ = _send(st, p, src, dst, size, t0, want)
+    # exact model: start_j = max(finish_{j-1}, t_send_j)
+    bw = float(p.channel_table[0, 0])
+    bits = (500 + p.header_bytes) * 8
+    ser = bits / bw * NS
+    t0n = np.asarray(t0[0])
+    finish_exact = []
+    cur = 0
+    for j in range(m):
+        cur = max(cur, t0n[j]) + ser
+        finish_exact.append(cur)
+    # approx finish from the batch (strip prop/access delay by diffing
+    # against the exact first message, whose start is shared)
+    t_del0 = np.asarray(t_del[0])
+    approx_rel = t_del0 - t_del0[0]
+    exact_rel = np.asarray(finish_exact) - finish_exact[0]
+    dev = np.abs(approx_rel - exact_rel)
+    assert (dev <= window_ns + 1).all(), dev
+
+
+def test_queue_overrun_drops():
+    """A burst exceeding sendQueueLength/bandwidth must be dropped and
+    counted (SimpleNodeEntry.cc:169-180 maxQueueTime)."""
+    st, p = _mk_state()
+    p = dataclasses.replace(p, send_queue_bytes=2_000)
+    m = 6
+    size = jnp.full((4, m), 1400, jnp.int32)
+    t0 = jnp.full((4, m), NS, jnp.int64)
+    src = jnp.zeros((4, m), jnp.int32) + jnp.arange(4)[:, None]
+    dst = jnp.full((4, m), 1, jnp.int32)
+    want = jnp.ones((4, m), bool).at[1].set(False)
+    t_del, ok, st2, drops = _send(st, p, src, dst, size, t0, want)
+    assert int(drops["queue_lost"]) > 0
+    okn = np.asarray(ok)
+    # the head of each burst still goes through
+    assert okn[0, 0] and okn[2, 0]
+
+
+def test_tx_queue_carries_across_batches():
+    """tx_finished must persist: a second batch right after a big burst
+    starts behind the queue (calcDelay's transmission-finished carry)."""
+    st, p = _mk_state()
+    m = 4
+    size = jnp.full((4, m), 4000, jnp.int32)
+    t0 = jnp.full((4, m), NS, jnp.int64)
+    src = jnp.zeros((4, m), jnp.int32) + jnp.arange(4)[:, None]
+    dst = jnp.full((4, m), 3, jnp.int32)
+    want = jnp.ones((4, m), bool).at[3].set(False)
+    _, _, st2, _ = _send(st, p, src, dst, size, t0, want)
+    assert (np.asarray(st2.tx_finished)[:3] > NS).all()
+    # one more message immediately after: delayed behind the queue
+    one = jnp.ones((4, 1), bool).at[3].set(False)
+    t_del2, ok2, _, _ = _send(
+        st2, p, src[:, :1], dst[:, :1],
+        jnp.full((4, 1), 100, jnp.int32),
+        jnp.full((4, 1), NS + 1, jnp.int64), one)
+    tx_fin = np.asarray(st2.tx_finished)
+    assert (np.asarray(t_del2)[0, 0] >= tx_fin[0])

@@ -1,0 +1,63 @@
+"""Engine semantics under pressure (VERDICT r1 weak #9/#10).
+
+* window sensitivity: the within-window commutation relaxation
+  (engine/sim.py docstring) must not change workload statistics — the
+  same scenario at window=20ms and window=5ms must agree on integer
+  workload counters within a tight band.
+* backpressure: with a tiny inbox the deferral path (inbox_deferred)
+  must actually engage, and the protocol must still converge — deferred
+  messages are delayed, not lost."""
+
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic, READY
+
+N = 16
+
+
+def _run(window, inbox_slots=8, t_sim=300.0, seed=4):
+    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=10.0)))
+    cp = churn_mod.ChurnParams(model="none", target_num=N,
+                               init_interval=0.3)
+    ep = sim_mod.EngineParams(window=window, inbox_slots=inbox_slots,
+                              transition_time=60.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=seed)
+    st = s.run_until(st, t_sim, chunk=256)
+    return s, st
+
+
+@pytest.mark.slow
+def test_window_insensitivity():
+    s20, st20 = _run(0.020)
+    s05, st05 = _run(0.005)
+    out20, out05 = s20.summary(st20), s05.summary(st05)
+    # both fully converge
+    assert (np.asarray(st20.logic.state) == READY).all()
+    assert (np.asarray(st05.logic.state) == READY).all()
+    # same seed, same workload volume; delivery within 2%
+    r20 = out20["kbr_delivered"] / max(out20["kbr_sent"], 1)
+    r05 = out05["kbr_delivered"] / max(out05["kbr_sent"], 1)
+    assert abs(r20 - r05) < 0.02, (r20, r05)
+    # hop-count mean stable across the relaxation
+    h20 = out20["kbr_hopcount"]["mean"]
+    h05 = out05["kbr_hopcount"]["mean"]
+    assert abs(h20 - h05) / max(h05, 1e-9) < 0.15, (h20, h05)
+
+
+@pytest.mark.slow
+def test_backpressure_defers_but_delivers():
+    s, st = _run(0.020, inbox_slots=2, t_sim=400.0, seed=6)
+    out = s.summary(st)
+    deferred = int(out["_engine"]["inbox_deferred"])
+    # stabilize+fixfingers+tests at 16 nodes through 2-slot inboxes must
+    # hit the deferral path at least sometimes
+    assert deferred > 0, "inbox_deferred never engaged at inbox_slots=2"
+    # ...and everything still converges and delivers
+    assert (np.asarray(st.logic.state) == READY).all()
+    ratio = out["kbr_delivered"] / max(out["kbr_sent"], 1)
+    assert ratio > 0.95, ratio
