@@ -1,0 +1,85 @@
+/* vecwriter — native OMNeT++ result-file writer for oversim_tpu.
+ *
+ * Native equivalent of the reference's result recording back-end: the
+ * OMNeT++ envir writes .vec (vector time series, cOutVector) and .sca
+ * (scalar) files for every run (GlobalStatistics.cc records via
+ * recordScalar/addStdDev; **.vector-recording in default.ini).  The
+ * TPU build records whole [T]-row blocks per flush instead of one
+ * value per event, so the writer's job is a tight buffered formatter:
+ * append millions of (vector, time, value) rows at memory bandwidth
+ * without Python string overhead.
+ *
+ * File formats (OMNeT++ 4.x textual result files):
+ *   .vec:  "version 2"
+ *          "run <runid>"
+ *          "vector <id> <module> <name> TV"
+ *          "<id>\t<time>\t<value>"
+ *   .sca:  "version 2" / "run <runid>" / "scalar <module> <name> <v>"
+ *
+ * API (ctypes, see oversim_tpu/recorder.py):
+ *   void *vw_open(const char *path, const char *runid);
+ *   int   vw_declare(void *h, const char *module, const char *name);
+ *   void  vw_rows(void *h, int vec_id, long n,
+ *                 const double *t, const double *v);
+ *   void  vw_scalar(void *h, const char *module, const char *name,
+ *                   double value);
+ *   void  vw_close(void *h);
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    FILE *f;
+    int next_id;
+} VW;
+
+void *vw_open(const char *path, const char *runid)
+{
+    VW *h = (VW *)malloc(sizeof(VW));
+    if (!h)
+        return NULL;
+    h->f = fopen(path, "w");
+    if (!h->f) {
+        free(h);
+        return NULL;
+    }
+    h->next_id = 0;
+    setvbuf(h->f, NULL, _IOFBF, 1 << 20);
+    fprintf(h->f, "version 2\nrun %s\n", runid);
+    return h;
+}
+
+int vw_declare(void *hp, const char *module, const char *name)
+{
+    VW *h = (VW *)hp;
+    int id = h->next_id++;
+    fprintf(h->f, "vector %d %s %s TV\n", id, module, name);
+    return id;
+}
+
+void vw_rows(void *hp, int vec_id, long n, const double *t,
+             const double *v)
+{
+    VW *h = (VW *)hp;
+    long i;
+    for (i = 0; i < n; i++)
+        fprintf(h->f, "%d\t%.9g\t%.12g\n", vec_id, t[i], v[i]);
+}
+
+void vw_scalar(void *hp, const char *module, const char *name,
+               double value)
+{
+    VW *h = (VW *)hp;
+    fprintf(h->f, "scalar %s %s %.12g\n", module, name, value);
+}
+
+void vw_close(void *hp)
+{
+    VW *h = (VW *)hp;
+    if (!h)
+        return;
+    fclose(h->f);
+    free(h);
+}

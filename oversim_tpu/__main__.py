@@ -61,6 +61,14 @@ def main(argv=None) -> int:
                          "simulations/dht.trace)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON object per run instead of scalars")
+    ap.add_argument("--output-vectors", default=None, metavar="FILE.vec",
+                    help="record counter time series into an "
+                         "OMNeT++-format .vec file (vector-recording)")
+    ap.add_argument("--output-scalars", default=None, metavar="FILE.sca",
+                    help="write finish()-time scalars into an "
+                         "OMNeT++-format .sca file")
+    ap.add_argument("--vector-interval", type=float, default=10.0,
+                    help="sampling period for --output-vectors (sim s)")
     args = ap.parse_args(argv)
 
     from oversim_tpu.config.ini import IniFile
@@ -90,8 +98,20 @@ def main(argv=None) -> int:
             meas = sim.ep.measurement_time
             horizon = (sim.cp.init_finished_time + sim.ep.transition_time
                        + (meas if meas and meas > 0 else 600.0))
-        state = sim.run_until(state, horizon)
+        if args.output_vectors:
+            from oversim_tpu.recorder import VectorRecorder
+            rec = VectorRecorder(sim, args.output_vectors,
+                                 run_id=f"{config}-{label}")
+            state = rec.run(state, horizon,
+                            sample_every=args.vector_interval)
+            rec.close()
+        else:
+            state = sim.run_until(state, horizon)
         out = sim.summary(state)
+        if args.output_scalars:
+            from oversim_tpu.recorder import write_scalars
+            write_scalars(sim, state, args.output_scalars,
+                          run_id=f"{config}-{label}")
         if args.json:
             print(json.dumps({"run": label, **out}))
         else:

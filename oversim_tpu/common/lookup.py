@@ -368,11 +368,16 @@ def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
 
 def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
          cfg: LookupConfig, *, num_siblings: int = 1,
-         num_redundant: int = 1):
+         num_redundant: int = 1, timeout_fn=None):
     """Fire FindNodeCalls for every active slot with free RPC capacity
     (up to R in flight); re-send timed-out RPCs with retries left;
     exhausted slots complete (as failed, or — exhaustive mode — with
     the accumulated sibling set).
+
+    ``timeout_fn([L] dsts) -> [L] i64 ns``: optional per-destination
+    RPC timeout (NeighborCache adaptive timeouts, getNodeTimeout /
+    NeighborCache.cc:802 — the overlay passes its RTT-cache estimate);
+    default is the static cfg.rpc_timeout_ns.
 
     Mirrors IterativePathLookup::sendRpc: pick the first unvisited,
     not-failed frontier entries; if none and nothing pending, the path
@@ -427,7 +432,9 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         vis_n = vis_n + fire.astype(I32)
         fr_flags = fr_flags.at[rows, first].set(F_PENDING, mode="drop")
         pending_dst = pending_dst.at[rows, col].set(cand, mode="drop")
-        t_to = t_to.at[rows, col].set(now + cfg.rpc_timeout_ns, mode="drop")
+        to_ns = (cfg.rpc_timeout_ns if timeout_fn is None
+                 else timeout_fn(cand))
+        t_to = t_to.at[rows, col].set(now + to_ns, mode="drop")
         retry = retry.at[rows, col].set(0, mode="drop")
         fired_any = fired_any | fire
 

@@ -49,6 +49,7 @@ from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
 from oversim_tpu.common import malicious as mal_mod
 from oversim_tpu.common import ncs as ncs_mod
+from oversim_tpu.common import neighborcache as nc_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -103,6 +104,7 @@ class ChordState:
     lk: lk_mod.LookupState     # [N, L, ...]
     cp_sent: jnp.ndarray       # [N] i64 — predecessor-ping send time (RTT)
     ncs: ncs_mod.NcsState      # [N, ...] Vivaldi coordinates (common/ncs.py)
+    nc: nc_mod.NcState         # [N, C] RTT cache (adaptive RPC timeouts)
     app: object                # [N, ...] tier-app state (apps/base.py)
     app_glob: object           # simulation-global app state (oracle maps)
 
@@ -126,13 +128,15 @@ class ChordLogic:
                  lcfg: lk_mod.LookupConfig = lk_mod.LookupConfig(),
                  app=None,
                  mparams: mal_mod.MaliciousParams = mal_mod.MaliciousParams(),
-                 ncs_params: ncs_mod.NcsParams = ncs_mod.NcsParams()):
+                 ncs_params: ncs_mod.NcsParams = ncs_mod.NcsParams(),
+                 nc_params: nc_mod.NcParams = nc_mod.NcParams()):
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg
         self.app = app or KbrTestApp()
         self.mp = mparams
         self.ncs = ncs_params
+        self.ncp = nc_params
         if spec.lanes < ncs_params.dims + 1:
             raise ValueError("key lanes too narrow for the NCS piggyback")
         self._pow2 = K.pow2_table(spec)          # [B, KL] finger offsets
@@ -179,6 +183,7 @@ class ChordLogic:
                 jnp.arange(n)),
             cp_sent=jnp.zeros((n,), I64),
             ncs=ncs_mod.init(rng, n, self.ncs),
+            nc=nc_mod.init(n, self.ncp),
             app=self.app.init(n),
             app_glob=self.app.glob_init(rng),
         )
@@ -579,8 +584,13 @@ class ChordLogic:
                                           spec.lanes),
                     size_b=wire.BASE_CALL_B + 4 * (self.ncs.dims + 1))
             en = v & (m.kind == wire.PING_RES) & (m.src == st.cp_dst)
+            rtt_s = (now - st.cp_sent).astype(jnp.float32) / NS
+            nc_row = dict(peer=st.nc.peer, rtt_mean=st.nc.rtt_mean,
+                          rtt_var=st.nc.rtt_var, last=st.nc.last,
+                          live=st.nc.live)
+            nc_row = nc_mod.insert_rtt(nc_row, m.src, rtt_s, now, en)
+            st = dataclasses.replace(st, nc=nc_mod.NcState(**nc_row))
             if self.ncs.ncs_type in ("vivaldi", "svivaldi"):
-                rtt_s = (now - st.cp_sent).astype(jnp.float32) / NS
                 xj, ej = ncs_mod.unpack_wire(m.key, self.ncs.dims)
                 me_ncs = dict(coords=st.ncs.coords, height=st.ncs.height,
                               error=st.ncs.error, loss=st.ncs.loss)
@@ -790,7 +800,20 @@ class ChordLogic:
             st.lk, start_fix, slot, P_FINGER, fi, target, seed, t0, lcfg))
 
         # ------------------------------------------------------- pump ------
-        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[4], lcfg)
+        # adaptive per-destination RPC timeouts from the RTT cache
+        # (NeighborCache::getNodeTimeout, NeighborCache.cc:802)
+        def _adaptive_to(cands, _st=st):
+            row = dict(peer=_st.nc.peer, rtt_mean=_st.nc.rtt_mean,
+                       rtt_var=_st.nc.rtt_var, last=_st.nc.last,
+                       live=_st.nc.live)
+            t_s = jax.vmap(lambda c: nc_mod.node_timeout(
+                row, c, lcfg.rpc_timeout_ns / NS))(cands)
+            return jnp.clip((t_s * NS).astype(I64),
+                            jnp.int64(int(0.2 * NS)),
+                            jnp.int64(lcfg.rpc_timeout_ns))
+
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[4],
+                                lcfg, timeout_fn=_adaptive_to)
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----
