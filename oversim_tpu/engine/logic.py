@@ -154,64 +154,119 @@ class LogicBase:
 class Outbox:
     """Append-only per-node message emitter used inside vmapped handlers.
 
-    Every ``send`` writes at the current cursor and advances it only when
-    ``en`` is true, so disabled sends cost nothing and are overwritten by
-    the next enabled one.  Slots beyond capacity are dropped (the engine
-    counts the overflow).  The reference equivalent is the unbounded
-    sendMessageToUDP path (BaseOverlay.cc:1147).
+    Every ``send`` records the message lazily; ``finish`` materializes
+    the whole batch with ONE stack + ONE compacting gather per field.
+    A naive implementation scatters ~14 fields per send — with tens of
+    send sites unrolled in a handler chain that dominates the tick
+    graph's op count (the engine is op-issue-bound, not FLOP-bound).
+    Deferring to finish() collapses S sends × 14 scatters into 14
+    stack+gather pairs.
+
+    ``en`` picks whether a send occupies a slot; disabled sends cost a
+    lane in the stacked batch but no slot.  Slots beyond capacity are
+    dropped (the engine counts the overflow).  The reference equivalent
+    is the unbounded sendMessageToUDP path (BaseOverlay.cc:1147).
     """
 
     def __init__(self, m: int, key_lanes: int, rmax: int):
         self.m = m
-        self.cursor = jnp.int32(0)
-        self.t_send = jnp.zeros((m,), I64)
-        self.dst = jnp.zeros((m,), I32)
-        self.kind = jnp.zeros((m,), I32)
-        self.key = jnp.zeros((m, key_lanes), U32)
-        self.nonce = jnp.zeros((m,), I32)
-        self.hops = jnp.zeros((m,), I32)
-        self.a = jnp.zeros((m,), I32)
-        self.b = jnp.zeros((m,), I32)
-        self.c = jnp.zeros((m,), I32)
-        self.d = jnp.zeros((m,), I32)
-        self.nodes = jnp.full((m, rmax), NO_NODE, I32)
-        self.size_b = jnp.zeros((m,), I32)
-        self.stamp = jnp.zeros((m,), I64)
+        self.key_lanes = key_lanes
+        self.rmax = rmax
+        self._en = []
+        self._rows = []   # list of per-send field dicts (scalar leaves)
 
     def send(self, en, t_send, dst, kind, *, key=None, nonce=0, hops=0,
              a=0, b=0, c=0, d=0, nodes=None, size_b=40, stamp=0):
-        cur = jnp.where(en, self.cursor, jnp.int32(self.m))  # OOB -> dropped
-        self.t_send = self.t_send.at[cur].set(t_send, mode="drop")
-        self.dst = self.dst.at[cur].set(jnp.asarray(dst, I32), mode="drop")
-        self.kind = self.kind.at[cur].set(jnp.asarray(kind, I32), mode="drop")
-        if key is not None:
-            self.key = self.key.at[cur].set(key, mode="drop")
-        self.nonce = self.nonce.at[cur].set(jnp.asarray(nonce, I32), mode="drop")
-        self.hops = self.hops.at[cur].set(jnp.asarray(hops, I32), mode="drop")
-        self.a = self.a.at[cur].set(jnp.asarray(a, I32), mode="drop")
-        self.b = self.b.at[cur].set(jnp.asarray(b, I32), mode="drop")
-        self.c = self.c.at[cur].set(jnp.asarray(c, I32), mode="drop")
-        self.d = self.d.at[cur].set(jnp.asarray(d, I32), mode="drop")
-        if nodes is not None:
-            pad = self.nodes.shape[1] - nodes.shape[0]
-            if pad < 0:
-                raise ValueError("node-list payload exceeds RMAX")
-            if pad:
-                nodes = jnp.concatenate([nodes, jnp.full((pad,), NO_NODE, I32)])
-            self.nodes = self.nodes.at[cur].set(nodes, mode="drop")
-        self.size_b = self.size_b.at[cur].set(jnp.asarray(size_b, I32),
-                                              mode="drop")
-        self.stamp = self.stamp.at[cur].set(jnp.asarray(stamp, I64), mode="drop")
-        self.cursor = self.cursor + en.astype(I32)
+        if nodes is not None and nodes.shape[0] > self.rmax:
+            raise ValueError("node-list payload exceeds RMAX")
+        self._en.append(jnp.asarray(en))
+        self._rows.append(dict(
+            t_send=jnp.asarray(t_send, I64),
+            dst=jnp.asarray(dst, I32),
+            kind=jnp.asarray(kind, I32),
+            key=key, nonce=jnp.asarray(nonce, I32),
+            hops=jnp.asarray(hops, I32),
+            a=jnp.asarray(a, I32), b=jnp.asarray(b, I32),
+            c=jnp.asarray(c, I32), d=jnp.asarray(d, I32),
+            nodes=nodes, size_b=jnp.asarray(size_b, I32),
+            stamp=jnp.asarray(stamp, I64)))
+
+    @property
+    def cursor(self):
+        """Number of enabled sends so far (inspection/debug only)."""
+        if not self._en:
+            return jnp.int32(0)
+        return jnp.sum(jnp.stack(self._en).astype(I32))
 
     def finish(self):
         """Returns (fields dict, valid [M], overflow count)."""
-        valid = jnp.arange(self.m, dtype=I32) < self.cursor
-        fields = dict(t_send=self.t_send, dst=self.dst, kind=self.kind,
-                      key=self.key, nonce=self.nonce, hops=self.hops,
-                      a=self.a, b=self.b, c=self.c, d=self.d,
-                      nodes=self.nodes, size_b=self.size_b, stamp=self.stamp)
-        return fields, valid, jnp.maximum(self.cursor - self.m, 0)
+        s = len(self._en)
+        m = self.m
+        zero_key = jnp.zeros((self.key_lanes,), U32)
+        no_nodes = jnp.full((self.rmax,), NO_NODE, I32)
+        if s == 0:
+            fields = dict(
+                t_send=jnp.zeros((m,), I64), dst=jnp.zeros((m,), I32),
+                kind=jnp.zeros((m,), I32),
+                key=jnp.zeros((m, self.key_lanes), U32),
+                nonce=jnp.zeros((m,), I32), hops=jnp.zeros((m,), I32),
+                a=jnp.zeros((m,), I32), b=jnp.zeros((m,), I32),
+                c=jnp.zeros((m,), I32), d=jnp.zeros((m,), I32),
+                nodes=jnp.full((m, self.rmax), NO_NODE, I32),
+                size_b=jnp.zeros((m,), I32), stamp=jnp.zeros((m,), I64))
+            return fields, jnp.zeros((m,), bool), jnp.int32(0)
+
+        en = jnp.stack([e.astype(I32) for e in self._en])        # [S]
+        # slot of send j = number of enabled sends before it
+        slots = jnp.cumsum(en) - en                              # [S]
+        # compaction: out[i] = the send occupying slot i.  gather form
+        # (argsort of disabled-last order) keeps everything one fused
+        # sort instead of S scatters
+        order_key = jnp.where(en > 0, slots, s)                  # [S]
+        src = jnp.argsort(order_key)[:m] if s > m else \
+            jnp.argsort(order_key)
+        n_sent = jnp.sum(en)
+
+        def pick(name, fill, width=None):
+            rows = []
+            for r in self._rows:
+                v = r[name]
+                if name == "key":
+                    v = zero_key if v is None else v
+                elif name == "nodes":
+                    if v is None:
+                        v = no_nodes
+                    elif v.shape[0] < self.rmax:
+                        v = jnp.concatenate([
+                            v, jnp.full((self.rmax - v.shape[0],),
+                                        NO_NODE, I32)])
+                rows.append(v)
+            stacked = jnp.stack(rows)                            # [S, ...]
+            out = stacked[src]                                   # [S'≤M]
+            pad = m - out.shape[0]
+            if pad > 0:
+                fill_row = jnp.broadcast_to(
+                    fill, out.shape[1:]) if out.ndim > 1 else fill
+                out = jnp.concatenate([
+                    out, jnp.broadcast_to(
+                        fill_row, (pad,) + out.shape[1:])])
+            return out
+
+        fields = dict(
+            t_send=pick("t_send", jnp.int64(0)),
+            dst=pick("dst", jnp.int32(0)),
+            kind=pick("kind", jnp.int32(0)),
+            key=pick("key", jnp.uint32(0)),
+            nonce=pick("nonce", jnp.int32(0)),
+            hops=pick("hops", jnp.int32(0)),
+            a=pick("a", jnp.int32(0)), b=pick("b", jnp.int32(0)),
+            c=pick("c", jnp.int32(0)), d=pick("d", jnp.int32(0)),
+            nodes=pick("nodes", NO_NODE),
+            size_b=pick("size_b", jnp.int32(0)),
+            stamp=pick("stamp", jnp.int64(0)))
+        valid = jnp.arange(m, dtype=I32) < n_sent
+        overflow = jnp.maximum(n_sent - m, 0)
+        return fields, valid, overflow
 
 
 def select_tree(pred, a, b):
