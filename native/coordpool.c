@@ -1,0 +1,103 @@
+/* coordpool — native node-coordinate XML pool loader for oversim_tpu.
+ *
+ * Native equivalent of the reference's coordinate-pool parsing: the
+ * SimpleUnderlay draws node positions from PlanetLab-derived XML files
+ * (SimpleUnderlayNetwork.underlayConfigurator.nodeCoordinateSource,
+ * simulations/default.ini:555 — nodes_2d_15000.xml ships 15k entries,
+ * the >200k-node files "need more memory"), parsed by OMNeT++'s XML
+ * infrastructure in SimpleUnderlayConfigurator::initialize.
+ *
+ * Format (simulations/nodes_2d_15000.xml):
+ *   <nodelist dimensions="D" rootnodes="R">
+ *     <node isroot="0|1"> <coord> x </coord> <coord> y </coord> </node>
+ *
+ * One mmap + single pass: every "<coord>" float lands in a growing
+ * double array; dims comes from the header attribute.  200k-node files
+ * parse in tens of milliseconds instead of Python-XML seconds.
+ *
+ * API (ctypes, oversim_tpu/native.py):
+ *   void  *cp_load(const char *path);     NULL on error
+ *   long   cp_n(void *h);                 number of coordinate values
+ *   int    cp_dims(void *h);
+ *   double*cp_data(void *h);              [n] doubles (node-major)
+ *   void   cp_free(void *h);
+ */
+
+#define _GNU_SOURCE   /* memmem */
+#include <fcntl.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+typedef struct {
+    double *data;
+    long n;
+    int dims;
+} CP;
+
+void *cp_load(const char *path)
+{
+    int fd = open(path, O_RDONLY);
+    if (fd < 0)
+        return NULL;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size == 0) {
+        close(fd);
+        return NULL;
+    }
+    char *buf = (char *)mmap(NULL, st.st_size, PROT_READ, MAP_PRIVATE,
+                             fd, 0);
+    close(fd);
+    if (buf == MAP_FAILED)
+        return NULL;
+
+    CP *h = (CP *)malloc(sizeof(CP));
+    long cap = 1 << 16;
+    h->data = (double *)malloc(cap * sizeof(double));
+    h->n = 0;
+    h->dims = 2;
+
+    const char *p = buf;
+    const char *end = buf + st.st_size;
+
+    const char *dm = memmem(buf, st.st_size, "dimensions=\"", 12);
+    if (dm && dm + 12 < end)
+        h->dims = atoi(dm + 12);
+
+    while ((p = memmem(p, end - p, "<coord>", 7)) != NULL) {
+        p += 7;
+        char *q;
+        double v = strtod(p, &q);
+        if (q != p) {
+            if (h->n == cap) {
+                cap *= 2;
+                h->data = (double *)realloc(h->data,
+                                            cap * sizeof(double));
+            }
+            h->data[h->n++] = v;
+            p = q;
+        }
+    }
+    munmap(buf, st.st_size);
+    if (h->dims <= 0)
+        h->dims = 2;
+    /* truncate to a whole number of nodes */
+    h->n -= h->n % h->dims;
+    return h;
+}
+
+long cp_n(void *hp) { return ((CP *)hp)->n; }
+int cp_dims(void *hp) { return ((CP *)hp)->dims; }
+double *cp_data(void *hp) { return ((CP *)hp)->data; }
+
+void cp_free(void *hp)
+{
+    CP *h = (CP *)hp;
+    if (!h)
+        return;
+    free(h->data);
+    free(h);
+}

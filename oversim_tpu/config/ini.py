@@ -125,6 +125,7 @@ class IniFile:
         self.sections: dict[str, list[tuple[str, object]]] = {"General": []}
         self.extends: dict[str, str | None] = {"General": None}
         self._regex_cache: dict[str, re.Pattern] = {}
+        self.base_dir = Path(".")   # for ini-relative resources (xml pools)
 
     # -- loading ------------------------------------------------------------
 
@@ -141,6 +142,7 @@ class IniFile:
         return ini
 
     def _load_file(self, path: Path):
+        self.base_dir = Path(path).parent
         self._parse(path.read_text(), path.parent)
 
     @staticmethod

@@ -80,7 +80,14 @@ def build_underlay(ini: IniFile, config: str):
                 ini.get("**.sendQueueLength", config), 1_000_000)),
         )
         return params, inet_mod
+    coord_src = str(_value(
+        ini.get("**.nodeCoordinateSource", config), "")).strip('"')
+    if coord_src:
+        import os as _os
+        if not _os.path.isabs(coord_src):
+            coord_src = str(ini.base_dir / coord_src)
     params = underlay_mod.UnderlayParams(
+        coord_source=coord_src,
         field_size=float(_value(ini.get("**.fieldSize", config), 150.0)),
         send_queue_bytes=int(_value(
             ini.get("**.sendQueueLength", config), 1_000_000)),

@@ -108,3 +108,77 @@ def scan_trace(path):
         return out
     finally:
         lib.ts_free(handle)
+
+
+# ---------------------------------------------------------------------------
+# coordpool (native/coordpool.c): node-coordinate XML pools
+# (SimpleUnderlay nodeCoordinateSource, default.ini:555)
+# ---------------------------------------------------------------------------
+_CP_SRC = _ROOT / "native" / "coordpool.c"
+_CP_SO = _ROOT / "native" / "coordpool.so"
+_cp_lib = None
+_cp_failed = False
+
+
+def _cp_load_lib():
+    global _cp_lib, _cp_failed
+    with _lock:
+        if _cp_lib is not None or _cp_failed:
+            return _cp_lib
+        ok = False
+        if _CP_SO.exists() and _CP_SO.stat().st_mtime >= _CP_SRC.stat().st_mtime:
+            ok = True
+        else:
+            for cc in ("cc", "gcc", "clang"):
+                try:
+                    r = subprocess.run(
+                        [cc, "-O2", "-shared", "-fPIC", str(_CP_SRC),
+                         "-o", str(_CP_SO)],
+                        capture_output=True, timeout=120)
+                    if r.returncode == 0:
+                        ok = True
+                        break
+                except (OSError, subprocess.TimeoutExpired):
+                    continue
+        if not ok:
+            _cp_failed = True
+            return None
+        lib = ctypes.CDLL(str(_CP_SO))
+        lib.cp_load.restype = ctypes.c_void_p
+        lib.cp_load.argtypes = [ctypes.c_char_p]
+        lib.cp_n.restype = ctypes.c_long
+        lib.cp_n.argtypes = [ctypes.c_void_p]
+        lib.cp_dims.restype = ctypes.c_int
+        lib.cp_dims.argtypes = [ctypes.c_void_p]
+        lib.cp_data.restype = ctypes.POINTER(ctypes.c_double)
+        lib.cp_data.argtypes = [ctypes.c_void_p]
+        lib.cp_free.restype = None
+        lib.cp_free.argtypes = [ctypes.c_void_p]
+        _cp_lib = lib
+        return lib
+
+
+def load_coord_pool(path):
+    """[P, D] float numpy array from a nodes_*.xml pool; falls back to a
+    pure-Python regex parse when no toolchain is available."""
+    import numpy as np
+    lib = _cp_load_lib()
+    if lib is not None:
+        h = lib.cp_load(str(path).encode())
+        if h:
+            try:
+                n = lib.cp_n(h)
+                d = lib.cp_dims(h)
+                flat = np.ctypeslib.as_array(lib.cp_data(h),
+                                             shape=(n,)).copy()
+                return flat.reshape(-1, d)
+            finally:
+                lib.cp_free(h)
+    # fallback: python scan
+    import re
+    text = open(path).read()
+    m = re.search(r'dimensions="(\d+)"', text)
+    d = int(m.group(1)) if m else 2
+    vals = [float(x) for x in re.findall(r"<coord>\s*([-\d.eE+]+)", text)]
+    vals = vals[:len(vals) - len(vals) % d]
+    return np.asarray(vals, float).reshape(-1, d)

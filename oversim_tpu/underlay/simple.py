@@ -51,6 +51,10 @@ class UnderlayParams:
 
     dims: int = 2
     field_size: float = 150.0          # default.ini:552
+    # node-coordinate XML pool (nodeCoordinateSource, default.ini:555:
+    # PlanetLab-derived positions instead of uniform draws; parsed by
+    # native/coordpool.c).  Empty = uniform random in the field.
+    coord_source: str = ""
     coord_delay_per_unit: float = 0.001  # s per coord unit, SimpleNodeEntry.cc:186
     use_coordinate_based_delay: bool = True  # default.ini:547
     constant_delay: float = 0.050      # fallback, default.ini:545
@@ -114,13 +118,35 @@ class UnderlayState:
     node_type: jnp.ndarray    # [N] i32 — churn-generator/partition type
 
 
+_POOL_CACHE: dict = {}
+
+
+def _coord_pool(p: UnderlayParams):
+    """[P, D] device constant from the XML pool (trace-time cached)."""
+    if p.coord_source not in _POOL_CACHE:
+        from oversim_tpu import native as native_mod
+        arr = native_mod.load_coord_pool(p.coord_source)
+        _POOL_CACHE[p.coord_source] = jnp.asarray(
+            arr[:, :p.dims], dtype=F32)
+    return _POOL_CACHE[p.coord_source]
+
+
+def _draw_coords(rng, n: int, p: UnderlayParams):
+    if p.coord_source:
+        pool = _coord_pool(p)
+        idx = jax.random.randint(rng, (n,), 0, pool.shape[0])
+        return pool[idx]
+    return jax.random.uniform(
+        rng, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
+
+
 def init(rng: jax.Array, n: int, p: UnderlayParams) -> UnderlayState:
-    """Random coordinates in the field, random channel type per node
-    (reference: SimpleUnderlayConfigurator.cc:143-184 draws coords from the
-    pool and the channel type uniformly from churnGenerator channelTypes)."""
+    """Coordinates from the XML pool (or uniform in the field), random
+    channel type per node (reference: SimpleUnderlayConfigurator.cc:143-184
+    draws coords from the pool and the channel type uniformly from
+    churnGenerator channelTypes)."""
     ck, xk = jax.random.split(rng)
-    coords = jax.random.uniform(
-        xk, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
+    coords = _draw_coords(xk, n, p)
     channel = jax.random.randint(ck, (n,), 0, len(p.channel_types), dtype=jnp.int32)
     return UnderlayState(coords=coords, channel=channel,
                          tx_finished=jnp.zeros((n,), dtype=I64),
@@ -131,8 +157,7 @@ def migrate(state: UnderlayState, mask, rng, p: UnderlayParams) -> UnderlayState
     """Redraw coordinates for masked nodes (node create / IP migration;
     reference SimpleUnderlayConfigurator::migrateNode)."""
     n = state.coords.shape[0]
-    new_coords = jax.random.uniform(
-        rng, (n, p.dims), dtype=F32, minval=0.0, maxval=p.field_size)
+    new_coords = _draw_coords(rng, n, p)
     coords = jnp.where(mask[:, None], new_coords, state.coords)
     tx_finished = jnp.where(mask, jnp.int64(0), state.tx_finished)
     return dataclasses.replace(state, coords=coords,
