@@ -261,7 +261,15 @@ class Simulation:
             jnp.sum(s.pool.valid & (s.pool.t_deliver < t_end)) -
             jnp.sum(delivered | to_dead)).astype(jnp.int64)
 
-        return SimState(t_now=t_next, tick=s.tick + 1, rng=rng, alive=alive,
+        # advance to the window END: anything generated during this tick
+        # with a due time inside the window is delivered next tick with
+        # its original timestamp (build_inbox consumes `t_deliver <
+        # t_end` regardless of the past), so no event is lost and no
+        # latency is distorted — but the engine is guaranteed ≥ one full
+        # window of progress per tick.  Returning t_next instead lets
+        # sub-window message delays drag the horizon back and collapses
+        # the batching (observed: 6-7x more ticks than windows).
+        return SimState(t_now=t_end, tick=s.tick + 1, rng=rng, alive=alive,
                         node_keys=node_keys, underlay=ul_state, pool=new_pool,
                         churn=churn_state, malicious=s.malicious,
                         logic=logic_state, stats=new_stats,
