@@ -69,6 +69,12 @@ import sys
 sys.modules["zstandard"] = None  # zlib cache compression (zstd C ext segfaults here)
 import jax  # noqa: E402
 
+from jax._src import compilation_cache as _cc  # zstd segfaults; zlib
+if getattr(_cc, "zstandard", None) is not None:
+    _cc.zstandard = None
+if getattr(_cc, "zstd", None) is not None:
+    _cc.zstd = None
+
 jax.config.update("jax_enable_x64", True)
 # sim-step graphs compile slowly; cache persistently across invocations
 jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
@@ -103,7 +109,7 @@ def run_bench():
     # ticks) — Kademlia, the reference's scale protocol (BASELINE.md
     # 1M-node rows), converges orders faster than a Chord ring at this
     # population.
-    n = int(os.environ.get("OVERSIM_BENCH_N", 8192))
+    n = int(os.environ.get("OVERSIM_BENCH_N", 2048))
     sim_seconds = float(os.environ.get("OVERSIM_BENCH_SIMTIME", 30.0))
     interval = float(os.environ.get("OVERSIM_BENCH_INTERVAL", 0.2))
     window = float(os.environ.get("OVERSIM_BENCH_WINDOW", 0.05))
@@ -118,11 +124,19 @@ def run_bench():
                                init_interval=20.0 / n,
                                init_deviation=2.0 / n)
     app = KbrTestApp(kbrtest.KbrTestParams(test_interval=interval))
+    # lookup concurrency: at `interval` issue rate with ~0.5-1 s lookup
+    # durations, steady-state in-flight lookups per node ≈ duration /
+    # interval — slots below that turn sends into instant failures
+    from oversim_tpu.common import lookup as lk_mod
+    slots = int(os.environ.get("OVERSIM_BENCH_SLOTS", 8))
     if overlay == "chord":
-        logic = ChordLogic(app=app)
+        logic = ChordLogic(app=app,
+                           lcfg=lk_mod.LookupConfig(slots=slots))
     else:
         from oversim_tpu.overlay.kademlia import KademliaLogic
-        logic = KademliaLogic(app=app)
+        logic = KademliaLogic(app=app,
+                              lcfg=lk_mod.LookupConfig(slots=slots,
+                                                       merge=True))
     ep = sim_mod.EngineParams(window=window, inbox_slots=4,
                               pool_factor=4)
     sim = sim_mod.Simulation(logic, cp, engine_params=ep)

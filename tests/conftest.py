@@ -26,6 +26,17 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402  (import after env setup)
 
+# the sitecustomize tunnel hook imports jax BEFORE this file runs, so
+# jax._src.compilation_cache may already hold a live reference to the
+# zstandard C extension (sys.modules poisoning alone is too late) —
+# null the module attribute so compress/decompress use zlib
+from jax._src import compilation_cache as _cc  # noqa: E402
+
+if getattr(_cc, "zstandard", None) is not None:
+    _cc.zstandard = None
+if getattr(_cc, "zstd", None) is not None:
+    _cc.zstd = None
+
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # persistent XLA compile cache: the sim-step graphs are large (minutes of
