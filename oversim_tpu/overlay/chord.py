@@ -417,9 +417,26 @@ class ChordLogic:
                 st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
 
             # JoinCall (rpcJoin, Chord.cc:917) — response compiled BEFORE
-            # the aggressive-join mutations (reference order)
+            # the aggressive-join mutations (reference order).
+            #
+            # RESPONSIBILITY GUARD: the reference's JoinCall is ROUTED to
+            # the joiner's key, so the receiver is the responsible node
+            # by construction; our joiner sends directly to its lookup
+            # result, which can be stale during mass joins.  Accepting a
+            # joiner whose key is NOT in (pred, me] would drag pred
+            # backwards, widen this node's claimed range, attract more
+            # mis-routed joins, and cascade into a loopy succ
+            # permutation that weak stabilization provably cannot repair
+            # (observed: N=64 interleaved-ring fixed point).  A
+            # non-responsible receiver stays silent; the joiner's join
+            # timer retries with a fresh lookup.
             en = v & (m.kind == wire.CHORD_JOIN_CALL) & (st.state == READY)
             alone = (st.pred == NO_NODE) & (st.succ[0] == NO_NODE)
+            jk = ctx.keys[jnp.maximum(m.src, 0)]
+            pk_j = ctx.keys[jnp.maximum(st.pred, 0)]
+            responsible = alone | (st.pred == NO_NODE) | K.is_between(
+                jk, pk_j, me_key, spec)
+            en = en & responsible
             pred_hint = jnp.where(alone, node_idx, st.pred)
             ob.send(en, now, m.src, wire.CHORD_JOIN_RES, a=pred_hint,
                     nodes=pad_nodes(st.succ),

@@ -492,10 +492,17 @@ class NiceLogic:
         nl = jnp.maximum(st.jn_layer - 1, st.jn_target)
         ob.send(go_down, now_j, jnp.maximum(best_node, 0), NICE_QUERY,
                 a=nl, size_b=16)
+        # a deadline expiring in QUERY or JOIN means the counterpart
+        # never answered (dead leader, rejected join) — fall back to
+        # IDLE so the restart below re-enters through the RP this same
+        # tick (the reference's query timeout, handleTimerEvent
+        # queryTimer → BasicJoinLayer retry)
+        stuck = due & ~alone & ((st.jn_stage == J_QUERY) |
+                                (st.jn_stage == J_JOIN))
         st = dataclasses.replace(
             st,
             jn_stage=jnp.where(go_down, J_QUERY,
-                               jnp.where(eval_p & ~got, J_IDLE,
+                               jnp.where((eval_p & ~got) | stuck, J_IDLE,
                                          st.jn_stage)),
             jn_deadline=jnp.where(
                 due & ~alone,

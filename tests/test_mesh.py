@@ -18,14 +18,15 @@ from oversim_tpu.overlay.chord import ChordLogic
 from oversim_tpu.parallel import mesh as mesh_mod
 
 N = 32
-TICKS = 600
+TICKS = 1500   # ~35-60 sim-s at 20 ms windows — past the
+              # 10 s transition with a measured tail
 
 
 def _make_sim():
-    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=10.0)))
+    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=5.0)))
     cp = churn_mod.ChurnParams(model="none", target_num=N,
                                init_interval=0.2)
-    ep = sim_mod.EngineParams(window=0.020, transition_time=40.0)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=10.0)
     return sim_mod.Simulation(logic, cp, engine_params=ep)
 
 
@@ -60,7 +61,7 @@ def test_sharded_run_matches_unsharded(pair):
     assert plain["_ticks"] == sharded["_ticks"] == TICKS
     assert plain["_alive"] == sharded["_alive"] == N
     # the workload actually ran
-    assert plain["kbr_sent"] > 200
+    assert plain["kbr_sent"] > 100
     # integer counters: identical math ⇒ identical results
     for key in ("kbr_sent", "kbr_delivered", "kbr_wrong_node",
                 "chord_joins"):
