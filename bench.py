@@ -8,19 +8,24 @@ events/core-s, one handleMessage per event); here every tick advances
 all N nodes at once on the accelerator, so throughput scales with the
 node batch (lookups-per-tick), not with the event count.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Robustness layout (rounds 1-2 recorded nothing: rc=1, then rc=124):
 
-Backend selection: the ambient sitecustomize hook force-selects the
-TPU-tunnel backend ("axon"), whose init can fail or hang indefinitely
-(round-1 failure mode: rc=1 at backend init).  We probe the tunnel in a
-subprocess with a hard timeout first; if it is unusable we pin the CPU
-backend before first jax use.  If the run itself dies on the tunnel
-backend we re-exec once with the CPU backend so a number is always
-produced.
+  * the top-level process is a thin ORCHESTRATOR: it prints a
+    provisional JSON line immediately, re-runs itself as a CHILD with a
+    hard wall-clock deadline (OVERSIM_BENCH_DEADLINE, default 300 s),
+    relays every child JSON line to stdout as it appears, SIGKILLs the
+    child at the deadline, and ALWAYS exits 0 — so the driver records a
+    parsed artifact no matter where the run dies (tunnel hang, compile
+    stall, mid-run crash);
+  * the child probes the TPU tunnel in a 30 s-bounded subprocess, falls
+    back to a small CPU config when the tunnel is unusable, and emits an
+    updated JSON line after EVERY measurement window — the last line
+    printed is the result.
 
-Env overrides: OVERSIM_BENCH_N (nodes), OVERSIM_BENCH_SIMTIME (measured
-simulated seconds), OVERSIM_BENCH_INTERVAL (per-node test period, s),
-OVERSIM_BENCH_PLATFORM (skip probing: "axon" | "cpu" | "tpu").
+Env overrides: OVERSIM_BENCH_N (nodes), OVERSIM_BENCH_MEASURE_WALL
+(seconds of wall-clock to measure for), OVERSIM_BENCH_INTERVAL (per-node
+test period, s), OVERSIM_BENCH_PLATFORM ("axon" | "cpu" — skips probing),
+OVERSIM_BENCH_DEADLINE (orchestrator kill + exit-0 watchdog, s).
 """
 
 import json
@@ -29,8 +34,72 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT_S = 240  # tunnel init + first trivial compile
+PROBE_TIMEOUT_S = int(os.environ.get("OVERSIM_BENCH_PROBE_TIMEOUT", 30))
+DEADLINE_S = int(os.environ.get("OVERSIM_BENCH_DEADLINE", 300))
+_T0 = time.time()
 
+# The reference publishes no benchmark numbers (BASELINE.json published={}).
+# Baseline estimate for the same workload on one CPU core: an OMNeT++
+# SimpleUnderlay event costs ~2-10us (hashmap lookup + calcDelay + FES
+# insert, SURVEY.md §2.2), and one KBR lookup is ~12-16 events (6 RPC
+# round trips + final hop + timers) → ~2e4 lookups/core-s.  This constant
+# is the denominator for vs_baseline until a measured reference number
+# replaces it.
+BASELINE_LOOKUPS_PER_SEC = 2.0e4
+
+
+def _json_line(rate: float, unit: str) -> str:
+    return json.dumps({
+        "metric": "kbr_lookups_per_sec",
+        "value": round(rate, 2),
+        "unit": unit,
+        "vs_baseline": round(rate / BASELINE_LOOKUPS_PER_SEC, 4),
+    })
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def orchestrate() -> int:
+    """Run the measurement in a child with a hard deadline; relay its
+    JSON lines; always exit 0 with at least the provisional line out."""
+    print(_json_line(0.0, "lookups/s (provisional: no measurement "
+                          "completed yet)"), flush=True)
+    env = dict(os.environ, OVERSIM_BENCH_CHILD="1")
+    child = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                             stdout=subprocess.PIPE, text=True, env=env)
+    import threading
+
+    def _watchdog():
+        remain = DEADLINE_S - (time.time() - _T0)
+        if remain > 0:
+            time.sleep(remain)
+        if child.poll() is None:
+            sys.stderr.write("bench: deadline %ds hit — killing child\n"
+                             % DEADLINE_S)
+            child.kill()
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    for line in child.stdout:
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        try:
+            json.loads(line)
+        except ValueError:
+            sys.stderr.write("bench child: %s\n" % line)
+            continue
+        print(line, flush=True)  # the driver parses the LAST line
+    child.wait()
+    sys.stderr.write("bench: child rc=%s, done in %.0fs\n"
+                     % (child.returncode, time.time() - _T0))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# child: probe + measure
+# ---------------------------------------------------------------------------
 
 def _probe_platform() -> str | None:
     """Decide the jax_platforms override before jax is imported.
@@ -63,62 +132,58 @@ def _probe_platform() -> str | None:
     return "cpu"
 
 
-_PLATFORM = _probe_platform()
+def child_main():
+    platform = _probe_platform()
+    on_cpu = platform == "cpu"
 
-import sys
-sys.modules["zstandard"] = None  # zlib cache compression (zstd C ext segfaults here)
-import jax  # noqa: E402
+    sys.modules["zstandard"] = None  # zstd C ext segfaults on this box
+    import jax
 
-from jax._src import compilation_cache as _cc  # zstd segfaults; zlib
-if getattr(_cc, "zstandard", None) is not None:
-    _cc.zstandard = None
-if getattr(_cc, "zstd", None) is not None:
-    _cc.zstd = None
+    from jax._src import compilation_cache as _cc
+    if getattr(_cc, "zstandard", None) is not None:
+        _cc.zstandard = None
+    if getattr(_cc, "zstd", None) is not None:
+        _cc.zstd = None
 
-jax.config.update("jax_enable_x64", True)
-# sim-step graphs compile slowly; cache persistently across invocations
-jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-# last update wins over the sitecustomize hook's forced "axon,cpu";
-# None keeps the ambient (tunnel) selection
-if _PLATFORM is not None:
-    jax.config.update("jax_platforms", _PLATFORM)
+    jax.config.update("jax_enable_x64", True)
+    if on_cpu:
+        # this box's XLA-CPU executable serialize() segfaults sporadically
+        # on big sim-step graphs (tests/conftest.py note) — no persistence
+        jax.config.update("jax_enable_compilation_cache", False)
+    else:
+        # sim-step graphs compile slowly; cache across invocations/rounds
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/oversim_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # last update wins over the sitecustomize hook's forced "axon,cpu";
+    # None keeps the ambient (tunnel) selection
+    if platform is not None:
+        jax.config.update("jax_platforms", platform)
 
-from oversim_tpu import churn as churn_mod  # noqa: E402
-from oversim_tpu.apps import kbrtest  # noqa: E402
-from oversim_tpu.apps.kbrtest import KbrTestApp  # noqa: E402
-from oversim_tpu.engine import sim as sim_mod  # noqa: E402
-from oversim_tpu.overlay.chord import ChordLogic  # noqa: E402
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu.apps import kbrtest
+    from oversim_tpu.apps.kbrtest import KbrTestApp
+    from oversim_tpu.engine import sim as sim_mod
+    from oversim_tpu.common import lookup as lk_mod
 
-# The reference publishes no benchmark numbers (BASELINE.json published={}).
-# Baseline estimate for the same workload on one CPU core: an OMNeT++
-# SimpleUnderlay event costs ~2-10us (hashmap lookup + calcDelay + FES
-# insert, SURVEY.md §2.2), and one KBR lookup is ~12-16 events (6 RPC
-# round trips + final hop + timers) → ~2e4 lookups/core-s.  This constant
-# is the denominator for vs_baseline until a measured reference number
-# replaces it.
-BASELINE_LOOKUPS_PER_SEC = 2.0e4
-
-
-def run_bench():
-    # The TPU wins on BATCH: lookups/s = (lookups per tick) / (tick
-    # wall cost), and the tick graph's cost is op-issue-bound (deep
-    # unrolled handler chains of narrow ops), nearly independent of N.
-    # So the headline config drives a dense workload on a wide overlay
-    # with a coarse event window and slim engine bounds (fewer, fatter
-    # ticks) — Kademlia, the reference's scale protocol (BASELINE.md
-    # 1M-node rows), converges orders faster than a Chord ring at this
-    # population.
-    n = int(os.environ.get("OVERSIM_BENCH_N", 2048))
-    sim_seconds = float(os.environ.get("OVERSIM_BENCH_SIMTIME", 30.0))
+    # The TPU wins on BATCH: lookups/s = (lookups per tick) / (tick wall
+    # cost), and the tick cost is op-issue-bound, nearly independent of
+    # N until the VPU saturates — so drive a dense workload on a wide
+    # overlay.  Kademlia is the reference's scale protocol (BASELINE.md
+    # 1M-node rows).
+    n = int(os.environ.get("OVERSIM_BENCH_N", "192" if on_cpu else "4096"))
     interval = float(os.environ.get("OVERSIM_BENCH_INTERVAL", 0.2))
     window = float(os.environ.get("OVERSIM_BENCH_WINDOW", 0.05))
-    warm_extra = float(os.environ.get("OVERSIM_BENCH_WARM", 90.0))
+    warm_extra = float(os.environ.get(
+        "OVERSIM_BENCH_WARM", "20" if on_cpu else "60"))
+    measure_wall = float(os.environ.get(
+        "OVERSIM_BENCH_MEASURE_WALL", "45" if on_cpu else "90"))
     overlay = os.environ.get("OVERSIM_BENCH_OVERLAY", "kademlia")
+    chunk = 64
 
     dev = jax.devices()[0]
-    sys.stderr.write("bench: platform=%s device=%s\n"
-                     % (dev.platform, str(dev)))
+    sys.stderr.write("bench: platform=%s device=%s n=%d\n"
+                     % (dev.platform, str(dev), n))
 
     cp = churn_mod.ChurnParams(model="none", target_num=n,
                                init_interval=20.0 / n,
@@ -127,66 +192,67 @@ def run_bench():
     # lookup concurrency: at `interval` issue rate with ~0.5-1 s lookup
     # durations, steady-state in-flight lookups per node ≈ duration /
     # interval — slots below that turn sends into instant failures
-    from oversim_tpu.common import lookup as lk_mod
     slots = int(os.environ.get("OVERSIM_BENCH_SLOTS", 8))
     if overlay == "chord":
-        logic = ChordLogic(app=app,
-                           lcfg=lk_mod.LookupConfig(slots=slots))
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=slots))
     else:
         from oversim_tpu.overlay.kademlia import KademliaLogic
         logic = KademliaLogic(app=app,
                               lcfg=lk_mod.LookupConfig(slots=slots,
                                                        merge=True))
-    ep = sim_mod.EngineParams(window=window, inbox_slots=4,
-                              pool_factor=4)
+    ep = sim_mod.EngineParams(window=window, inbox_slots=4, pool_factor=4)
     sim = sim_mod.Simulation(logic, cp, engine_params=ep)
 
     s = sim.init(seed=7)
-    # build + join + stabilization phase (not measured)
     warm_until = cp.init_finished_time + warm_extra
-    s = sim.run_until(s, warm_until)
+    t0 = time.perf_counter()
+    s = sim.run_until(s, warm_until, chunk=chunk)
     jax.block_until_ready(s.t_now)
+    sys.stderr.write("bench: warmup (%.0f sim-s) took %.1fs wall\n"
+                     % (warm_until, time.perf_counter() - t0))
     base = sim.summary(s)
 
-    t0 = time.perf_counter()
-    s = sim.run_until(s, warm_until + sim_seconds)
-    jax.block_until_ready(s.t_now)
-    wall = time.perf_counter() - t0
-
-    out = sim.summary(s)
-    delivered = out["kbr_delivered"] - base["kbr_delivered"]
-    sent = out["kbr_sent"] - base["kbr_sent"]
-    rate = delivered / wall if wall > 0 else 0.0
-
-    result = {
-        "metric": "kbr_lookups_per_sec",
-        "value": round(rate, 2),
-        "unit": f"lookups/s ({overlay} {n} nodes, {dev.platform}, "
+    # measure in wall-clock windows, emitting an updated JSON line after
+    # each — the orchestrator relays them, the driver takes the last
+    t_meas0 = time.perf_counter()
+    sim_t = warm_until
+    chunk_sim_s = chunk * window
+    while time.perf_counter() - t_meas0 < measure_wall:
+        sim_t += chunk_sim_s
+        s = sim.run_until(s, sim_t, chunk=chunk)
+        jax.block_until_ready(s.t_now)
+        out = sim.summary(s)
+        wall = time.perf_counter() - t_meas0
+        delivered = out["kbr_delivered"] - base["kbr_delivered"]
+        sent = out["kbr_sent"] - base["kbr_sent"]
+        rate = delivered / wall if wall > 0 else 0.0
+        unit = (f"lookups/s ({overlay} {n} nodes, {dev.platform}, "
                 f"delivery {delivered}/{sent}, {out['_ticks']} ticks, "
-                f"{wall:.1f}s wall)",
-        "vs_baseline": round(rate / BASELINE_LOOKUPS_PER_SEC, 3),
-    }
-    print(json.dumps(result))
+                f"{wall:.1f}s wall)")
+        print(_json_line(rate, unit), flush=True)
+        sys.stderr.write("bench: %.0f lookups/s after %.1fs (%d/%d)\n"
+                         % (rate, wall, delivered, sent))
 
 
 def main():
+    if os.environ.get("OVERSIM_BENCH_CHILD") != "1":
+        sys.exit(orchestrate())
     try:
-        run_bench()
+        child_main()
     except Exception:
         import traceback
         traceback.print_exc()
-        if _PLATFORM is None:
-            # tunnel backend died mid-run: retry once on CPU so the
-            # driver still records a number — at a SMALL config (the
-            # headline N would take hours to compile+run on one core)
+        if (os.environ.get("OVERSIM_BENCH_PLATFORM") is None
+                and time.time() - _T0 < DEADLINE_S - 100):
+            # tunnel backend died mid-run: retry once on CPU at a small
+            # config so a real measurement still lands
             sys.stderr.write("bench: retrying on cpu backend\n")
             os.environ["OVERSIM_BENCH_PLATFORM"] = "cpu"
-            os.environ["OVERSIM_BENCH_N"] = os.environ.get(
-                "OVERSIM_BENCH_FALLBACK_N", "256")
-            os.environ["OVERSIM_BENCH_SIMTIME"] = "20"
-            os.environ["OVERSIM_BENCH_WARM"] = "60"
+            os.environ.setdefault("OVERSIM_BENCH_N", "128")
+            os.environ["OVERSIM_BENCH_MEASURE_WALL"] = "30"
             os.execv(sys.executable, [sys.executable] + sys.argv)
-        raise
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -90,11 +90,16 @@ class AppEvents:
         inc = jnp.asarray(inc)
         if inc.dtype == bool:
             inc = inc.astype(I32)
+        if inc.ndim > 0:
+            inc = jnp.sum(inc)     # vector emissions fold immediately
         self._counts[name] = self._counts.get(name, jnp.int32(0)) + inc
 
     def value(self, name: str, val, mask):
-        self._vals.setdefault(name, []).append(
-            (jnp.asarray(val, jnp.float32), jnp.asarray(mask)))
+        """``val``/``mask`` may be scalar or vector-shaped; everything is
+        flattened so scalar and batched emissions of one stat coexist."""
+        val = jnp.asarray(val, jnp.float32).reshape(-1)
+        mask = jnp.broadcast_to(jnp.asarray(mask), val.shape).reshape(-1)
+        self._vals.setdefault(name, []).append((val, mask))
 
     def finish(self, events: dict, hist_bins: dict | None = None):
         """Write accumulated events; ``hist_bins`` maps a scalar-event name
@@ -102,8 +107,8 @@ class AppEvents:
         for name, v in self._counts.items():
             events["c:" + name] = events.get("c:" + name, 0) + v
         for name, pairs in self._vals.items():
-            vals = jnp.stack([p[0] for p in pairs])
-            mask = jnp.stack([p[1] for p in pairs])
+            vals = jnp.concatenate([p[0] for p in pairs])
+            mask = jnp.concatenate([p[1] for p in pairs])
             events["s:" + name] = (vals, mask)
             if hist_bins and name in hist_bins:
                 events["h:" + hist_bins[name]] = (vals.astype(I32), mask)

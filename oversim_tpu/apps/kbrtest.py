@@ -218,6 +218,102 @@ class KbrTestApp:
                  en_l & right & ctx.measuring)
         return app
 
+    def on_lookup_done_batch(self, app, done: base.LookupDone, ctx, ob, ev,
+                             now, node_idx):
+        """Batched completion hook: ``done`` fields are [L]-shaped (one
+        lane per lookup slot).  Semantics = folding :meth:`on_lookup_done`
+        over the L lanes; the at-most-one outstanding routed RPC keeps
+        last-fired-wins semantics like the fold did."""
+        en = done.en                                   # [L]
+        mode = done.tag % 4
+        suc = done.success & (done.results[:, 0] != NO_NODE)
+        res = done.results[:, 0]
+
+        # ---- one-way: final payload hop to the sibling -----------------
+        en_1 = en & (mode == M_ONEWAY)
+        ev.count("kbr_lookup_failed", en_1 & ~suc)
+        ob.send(en_1 & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
+                key=done.target, hops=done.hops + 1,
+                c=ctx.measuring.astype(I32), stamp=done.t0,
+                size_b=self.p.test_msg_bytes)
+        self_del = en_1 & suc & (res == node_idx)
+        ev.count("kbr_delivered", self_del & ctx.measuring)
+        ev.value("kbr_hopcount", done.hops, self_del & ctx.measuring)
+        ev.value("kbr_latency_s",
+                 (now - done.t0).astype(jnp.float32) / NS,
+                 self_del & ctx.measuring)
+
+        # ---- routed RPC: KbrTestCall to the responsible node -----------
+        en_r = en & (mode == M_RPC)
+        ev.count("kbr_rpc_failed", en_r & ~suc)
+        fire_r = en_r & suc & (res != node_idx)
+        ob.send(fire_r, now, res, wire.APP_RPC_CALL, key=done.target,
+                a=done.tag, stamp=done.t0, size_b=self.p.test_msg_bytes)
+        self_r = en_r & suc & (res == node_idx)
+        ev.count("kbr_rpc_success", self_r & ctx.measuring)
+        # one outstanding call per node: the LAST fired lane wins (the
+        # sequential fold's later where() overwrote earlier ones)
+        l_dim = en.shape[0]
+        any_f = jnp.any(fire_r)
+        last = l_dim - 1 - jnp.argmax(fire_r[::-1]).astype(I32)
+        sel = jnp.clip(last, 0, l_dim - 1)
+        app = dataclasses.replace(
+            app,
+            rpc_dst=jnp.where(any_f, res[sel], app.rpc_dst),
+            rpc_to=jnp.where(any_f, now + jnp.int64(
+                int(self.p.rpc_timeout * NS)), app.rpc_to),
+            rpc_t0=jnp.where(any_f, done.t0[sel], app.rpc_t0),
+            rpc_nonce=jnp.where(any_f, done.tag[sel], app.rpc_nonce))
+
+        # ---- lookup test: oracle validation ----------------------------
+        en_l = en & (mode == M_LOOKUP)
+        resk = ctx.keys[jnp.maximum(res, 0)]
+        target_alive = ctx.alive[jnp.maximum(res, 0)]
+        right = suc & jnp.all(resk == done.target, axis=-1) & target_alive
+        ev.count("kbr_lookup_success", en_l & right & ctx.measuring)
+        ev.count("kbr_lookup_wrong", en_l & suc & ~right & ctx.measuring)
+        ev.count("kbr_lookup_failed", en_l & ~suc)
+        ev.value("kbr_lookup_latency_s",
+                 (now - done.t0).astype(jnp.float32) / NS,
+                 en_l & right & ctx.measuring)
+        return app
+
+    def on_msgs(self, app, msgs, ctx, ob, ev, is_sib):
+        """Batched deliver hook: ``msgs`` is the [R]-batch Msg view and
+        ``is_sib[r]`` the receiver's responsibility flag for msgs.key[r].
+        Semantics = folding :meth:`on_msg` over the R slots (at most one
+        outstanding RPC means at most one lane can match the client
+        response check)."""
+        v = msgs.valid
+        en = v & (msgs.kind == wire.APP_ONEWAY)
+        good = en & is_sib & (msgs.c != 0)
+        ev.count("kbr_delivered", good)
+        ev.count("kbr_wrong_node", en & ~is_sib & (msgs.c != 0))
+        ev.value("kbr_hopcount", msgs.hops, good)
+        ev.value("kbr_latency_s",
+                 (msgs.t_deliver - msgs.stamp).astype(jnp.float32) / NS,
+                 good)
+
+        # routed-RPC server: reply directly (KbrTestCall → Response)
+        en = v & (msgs.kind == wire.APP_RPC_CALL)
+        ob.send(en, msgs.t_deliver, msgs.src, wire.APP_RPC_RES,
+                key=msgs.key, a=msgs.a, stamp=msgs.stamp,
+                size_b=wire.BASE_CALL_B)
+
+        # routed-RPC client: RTT + success (nonce-matched)
+        en = v & (msgs.kind == wire.APP_RPC_RES) & (
+            msgs.src == app.rpc_dst) & (msgs.a == app.rpc_nonce)
+        hit = jnp.any(en)
+        ev.count("kbr_rpc_success", en & ctx.measuring)
+        ev.value("kbr_rpc_rtt_s",
+                 (msgs.t_deliver - msgs.stamp).astype(jnp.float32) / NS,
+                 en & ctx.measuring)
+        app = dataclasses.replace(
+            app,
+            rpc_dst=jnp.where(hit, NO_NODE, app.rpc_dst),
+            rpc_to=jnp.where(hit, T_INF, app.rpc_to))
+        return app
+
     def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
         """No state to hand over; leaving nodes just stop testing (the
         engine stops firing app timers during the grace window)."""

@@ -328,6 +328,122 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
     return lk
 
 
+def on_responses(lk: LookupState, msgs, metric_fn, cfg: LookupConfig):
+    """Batched ``on_response``: consume ALL of a node's FINDNODE_RES inbox
+    messages ([R]-batch Msg view, ``msgs.valid`` pre-masked to response
+    kind) in one pass.
+
+    Semantically equivalent to folding :func:`on_response` over the R
+    slots, except (a) several same-tick responses for one lookup slot
+    merge into the frontier through ONE sort over [F + R·F] candidates
+    instead of R sorts, and (b) when two sibling-flagged responses land
+    in one tick the lowest inbox slot wins (the fold took the first too).
+    This is the op-count lever: the unrolled fold dominated the tick
+    graph (PERFORMANCE.md round-2 analysis).
+    """
+    r_in = msgs.valid.shape[0]
+    l_dim, f = lk.frontier.shape
+    lixs = jnp.arange(l_dim, dtype=I32)
+
+    l_r = jnp.clip(msgs.a, 0, l_dim - 1)                       # [R]
+    match = (lk.pending_dst[l_r] == msgs.src[:, None]) & (
+        msgs.src != NO_NODE)[:, None]                          # [R, Rrpc]
+    ok = (msgs.valid & lk.active[l_r] & (lk.gen[l_r] == msgs.b) &
+          jnp.any(match, axis=1) & ~lk.done[l_r])
+    # a duplicate response (same slot, same responder) in the same tick
+    # must not double-count: the sequential fold rejected it because the
+    # first response cleared the pending entry (BaseRpc nonce matching)
+    same = (l_r[None, :] == l_r[:, None]) & (
+        msgs.src[None, :] == msgs.src[:, None])
+    earlier = jnp.tril(jnp.ones((r_in, r_in), bool), k=-1)
+    ok = ok & ~jnp.any(same & earlier & ok[None, :], axis=1)
+    j = jnp.argmax(match, axis=1).astype(I32)
+
+    # clear matched pending RPCs; count hops (IterativeLookup.cc:825)
+    rows = jnp.where(ok, l_r, l_dim)
+    lk = dataclasses.replace(
+        lk,
+        pending_dst=lk.pending_dst.at[rows, j].set(NO_NODE, mode="drop"),
+        t_to=lk.t_to.at[rows, j].set(T_INF, mode="drop"),
+        retry=lk.retry.at[rows, j].set(0, mode="drop"),
+        refire=lk.refire.at[rows, j].set(False, mode="drop"),
+        hops=lk.hops.at[rows].add(1, mode="drop"))
+
+    resp_nodes = msgs.nodes[:, :f]                              # [R, F]
+    has_nodes = jnp.any(resp_nodes != NO_NODE, axis=1)
+    is_sib = (msgs.c != 0) & has_nodes
+
+    def per_slot(pred):
+        """[R] bool → ([L] any, [L] first-r index)."""
+        m_rl = pred[:, None] & (l_r[:, None] == lixs[None, :])
+        return jnp.any(m_rl, axis=0), jnp.argmax(m_rl, axis=0), m_rl
+
+    if not cfg.exhaustive:
+        fin, win, _ = per_slot(ok & is_sib)
+        wnodes = resp_nodes[win]                                # [L, F]
+        lk = dataclasses.replace(
+            lk,
+            done=lk.done | fin,
+            success=lk.success | fin,
+            result=jnp.where(fin, wnodes[:, 0], lk.result),
+            results=jnp.where(fin[:, None], wnodes, lk.results),
+            t_done=jnp.where(fin, msgs.t_deliver[win], lk.t_done))
+        upd = ok & ~is_sib
+    else:
+        # exhaustive: accumulate every sibling-flagged response's node set,
+        # kept metric-sorted so results[0] is the closest found
+        _, _, m_acc = per_slot(ok & is_sib)
+        contrib = jnp.where(m_acc.T[:, :, None], resp_nodes[None, :, :],
+                            NO_NODE).reshape(l_dim, r_in * f)
+        cur = jnp.concatenate([lk.results, contrib], axis=1)    # [L, F+RF]
+        dup = jax.vmap(keys_mod.dup_mask)(cur) | (cur == NO_NODE)
+        cur = jnp.where(dup, NO_NODE, cur)
+        sdist = jax.vmap(metric_fn)(cur, lk.target)
+        sdist = jnp.where(dup[..., None], jnp.uint32(0xFFFFFFFF), sdist)
+        _, (packed,) = keys_mod.sort_by_distance(sdist, (cur,))
+        packed = packed[:, :f]
+        acc_any = jnp.any(m_acc, axis=0)
+        lk = dataclasses.replace(
+            lk,
+            results=jnp.where(acc_any[:, None], packed, lk.results),
+            res_n=jnp.where(acc_any,
+                            jnp.sum(packed != NO_NODE, axis=1, dtype=I32),
+                            lk.res_n))
+        upd = ok
+
+    if cfg.merge:
+        any_upd, _, m_upd = per_slot(upd)
+        contrib = jnp.where(m_upd.T[:, :, None], resp_nodes[None, :, :],
+                            NO_NODE).reshape(l_dim, r_in * f)
+        cand = jnp.concatenate([lk.frontier, contrib], axis=1)  # [L, F+RF]
+        flags = jnp.concatenate(
+            [lk.fr_flags, jnp.full((l_dim, r_in * f), F_NEW, I32)], axis=1)
+        dup = jax.vmap(keys_mod.dup_mask)(cand) | (cand == NO_NODE)
+        cand = jnp.where(dup, NO_NODE, cand)
+        dist = jax.vmap(metric_fn)(cand, lk.target)
+        dist = jnp.where(dup[..., None], jnp.uint32(0xFFFFFFFF), dist)
+        _, (cand_s, flags_s) = keys_mod.sort_by_distance(dist, (cand, flags))
+        new_frontier = cand_s[:, :f]
+        new_flags = jnp.where(new_frontier == NO_NODE, F_NEW, flags_s[:, :f])
+    else:
+        # replace mode: the first consuming response replaces the frontier
+        # (IterativeLookup.cc:839-841); empty responses keep the old one
+        any_upd, win_u, _ = per_slot(upd & has_nodes)
+        new_frontier = resp_nodes[win_u]
+        new_flags = jnp.full((l_dim, f), F_NEW, I32)
+
+    lk = dataclasses.replace(
+        lk,
+        frontier=jnp.where(any_upd[:, None], new_frontier, lk.frontier),
+        fr_flags=jnp.where(any_upd[:, None], new_flags, lk.fr_flags))
+    ew = cfg.ext_words
+    if ew:
+        any_e, win_e, _ = per_slot(upd)
+        lk = dataclasses.replace(lk, ext=jnp.where(
+            any_e[:, None], msgs.nodes[win_e][:, -ew:], lk.ext))
+    return lk
+
+
 def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
     """Expire pending RPCs / deadlines due strictly before ``t_end``.
 
@@ -393,15 +509,22 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
     # skip tracing the L×R send fan-out entirely in that case
     if cfg.retries:
         t_to = jnp.where(lk.refire, now + cfg.rpc_timeout_ns, lk.t_to)
-        for li in range(l_dim):
-            for rj in range(r_dim):
-                outbox.send(
-                    lk.refire[li, rj], now, lk.pending_dst[li, rj],
-                    wire.FINDNODE_CALL,
-                    key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
-                    c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
-                    nodes=lk.ext[li] if cfg.ext_words else None,
-                    size_b=call_size)
+        li_grid = jnp.broadcast_to(
+            jnp.arange(l_dim, dtype=I32)[:, None], (l_dim, r_dim))
+        outbox.send(
+            lk.refire.reshape(-1), now, lk.pending_dst.reshape(-1),
+            wire.FINDNODE_CALL,
+            key=jnp.broadcast_to(lk.target[:, None, :],
+                                 (l_dim, r_dim, lk.target.shape[1])
+                                 ).reshape(l_dim * r_dim, -1),
+            a=li_grid.reshape(-1),
+            b=jnp.broadcast_to(lk.gen[:, None], (l_dim, r_dim)).reshape(-1),
+            c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
+            nodes=(jnp.broadcast_to(lk.ext[:, None, :],
+                                    (l_dim, r_dim, cfg.ext_words)
+                                    ).reshape(l_dim * r_dim, -1)
+                   if cfg.ext_words else None),
+            size_b=call_size)
         lk = dataclasses.replace(
             lk, t_to=t_to, refire=jnp.zeros_like(lk.refire))
 
@@ -438,13 +561,12 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         retry = retry.at[rows, col].set(0, mode="drop")
         fired_any = fired_any | fire
 
-        for li in range(l_dim):
-            outbox.send(
-                fire[li], now, cand[li], wire.FINDNODE_CALL,
-                key=lk.target[li], a=jnp.int32(li), b=lk.gen[li],
-                c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
-                nodes=lk.ext[li] if cfg.ext_words else None,
-                size_b=call_size)
+        outbox.send(
+            fire, now, cand, wire.FINDNODE_CALL,
+            key=lk.target, a=jnp.arange(l_dim, dtype=I32), b=lk.gen,
+            c=jnp.int32(num_siblings), d=jnp.int32(num_redundant),
+            nodes=lk.ext if cfg.ext_words else None,
+            size_b=call_size)
 
     # ---- exhaustion: nothing in flight and nothing left to query ----
     cand_ok = (frontier != NO_NODE) & (fr_flags == F_NEW)

@@ -166,44 +166,67 @@ class Outbox:
     lane in the stacked batch but no slot.  Slots beyond capacity are
     dropped (the engine counts the overflow).  The reference equivalent
     is the unbounded sendMessageToUDP path (BaseOverlay.cc:1147).
+
+    A send site may be VECTOR-VALUED: pass ``en`` with shape [B] and the
+    other fields with shape [B] (or scalar, broadcast) to emit B
+    candidate messages from ONE trace-time call.  This is the op-count
+    lever: an unrolled loop of B scalar sends costs B×14 graph nodes,
+    a single vector send costs 14.
     """
 
     def __init__(self, m: int, key_lanes: int, rmax: int):
         self.m = m
         self.key_lanes = key_lanes
         self.rmax = rmax
-        self._en = []
-        self._rows = []   # list of per-send field dicts (scalar leaves)
+        self._en = []      # list of [B_i] bool
+        self._rows = []    # list of per-send field dicts ([B_i, ...] leaves)
 
     def send(self, en, t_send, dst, kind, *, key=None, nonce=0, hops=0,
              a=0, b=0, c=0, d=0, nodes=None, size_b=40, stamp=0):
-        if nodes is not None and nodes.shape[0] > self.rmax:
-            raise ValueError("node-list payload exceeds RMAX")
-        self._en.append(jnp.asarray(en))
+        en = jnp.atleast_1d(jnp.asarray(en))
+        bdim = en.shape[0]
+
+        def f(v, dt):
+            v = jnp.asarray(v, dt)
+            if v.ndim == 0:
+                v = jnp.broadcast_to(v, (bdim,))
+            return v
+
+        if key is not None:
+            key = jnp.asarray(key)
+            if key.ndim == 1:
+                key = jnp.broadcast_to(key, (bdim,) + key.shape)
+        if nodes is not None:
+            nodes = jnp.asarray(nodes, I32)
+            if nodes.ndim == 1:
+                nodes = jnp.broadcast_to(nodes, (bdim,) + nodes.shape)
+            if nodes.shape[-1] > self.rmax:
+                raise ValueError("node-list payload exceeds RMAX")
+        self._en.append(en)
         self._rows.append(dict(
-            t_send=jnp.asarray(t_send, I64),
-            dst=jnp.asarray(dst, I32),
-            kind=jnp.asarray(kind, I32),
-            key=key, nonce=jnp.asarray(nonce, I32),
-            hops=jnp.asarray(hops, I32),
-            a=jnp.asarray(a, I32), b=jnp.asarray(b, I32),
-            c=jnp.asarray(c, I32), d=jnp.asarray(d, I32),
-            nodes=nodes, size_b=jnp.asarray(size_b, I32),
-            stamp=jnp.asarray(stamp, I64)))
+            t_send=f(t_send, I64),
+            dst=f(dst, I32),
+            kind=f(kind, I32),
+            key=key, nonce=f(nonce, I32),
+            hops=f(hops, I32),
+            a=f(a, I32), b=f(b, I32),
+            c=f(c, I32), d=f(d, I32),
+            nodes=nodes, size_b=f(size_b, I32),
+            stamp=f(stamp, I64)))
 
     @property
     def cursor(self):
         """Number of enabled sends so far (inspection/debug only)."""
         if not self._en:
             return jnp.int32(0)
-        return jnp.sum(jnp.stack(self._en).astype(I32))
+        return jnp.sum(jnp.concatenate(self._en).astype(I32))
 
     def finish(self):
         """Returns (fields dict, valid [M], overflow count)."""
-        s = len(self._en)
         m = self.m
         zero_key = jnp.zeros((self.key_lanes,), U32)
         no_nodes = jnp.full((self.rmax,), NO_NODE, I32)
+        s = sum(int(e.shape[0]) for e in self._en)
         if s == 0:
             fields = dict(
                 t_send=jnp.zeros((m,), I64), dst=jnp.zeros((m,), I32),
@@ -216,7 +239,7 @@ class Outbox:
                 size_b=jnp.zeros((m,), I32), stamp=jnp.zeros((m,), I64))
             return fields, jnp.zeros((m,), bool), jnp.int32(0)
 
-        en = jnp.stack([e.astype(I32) for e in self._en])        # [S]
+        en = jnp.concatenate([e.astype(I32) for e in self._en])  # [S]
         # slot of send j = number of enabled sends before it
         slots = jnp.cumsum(en) - en                              # [S]
         # compaction: out[i] = the send occupying slot i.  gather form
@@ -229,19 +252,22 @@ class Outbox:
 
         def pick(name, fill, width=None):
             rows = []
-            for r in self._rows:
+            for e, r in zip(self._en, self._rows):
                 v = r[name]
+                b = int(e.shape[0])
                 if name == "key":
-                    v = zero_key if v is None else v
+                    v = (jnp.broadcast_to(zero_key, (b, self.key_lanes))
+                         if v is None else v)
                 elif name == "nodes":
                     if v is None:
-                        v = no_nodes
-                    elif v.shape[0] < self.rmax:
+                        v = jnp.broadcast_to(no_nodes, (b, self.rmax))
+                    elif v.shape[-1] < self.rmax:
                         v = jnp.concatenate([
-                            v, jnp.full((self.rmax - v.shape[0],),
-                                        NO_NODE, I32)])
+                            v, jnp.full(v.shape[:-1]
+                                        + (self.rmax - v.shape[-1],),
+                                        NO_NODE, I32)], axis=-1)
                 rows.append(v)
-            stacked = jnp.stack(rows)                            # [S, ...]
+            stacked = jnp.concatenate(rows)                      # [S, ...]
             out = stacked[src]                                   # [S'≤M]
             pad = m - out.shape[0]
             if pad > 0:

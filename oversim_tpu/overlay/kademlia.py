@@ -221,115 +221,158 @@ class KademliaLogic:
         disp = jnp.where(was & ~still, sib, NO_NODE)
         return new_sib, disp
 
-    def _bucket_add(self, ctx, st, me_key, cand, alive, now):
-        """Full routingAdd bucket policy for ONE candidate slot."""
+    def _bucket_update_batch(self, ctx, st, me_key, cands, alive, now):
+        """One-pass batched bucket update — the bucket half of routingAdd
+        (Kademlia.cc:432-700) for ALL of a tick's C candidates at once.
+
+        Per-candidate policy, equal to the sequential reference up to
+        within-tick ordering: present+alive → lastSeen refresh / stale
+        reset; absent → take a free slot, or (alive candidates only)
+        evict a stale entry (staleCount > maxStaleCount, highest count
+        first); unverified candidates (alive=False, nodes learned from
+        FindNodeResponse payloads, Kademlia.cc:1412) fill free slots only
+        and never displace.  Alive candidates get slot priority.  The
+        whole policy is one candidate sort + one column sort + three
+        scatters instead of C unrolled scatter chains (the round-2 tick
+        graph was dominated by exactly those chains).
+
+        ``cands`` must be deduplicated by the caller; NO_NODE = disabled.
+        """
         p = self.p
-        alive = jnp.asarray(alive, bool)
-        en = (cand != NO_NODE)
-        ck = ctx.keys[jnp.maximum(cand, 0)]
-        bi = self._bucket_index(me_key, ck)
-        row = st.buckets[bi]           # [K]
-        seen = st.b_seen[bi]
-        stale = st.b_stale[bi]
+        num_b, kk = p.num_buckets, p.k
+        c_dim = cands.shape[0]
+        en = cands != NO_NODE
+        ck = ctx.keys[jnp.maximum(cands, 0)]
+        bi = jnp.where(en, self._bucket_index(me_key, ck), num_b)
 
-        present = row == cand
-        is_present = jnp.any(present)
-        free = row == NO_NODE
-        has_free = jnp.any(free)
-        # stale eviction candidate: highest stale count above threshold
-        evict_ok = alive & (stale > p.max_stale) & ~free
-        has_evict = jnp.any(evict_ok)
+        # --- presence refresh (alive contacts only) ---
+        acand = jnp.where(en & alive, cands, NO_NODE)
+        hit = jnp.any(
+            st.buckets[:, :, None] == acand[None, None, :], axis=-1) & (
+            st.buckets != NO_NODE)
+        b_seen = jnp.where(hit, now, st.b_seen)
+        b_stale = jnp.where(hit, 0, st.b_stale)
+        buckets = st.buckets
 
-        col_present = jnp.argmax(present).astype(I32)
-        col_free = jnp.argmax(free).astype(I32)
-        col_evict = jnp.argmax(jnp.where(evict_ok, stale, -1)).astype(I32)
+        # --- slot assignment for absent candidates ---
+        row_c = buckets[jnp.minimum(bi, num_b - 1)]           # [C, K]
+        present = jnp.any(row_c == cands[:, None], axis=1)
+        need = en & ~present
+        # candidates ordered by (bucket, alive-first, arrival order)
+        k1 = jnp.where(need, bi, num_b).astype(I32)
+        k2 = (~alive).astype(I32)
+        k3 = jnp.arange(c_dim, dtype=I32)
+        b_s, a_s, idx_s = jax.lax.sort((k1, k2, k3), num_keys=3)
+        rank = k3 - jnp.searchsorted(b_s, b_s, side="left").astype(I32)
+        # per-bucket column order: free columns first, then evictable by
+        # stale count descending, then untouchable
+        free = buckets == NO_NODE
+        evictable = ~free & (b_stale > p.max_stale)
+        cls = jnp.where(free, 0, jnp.where(evictable, 1, 2))
+        colkey = cls * (1 << 20) - jnp.where(
+            evictable, jnp.minimum(b_stale, (1 << 19) - 1), 0)
+        order = jnp.argsort(colkey, axis=1).astype(I32)       # [B, K]
+        free_cnt = jnp.sum(free, axis=1, dtype=I32)           # [B]
+        avail_cnt = free_cnt + jnp.sum(evictable, axis=1, dtype=I32)
 
-        col = jnp.where(is_present, col_present,
-                        jnp.where(has_free, col_free, col_evict))
-        do = en & (is_present | has_free | (alive & has_evict))
-        # unverified learned nodes never displace (free slots only)
-        do = do & (alive | is_present | has_free)
-
-        col = jnp.where(do, col, p.k)  # OOB drop
-        new_row = row.at[col].set(cand, mode="drop")
-        new_seen = seen.at[col].set(jnp.where(alive, now, jnp.int64(0)),
-                                    mode="drop")
-        # presence refresh only bumps seen/stale when the contact is alive
-        keep_old = is_present & ~alive
-        new_seen = jnp.where(keep_old, seen, new_seen)
-        new_stale = jnp.where(keep_old, stale, stale.at[col].set(0, mode="drop"))
-
+        bi_c = jnp.minimum(b_s, num_b - 1)
+        limit = jnp.where(a_s == 0, avail_cnt[bi_c], free_cnt[bi_c])
+        okc = (b_s < num_b) & (rank < limit) & (rank < kk)
+        col = order[bi_c, jnp.clip(rank, 0, kk - 1)]
+        rows = jnp.where(okc, bi_c, num_b)
+        vals = cands[idx_s]
+        al_v = a_s == 0
         return dataclasses.replace(
             st,
-            buckets=st.buckets.at[bi].set(new_row),
-            b_seen=st.b_seen.at[bi].set(new_seen),
-            b_stale=st.b_stale.at[bi].set(new_stale))
+            buckets=buckets.at[rows, col].set(vals, mode="drop"),
+            b_seen=b_seen.at[rows, col].set(
+                jnp.where(al_v, now, jnp.int64(0)), mode="drop"),
+            b_stale=b_stale.at[rows, col].set(0, mode="drop"))
 
-    def _routing_add(self, ctx, st, me_key, node_idx, cand, alive, now):
-        """Full routingAdd for one heard-from node (Kademlia.cc:432)."""
-        en = (cand != NO_NODE) & (cand != node_idx)
-        cand = jnp.where(en, cand, NO_NODE)
-        new_sib, disp_vec = self._sib_merge(
-            ctx, me_key, node_idx, st.sib, cand[None], en[None])
-        # a single added candidate displaces at most one sibling
-        disp = jnp.where(jnp.any(disp_vec != NO_NODE),
-                         disp_vec[jnp.argmax(disp_vec != NO_NODE)], NO_NODE)
-        became_sib = jnp.any(new_sib == cand) & en
-        st = dataclasses.replace(st, sib=jnp.where(en, new_sib, st.sib))
-        # bucket candidate: the displaced ex-sibling, or the node itself if
-        # it did not make the sibling table
-        bucket_cand = jnp.where(became_sib, disp, cand)
-        st = self._bucket_add(ctx, st, me_key, bucket_cand,
-                              alive | became_sib, now)
-        return st
+    def _routing_add_batch(self, ctx, st, me_key, node_idx, cands, alive,
+                           now):
+        """Batched routingAdd (Kademlia.cc:432) for a tick's whole
+        candidate set: one sibling-table merge sort + one batched bucket
+        pass.  ``alive`` marks verified contacts (message sources); false
+        = unverified learned nodes.  An alive occurrence of a node wins
+        over an unverified duplicate."""
+        en = (cands != NO_NODE) & (cands != node_idx)
+        cands = jnp.where(en, cands, NO_NODE)
+        eq = cands[None, :] == cands[:, None]
+        alive = jnp.any(eq & (alive & en)[None, :], axis=1) & en
+        dup = K.dup_mask(cands)
+        en = en & ~dup
+        cands = jnp.where(en, cands, NO_NODE)
 
-    def _learn_batch(self, ctx, st, me_key, node_idx, cands, ok, now):
-        """Unverified batch learn (FindNodeResponse payload,
-        Kademlia.cc:1412): sibling merge + free-slot bucket inserts."""
         new_sib, disp_vec = self._sib_merge(ctx, me_key, node_idx, st.sib,
-                                            cands, ok)
+                                            cands, en)
         st = dataclasses.replace(st, sib=new_sib)
-        # every displaced ex-sibling was a verified contact: move it into
-        # its bucket with the full alive policy (reference routingAdd)
-        for i in range(disp_vec.shape[0]):
-            st = self._bucket_add(ctx, st, me_key, disp_vec[i], True, now)
-        # free-slot-only bucket insert for each learned node not in siblings
-        in_sib = jnp.any(cands[:, None] == new_sib[None, :], axis=1)
-        todo = ok & ~in_sib & (cands != NO_NODE) & (cands != node_idx)
-        for i in range(cands.shape[0]):
-            st = self._bucket_add(ctx, st, me_key,
-                                  jnp.where(todo[i], cands[i], NO_NODE),
-                                  False, now)
-        return st
+        became_sib = jnp.any(cands[:, None] == new_sib[None, :], axis=1) & en
+        # bucket candidates: displaced ex-siblings re-file as verified
+        # contacts (reference routingAdd moves them into their buckets,
+        # Kademlia.cc:613 area); non-sibling candidates keep their flag.
+        # A displaced node that is ALSO a same-tick candidate must enter
+        # the bucket once (the bucket pass requires caller-side dedup):
+        # drop the disp_vec copy and promote the candidate to verified.
+        in_disp = jnp.any(cands[:, None] == disp_vec[None, :], axis=1) & en
+        disp_vec = jnp.where(
+            jnp.any(disp_vec[:, None] == jnp.where(en, cands, NO_NODE)[None, :],
+                    axis=1), NO_NODE, disp_vec)
+        bc = jnp.concatenate([disp_vec,
+                              jnp.where(became_sib, NO_NODE, cands)])
+        ba = jnp.concatenate([jnp.ones(disp_vec.shape, bool),
+                              alive | in_disp])
+        return self._bucket_update_batch(ctx, st, me_key, bc, ba, now)
 
     def _find_node(self, ctx, st, me_key, node_idx, key, rmax):
         """Top-R closest known nodes by XOR distance (Kademlia.cc:1101).
 
         Returns ([rmax] i32 slots NO_NODE-padded, is_sibling bool)."""
+        out, is_sib = self._find_node_batch(ctx, st, me_key, node_idx,
+                                            key[None], rmax)
+        return out[0], is_sib[0]
+
+    def _find_node_batch(self, ctx, st, me_key, node_idx, keys, rmax):
+        """Batched findNode for T target keys at once ([T, KL] → ([T, rmax]
+        slots, [T] is_sibling)) — ONE sort over the shared candidate set
+        per tick instead of one per unrolled call site.
+
+        findNode: top-R by XOR distance over self ∪ siblings ∪ all buckets
+        (Kademlia.cc:1101 walks best bucket → surrounding buckets →
+        siblings; same result set).  isSiblingFor: Kademlia.cc:888."""
         p = self.p
+        t_dim = keys.shape[0]
         # mask bucket entries that were since promoted into the sibling
         # table (routingAdd can adopt a bucket resident without purging
         # its bucket slot) so the result set never repeats a node
         flat = st.buckets.reshape(-1)
         in_sib = jnp.any(flat[:, None] == st.sib[None, :], axis=1)
         flat = jnp.where(in_sib, NO_NODE, flat)
-        cands = jnp.concatenate([node_idx[None], st.sib, flat])
-        d = self._xor_to(ctx, cands, key)
-        (c_s,) = K.sort_by_distance(d, (cands,))[1]
+        cands = jnp.concatenate([node_idx[None], st.sib, flat])    # [C]
+        ck = ctx.keys[jnp.maximum(cands, 0)]                       # [C, KL]
+        d = ck[None, :, :] ^ keys[:, None, :]                      # [T, C, KL]
+        d = jnp.where((cands == NO_NODE)[None, :, None], UMAX, d)
+        (c_s,) = K.sort_by_distance(
+            d, (jnp.broadcast_to(cands, (t_dim, cands.shape[0])),))[1]
         ready = st.state == READY
-        out = jnp.where(ready, c_s[:rmax], NO_NODE)
+        out = jnp.where(ready, c_s[:, :rmax], NO_NODE)
         r = p.redundant_nodes
         if r < rmax:
-            out = out.at[r:].set(NO_NODE)
+            out = out.at[:, r:].set(NO_NODE)
 
         # isSiblingFor(self, key, numSiblings=1) (Kademlia.cc:888)
         n_sib = jnp.sum((st.sib != NO_NODE).astype(I32))
         full = n_sib >= p.s
-        d_me = (me_key ^ key)[None]
-        d_far = self._xor_to(ctx, st.sib[-1:], key * 0 + me_key)  # xor to me
-        not_ours = full & K.gt(d_me, d_far)[0]
-        d_sib_key = self._xor_to(ctx, st.sib, key)
-        closer_sib = jnp.any(K.lt(d_sib_key, jnp.broadcast_to(d_me, d_sib_key.shape)))
+        d_me = me_key[None, :] ^ keys                              # [T, KL]
+        d_far = self._xor_to(ctx, st.sib[-1:], me_key)             # [1, KL]
+        not_ours = full & K.gt(d_me, jnp.broadcast_to(d_far, d_me.shape))
+        sk = ctx.keys[jnp.maximum(st.sib, 0)]                      # [S, KL]
+        d_sib_key = sk[None, :, :] ^ keys[:, None, :]              # [T, S, KL]
+        d_sib_key = jnp.where((st.sib == NO_NODE)[None, :, None], UMAX,
+                              d_sib_key)
+        closer_sib = jnp.any(
+            K.lt(d_sib_key, jnp.broadcast_to(d_me[:, None, :],
+                                             d_sib_key.shape)), axis=1)
         is_sib = ready & (n_sib < 1) | (ready & ~not_ours & ~closer_sib)
         return out, is_sib
 
@@ -382,6 +425,7 @@ class KademliaLogic:
         rngs = jax.random.split(rng, 8)
         t0 = ctx.t_start
         t_end = ctx.t_end
+        r_in = msgs.valid.shape[0]
 
         def metric_fn(cand_slots, target):
             return self._xor_to(ctx, cand_slots, target)
@@ -391,47 +435,65 @@ class KademliaLogic:
         anyfail_cnt = jnp.int32(0)
         lksucc_cnt = jnp.int32(0)
 
-        # ------------------------------------------------------- inbox -----
-        for r in range(msgs.valid.shape[0]):
-            m = msgs.slot(r)
-            now = m.t_deliver
-            v = m.valid
+        # --------------------------------------------- inbox (batched) -----
+        # All R inbox slots are consumed in ONE pass per handler class —
+        # a masked [R]-batch instead of R unrolled handler chains (the
+        # round-2 graph was op-issue-bound on exactly that unrolling).
+        # Within-window ordering across the slots is already relaxed by
+        # the engine; the batch passes commute the same way.
+        v_r = msgs.valid
+        t_del_r = msgs.t_deliver
 
-            # every received message: routingAdd(src, alive)
-            # (Kademlia.cc:1027/1419)
-            st = select_tree(
-                v, self._routing_add(ctx, st, me_key, node_idx, m.src,
-                                     jnp.bool_(True), now), st)
+        # FindNodeResponses → lookup engine (one batched pass)
+        en_res = v_r & (msgs.kind == wire.FINDNODE_RES)
+        st = dataclasses.replace(st, lk=lk_mod.on_responses(
+            st.lk, dataclasses.replace(msgs, valid=en_res), metric_fn, lcfg))
 
-            # FindNodeCall → findNode + sibling flag
-            en = v & (m.kind == wire.FINDNODE_CALL)
-            res, sib = self._find_node(ctx, st, me_key, node_idx, m.key, rmax)
-            # byzantine switches (common/malicious.py; no-op by default).
-            # Only the wire copy is attacked; the honest ``sib`` feeds the
-            # app deliver check below (wrong-node detection)
-            res_atk, sib_atk, respond = mal_mod.attack_findnode(
-                ctx, self.mp, node_idx, res, sib,
-                jax.random.fold_in(rngs[7], r))
-            ob.send(en & respond, now, m.src, wire.FINDNODE_RES, key=m.key,
-                    a=m.a, b=m.b, c=sib_atk.astype(I32), nodes=res_atk,
-                    size_b=wire.findnode_res_b(p.redundant_nodes))
+        # batched routingAdd (Kademlia.cc:1027/1419): every message source
+        # as a verified contact + every FindNodeResponse payload node as
+        # an unverified learn (Kademlia.cc:1412)
+        learned = jnp.where(en_res[:, None], msgs.nodes[:, :lcfg.frontier],
+                            NO_NODE)                            # [R, F]
+        add_cands = jnp.concatenate(
+            [jnp.where(v_r, msgs.src, NO_NODE), learned.reshape(-1)])
+        add_alive = jnp.concatenate(
+            [jnp.ones((r_in,), bool),
+             jnp.zeros((learned.size,), bool)])
+        now_add = jnp.max(jnp.where(v_r, t_del_r, 0))
+        st = self._routing_add_batch(ctx, st, me_key, node_idx, add_cands,
+                                     add_alive, now_add)
 
-            # FindNodeResponse → lookup engine + unverified learns
-            en = v & (m.kind == wire.FINDNODE_RES)
-            st = dataclasses.replace(st, lk=lk_mod.on_response(
-                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
-            learned = m.nodes[:lcfg.frontier]
-            st = select_tree(
-                en, self._learn_batch(ctx, st, me_key, node_idx, learned,
-                                      learned != NO_NODE, now), st)
+        # FindNodeCalls → batched findNode + sibling flags
+        en_call = v_r & (msgs.kind == wire.FINDNODE_CALL)
+        res_b, sib_b = self._find_node_batch(ctx, st, me_key, node_idx,
+                                             msgs.key, rmax)
+        # byzantine switches (common/malicious.py; statically no-op by
+        # default).  Only the wire copy is attacked; the honest ``sib_b``
+        # feeds the app deliver check below (wrong-node detection)
+        if self.mp.active:
+            res_atk, sib_atk, respond = jax.vmap(
+                lambda rr, ss, rg: mal_mod.attack_findnode(
+                    ctx, self.mp, node_idx, rr, ss, rg))(
+                res_b, sib_b, jax.random.split(rngs[7], r_in))
+        else:
+            res_atk, sib_atk, respond = res_b, sib_b, jnp.ones((r_in,), bool)
+        ob.send(en_call & respond, t_del_r, msgs.src, wire.FINDNODE_RES,
+                key=msgs.key, a=msgs.a, b=msgs.b, c=sib_atk.astype(I32),
+                nodes=res_atk,
+                size_b=wire.findnode_res_b(p.redundant_nodes))
 
-            # app-owned message kinds (Common API deliver path)
-            st = dataclasses.replace(st, app=self.app.on_msg(
-                st.app, m, ctx, ob, ev, sib))
+        # ping (generic liveness)
+        ob.send(v_r & (msgs.kind == wire.PING_CALL), t_del_r, msgs.src,
+                wire.PING_RES, a=msgs.a, size_b=wire.BASE_CALL_B)
 
-            # ping (generic liveness)
-            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
-                    wire.PING_RES, a=m.a, size_b=wire.BASE_CALL_B)
+        # app-owned message kinds (Common API deliver path)
+        if hasattr(self.app, "on_msgs"):
+            st = dataclasses.replace(st, app=self.app.on_msgs(
+                st.app, msgs, ctx, ob, ev, sib_b))
+        else:
+            for r in range(r_in):
+                st = dataclasses.replace(st, app=self.app.on_msg(
+                    st.app, msgs.slot(r), ctx, ob, ev, sib_b[r]))
 
         # ------------------------------------------------------- timers ----
         # join (joinOverlay: lookup own key via bootstrap,
@@ -473,18 +535,10 @@ class KademliaLogic:
             st,
             refresh_dirty=st.refresh_dirty | mark,
             t_refresh=jnp.where(en_r, now_r + refresh_ns, st.t_refresh))
-        # sibling-table refresh: lookup own key when unused for the interval
+        # sibling-table refresh timing: lookup own key when unused for the
+        # interval (start fires below, after the batched findNode)
         sib_stale = en_r & (st.sib_used + jnp.int64(
             int(p.sibling_refresh * NS)) < now_r)
-        no_sib_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_SIB))
-        slot, have = lk_mod.free_slot(st.lk)
-        res0, _ = self._find_node(ctx, st, me_key, node_idx, me_key, rmax)
-        start_sib = sib_stale & no_sib_lk & have & (res0[0] != NO_NODE)
-        st = dataclasses.replace(st, lk=lk_mod.start(
-            st.lk, start_sib, slot, P_SIB, 0, me_key,
-            res0[:lcfg.frontier], now_r, lcfg))
-        st = dataclasses.replace(
-            st, sib_used=jnp.where(start_sib, now_r, st.sib_used))
 
         # app timer
         # graceful-leave: hand app data to the closest sibling and stop
@@ -497,8 +551,34 @@ class KademliaLogic:
         now_a = jnp.maximum(self.app.next_event(st.app), t0)
         app, req = self.app.on_timer(st.app, en_a, ctx, now_a, rngs[3], ev, node_idx)
         st = dataclasses.replace(st, app=app)
-        seed_a, sib_a = self._find_node(ctx, st, me_key, node_idx, req.key,
-                                        rmax)
+
+        # bucket-refresh target (pump below): random key with
+        # sharedPrefixLength(me, target) == bi —
+        # delta = 2^(bits-1-bi) | (rand & (2^(bits-1-bi) - 1)); target=me^delta
+        bi_ref = jnp.argmax(st.refresh_dirty).astype(I32)
+        jbit = jnp.clip(spec.bits - 1 - bi_ref, 0, spec.bits - 1)
+        top = self._pow2[jbit]
+        mask = K.sub(top, K.from_int(1, spec), spec)
+        rnd = K.random_keys(rngs[5], (), spec)
+        target_ref = me_key ^ (top | (rnd & mask))
+
+        # ONE batched findNode for every timer consumer: sibling refresh
+        # (own key), the app lookup seed, and the bucket-refresh seed
+        seeds3, sib3 = self._find_node_batch(
+            ctx, st, me_key, node_idx,
+            jnp.stack([me_key, req.key, target_ref]), rmax)
+        res0, seed_a, seed_r = seeds3[0], seeds3[1], seeds3[2]
+        sib_a = sib3[1]
+
+        # sibling refresh start
+        no_sib_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_SIB))
+        slot, have = lk_mod.free_slot(st.lk)
+        start_sib = sib_stale & no_sib_lk & have & (res0[0] != NO_NODE)
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_sib, slot, P_SIB, 0, me_key,
+            res0[:lcfg.frontier], now_r, lcfg))
+        st = dataclasses.replace(
+            st, sib_used=jnp.where(start_sib, now_r, st.sib_used))
         # local responsibility → full sibling set (top-s of self ∪
         # siblings by XOR distance to the key), matching the responder-side
         # FINDNODE_RES payload so numReplica consumers get the replica set
@@ -533,59 +613,65 @@ class KademliaLogic:
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
         st = dataclasses.replace(st, lk=new_lk)
+        taken = comp["taken"]                                   # [L]
+        suc_l = comp["success"] & (comp["result"] != NO_NODE)
+        pur_l = comp["purpose"]
         comp_hops_ev = (comp["hops"].astype(jnp.float32),
-                        comp["taken"] & comp["success"])
-        for li in range(lcfg.slots):
-            en = comp["taken"][li]
-            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
-            res = comp["result"][li]
-            pur = comp["purpose"][li]
-            lksucc_cnt += (en & suc).astype(I32)
-            anyfail_cnt += (en & ~suc).astype(I32)
+                        taken & comp["success"])
+        lksucc_cnt += jnp.sum((taken & suc_l).astype(I32))
+        anyfail_cnt += jnp.sum((taken & ~suc_l).astype(I32))
 
-            # join completion → READY (even on failure if we learned nodes;
-            # reference joins as long as the sibling table is non-empty)
-            enj = en & (pur == P_JOIN)
-            got = enj & (jnp.any(st.sib != NO_NODE) | suc)
-            joins_cnt += got.astype(I32)
-            st = self._become_ready(ctx, st, got, t0, rngs[4])
-            # join failed with nothing learned → retry via t_join
-            st = dataclasses.replace(st, t_join=jnp.where(
-                enj & ~got, t0 + jnp.int64(int(p.join_delay * NS)),
-                st.t_join))
+        # join completion → READY (even on failure if we learned nodes;
+        # reference joins as long as the sibling table is non-empty).
+        # At most one join lookup exists per node (no_join_lk gate above).
+        enj = taken & (pur_l == P_JOIN)
+        any_j = jnp.any(enj)
+        got = any_j & (jnp.any(st.sib != NO_NODE) | jnp.any(enj & suc_l))
+        joins_cnt += got.astype(I32)
+        st = self._become_ready(ctx, st, got, t0, rngs[4])
+        # join failed with nothing learned → retry via t_join
+        st = dataclasses.replace(st, t_join=jnp.where(
+            any_j & ~got, t0 + jnp.int64(int(p.join_delay * NS)),
+            st.t_join))
 
-            # bucket refresh completion → clear dirty bit
-            enr = en & (pur == P_REFRESH)
-            bi = jnp.clip(comp["aux"][li], 0, p.num_buckets - 1)
-            st = dataclasses.replace(
-                st,
-                refresh_dirty=jnp.where(
-                    enr, st.refresh_dirty.at[bi].set(False),
-                    st.refresh_dirty),
-                b_used=jnp.where(enr, st.b_used.at[bi].set(t0), st.b_used))
+        # bucket refresh completions → clear dirty bits (one scatter)
+        enr_l = taken & (pur_l == P_REFRESH)
+        rows_r = jnp.where(enr_l, jnp.clip(comp["aux"], 0,
+                                           p.num_buckets - 1),
+                           p.num_buckets)
+        st = dataclasses.replace(
+            st,
+            refresh_dirty=st.refresh_dirty.at[rows_r].set(
+                False, mode="drop"),
+            b_used=st.b_used.at[rows_r].set(t0, mode="drop"))
 
-            # app lookup → app completion hook
-            ena = en & (pur == P_APP)
-            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+        # app lookups → app completion hook (batched when the app
+        # supports it; per-slot fold otherwise)
+        ena_l = taken & (pur_l == P_APP)
+        if hasattr(self.app, "on_lookup_done_batch"):
+            st = dataclasses.replace(st, app=self.app.on_lookup_done_batch(
                 st.app, app_base.LookupDone(
-                    en=ena, success=ena & suc, tag=comp["aux"][li],
-                    target=comp["target"][li], results=comp["results"][li],
-                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                    en=ena_l, success=ena_l & suc_l, tag=comp["aux"],
+                    target=comp["target"], results=comp["results"],
+                    hops=comp["hops"], t0=comp["t0"]),
                 ctx, ob, ev, t0, node_idx))
+        else:
+            for li in range(lcfg.slots):
+                st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                    st.app, app_base.LookupDone(
+                        en=ena_l[li], success=ena_l[li] & suc_l[li],
+                        tag=comp["aux"][li], target=comp["target"][li],
+                        results=comp["results"][li], hops=comp["hops"][li],
+                        t0=comp["t0"][li]),
+                    ctx, ob, ev, t0, node_idx))
 
         # ------------------------------------------- bucket refresh pump ---
-        dirty_any = (st.state == READY) & jnp.any(st.refresh_dirty)
+        # target/seed were computed in the batched findNode above; gate on
+        # the POST-completion dirty bit so a bucket whose refresh just
+        # finished is not immediately re-queried
+        dirty_now = st.refresh_dirty[jnp.minimum(bi_ref, p.num_buckets - 1)]
+        dirty_any = (st.state == READY) & dirty_now
         no_ref_lk = ~jnp.any(st.lk.active & (st.lk.purpose == P_REFRESH))
-        bi = jnp.argmax(st.refresh_dirty).astype(I32)
-        # random key with sharedPrefixLength(me, target) == bi:
-        # delta = 2^(bits-1-bi) | (rand & (2^(bits-1-bi) - 1)); target=me^delta
-        jbit = jnp.clip(spec.bits - 1 - bi, 0, spec.bits - 1)
-        top = self._pow2[jbit]
-        mask = K.sub(top, K.from_int(1, spec), spec)
-        rnd = K.random_keys(rngs[5], (), spec)
-        delta = top | (rnd & mask)
-        target = me_key ^ delta
-        seed_r, _ = self._find_node(ctx, st, me_key, node_idx, target, rmax)
         slot, have = lk_mod.free_slot(st.lk)
         start_ref = dirty_any & no_ref_lk & have & (seed_r[0] != NO_NODE)
         # no candidates at all → just clear the bit
@@ -593,10 +679,10 @@ class KademliaLogic:
         st = dataclasses.replace(
             st,
             refresh_dirty=jnp.where(clear_only,
-                                    st.refresh_dirty.at[bi].set(False),
+                                    st.refresh_dirty.at[bi_ref].set(False),
                                     st.refresh_dirty),
-            lk=lk_mod.start(st.lk, start_ref, slot, P_REFRESH, bi, target,
-                            seed_r[:lcfg.frontier], t0, lcfg))
+            lk=lk_mod.start(st.lk, start_ref, slot, P_REFRESH, bi_ref,
+                            target_ref, seed_r[:lcfg.frontier], t0, lcfg))
 
         # ------------------------------------------------------- pump ------
         new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[6], lcfg,
