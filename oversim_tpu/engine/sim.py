@@ -255,11 +255,15 @@ class Simulation:
             drops["dest_unavailable_lost"] + jnp.sum(to_dead))
         counters["pool_overflow"] += pool_overflow
         counters["outbox_overflow"] += jnp.sum(out_overflow)
-        # gauge, not a sum: messages currently backpressured behind full
-        # inboxes (re-counting per tick would inflate it meaninglessly)
-        counters["inbox_deferred"] = (
-            jnp.sum(s.pool.valid & (s.pool.t_deliver < t_end)) -
-            jnp.sum(delivered | to_dead)).astype(jnp.int64)
+        # high-water mark, not a sum: peak count of messages backpressured
+        # behind full inboxes in any one tick (a per-tick sum would count
+        # each waiting message once per tick it waits; a point-in-time
+        # gauge is noise at readout — the peak is stable and still proves
+        # whether the deferral path ever engaged)
+        counters["inbox_deferred"] = jnp.maximum(
+            counters["inbox_deferred"],
+            (jnp.sum(s.pool.valid & (s.pool.t_deliver < t_end)) -
+             jnp.sum(delivered | to_dead)).astype(jnp.int64))
 
         # advance to the window END: anything generated during this tick
         # with a due time inside the window is delivered next tick with

@@ -381,242 +381,331 @@ class ChordLogic:
         anyfail_cnt = jnp.int32(0)  # failed lookups of any purpose
         lksucc_cnt = jnp.int32(0)
 
-        # ------------------------------------------------------- inbox -----
-        for r in range(msgs.valid.shape[0]):
-            m = msgs.slot(r)
-            now = m.t_deliver
-            v = m.valid
+        # --------------------------------------------- inbox (batched) -----
+        # Kind-major batching: each message kind is handled in ONE masked
+        # pass over the R inbox slots (kinds in the original per-slot
+        # order) instead of R unrolled handler chains — the round-2 tick
+        # graph was op-issue-bound on exactly that unrolling (52k eqns).
+        # Within-window ordering across slots is already relaxed by the
+        # engine (engine/sim.py docstring); the kind-major permutation is
+        # the same relaxation.  Each kind's reads see every earlier
+        # kind's writes; response payloads read the state as of their
+        # kind's turn (the unrolled loop exposed mid-loop state the same
+        # way, just slot-major).
+        v_r = msgs.valid                                     # [R]
+        now_r = msgs.t_deliver                               # [R]
+        r_in = v_r.shape[0]
 
-            # FindNodeCall → findNode + sibling flag (findNodeRpc,
-            # BaseOverlay.cc:1841).  When responsible, the response is the
-            # sibling set — ourselves followed by our successor list
-            # (Chord::findNode returns siblings for isSiblingFor keys,
-            # Chord.cc:548-560) — so callers wanting numSiblings replicas
-            # (DHT puts) get the full replica set.  Subclasses (Koorde)
-            # override _respond_find for their own hop choice + lookup
-            # extension handling.
-            en = v & (m.kind == wire.FINDNODE_CALL)
-            res_nodes, sib = self._respond_find(ctx, st, me_key, node_idx,
-                                                m, rmax, pad_nodes)
-            # byzantine switches (common/malicious.py; no-op by default).
-            # The attacked flag only goes on the wire — the honest ``sib``
-            # is reused below for the app deliver check, so an attacker
-            # that lies about responsibility still records a wrong-node
-            # delivery (KBRTestApp.cc:252-286 oracle check)
-            res_atk, sib_atk, respond = mal_mod.attack_findnode(
-                ctx, self.mp, node_idx, res_nodes, sib,
-                jax.random.fold_in(rngs[6], r))
-            n_res = jnp.sum((res_atk != NO_NODE).astype(I32))
-            ob.send(en & respond, now, m.src, wire.FINDNODE_RES, key=m.key,
-                    a=m.a, b=m.b, c=sib_atk.astype(I32), nodes=res_atk,
-                    size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
+        # FindNodeCall -> findNode + sibling flag (findNodeRpc,
+        # BaseOverlay.cc:1841), vmapped over inbox slots.  Subclasses
+        # (Koorde) override _respond_find for their own hop choice +
+        # lookup extension handling.
+        en_call = v_r & (msgs.kind == wire.FINDNODE_CALL)
+        res_b, sib_b = jax.vmap(
+            lambda mm: self._respond_find(ctx, st, me_key, node_idx, mm,
+                                          rmax, pad_nodes))(msgs)
+        # byzantine switches (common/malicious.py; statically no-op by
+        # default).  Only the wire copy is attacked; the honest ``sib_b``
+        # feeds the app deliver check below (wrong-node detection,
+        # KBRTestApp.cc:252-286 oracle check)
+        if self.mp.active:
+            res_atk, sib_atk, respond = jax.vmap(
+                lambda rr, ss, rg: mal_mod.attack_findnode(
+                    ctx, self.mp, node_idx, rr, ss, rg))(
+                res_b, sib_b, jax.random.split(rngs[6], r_in))
+        else:
+            res_atk, sib_atk, respond = res_b, sib_b, jnp.ones((r_in,), bool)
+        n_res = jnp.sum((res_atk != NO_NODE).astype(I32), axis=1)
+        ob.send(en_call & respond, now_r, msgs.src, wire.FINDNODE_RES,
+                key=msgs.key, a=msgs.a, b=msgs.b, c=sib_atk.astype(I32),
+                nodes=res_atk,
+                size_b=wire.BASE_CALL_B + 1 + wire.NODEHANDLE_B * n_res)
 
-            # FindNodeResponse → lookup engine
-            en = v & (m.kind == wire.FINDNODE_RES)
-            st = dataclasses.replace(st, lk=lk_mod.on_response(
-                st.lk, dataclasses.replace(m, valid=en), metric_fn, lcfg))
+        # FindNodeResponse -> lookup engine (one batched pass)
+        en_res = v_r & (msgs.kind == wire.FINDNODE_RES)
+        st = dataclasses.replace(st, lk=lk_mod.on_responses(
+            st.lk, dataclasses.replace(msgs, valid=en_res), metric_fn, lcfg))
 
-            # JoinCall (rpcJoin, Chord.cc:917) — response compiled BEFORE
-            # the aggressive-join mutations (reference order).
-            #
-            # RESPONSIBILITY GUARD: the reference's JoinCall is ROUTED to
-            # the joiner's key, so the receiver is the responsible node
-            # by construction; our joiner sends directly to its lookup
-            # result, which can be stale during mass joins.  Accepting a
-            # joiner whose key is NOT in (pred, me] would drag pred
-            # backwards, widen this node's claimed range, attract more
-            # mis-routed joins, and cascade into a loopy succ
-            # permutation that weak stabilization provably cannot repair
-            # (observed: N=64 interleaved-ring fixed point).  A
-            # non-responsible receiver stays silent; the joiner's join
-            # timer retries with a fresh lookup.
-            en = v & (m.kind == wire.CHORD_JOIN_CALL) & (st.state == READY)
-            alone = (st.pred == NO_NODE) & (st.succ[0] == NO_NODE)
-            jk = ctx.keys[jnp.maximum(m.src, 0)]
-            pk_j = ctx.keys[jnp.maximum(st.pred, 0)]
-            responsible = alone | (st.pred == NO_NODE) | K.is_between(
-                jk, pk_j, me_key, spec)
-            en = en & responsible
-            pred_hint = jnp.where(alone, node_idx, st.pred)
-            ob.send(en, now, m.src, wire.CHORD_JOIN_RES, a=pred_hint,
-                    nodes=pad_nodes(st.succ),
-                    size_b=wire.BASE_CALL_B
-                    + wire.NODEHANDLE_B * (p.succ_size + 1))
-            if p.aggressive_join:
-                ob.send(en & (st.pred != NO_NODE), now, st.pred,
-                        wire.CHORD_SUCC_HINT, a=m.src,
-                        size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
-                pred2 = jnp.where(en, m.src, st.pred)
-            else:
-                pred2 = st.pred
-            succ2 = jnp.where(en & (st.succ[0] == NO_NODE),
-                              st.succ.at[0].set(m.src), st.succ)
-            st = dataclasses.replace(st, pred=pred2, succ=succ2)
-
-            # JoinResponse (handleRpcJoinResponse)
-            en = v & (m.kind == wire.CHORD_JOIN_RES) & (st.state == JOINING)
-            succ3 = self._succ_sorted(
-                ctx, me_key, node_idx,
-                jnp.concatenate([m.nodes[:p.succ_size], m.src[None]]))
-            got_succ = en & (succ3[0] != NO_NODE)
-            joins_cnt += got_succ.astype(I32)
-            st = dataclasses.replace(
-                st,
-                succ=jnp.where(got_succ, succ3, st.succ),
-                pred=jnp.where(got_succ & (m.a != NO_NODE)
-                               & jnp.bool_(p.aggressive_join), m.a, st.pred))
-            st = self._become_ready(ctx, st, got_succ, now, rngs[0])
-
-            # StabilizeCall → reply with predecessor (rpcStabilize)
-            en = v & (m.kind == wire.CHORD_STABILIZE_CALL) & (
-                st.state == READY)
-            ob.send(en, now, m.src, wire.CHORD_STABILIZE_RES, a=st.pred,
+        # JoinCall (rpcJoin, Chord.cc:917) — response compiled BEFORE
+        # the aggressive-join mutations (reference order).
+        #
+        # RESPONSIBILITY GUARD: the reference's JoinCall is ROUTED to
+        # the joiner's key, so the receiver is the responsible node
+        # by construction; our joiner sends directly to its lookup
+        # result, which can be stale during mass joins.  Accepting a
+        # joiner whose key is NOT in (pred, me] would drag pred
+        # backwards, widen this node's claimed range, attract more
+        # mis-routed joins, and cascade into a loopy succ
+        # permutation that weak stabilization provably cannot repair
+        # (observed: N=64 interleaved-ring fixed point).  A
+        # non-responsible receiver stays silent; the joiner's join
+        # timer retries with a fresh lookup.
+        en = v_r & (msgs.kind == wire.CHORD_JOIN_CALL) & (st.state == READY)
+        alone = (st.pred == NO_NODE) & (st.succ[0] == NO_NODE)
+        jk = ctx.keys[jnp.maximum(msgs.src, 0)]              # [R, KL]
+        pk_j = ctx.keys[jnp.maximum(st.pred, 0)]
+        responsible = alone | (st.pred == NO_NODE) | K.is_between(
+            jk, jnp.broadcast_to(pk_j, jk.shape),
+            jnp.broadcast_to(me_key, jk.shape), spec)
+        en = en & responsible
+        pred_hint = jnp.where(alone, node_idx, st.pred)
+        ob.send(en, now_r, msgs.src, wire.CHORD_JOIN_RES, a=pred_hint,
+                nodes=pad_nodes(st.succ),
+                size_b=wire.BASE_CALL_B
+                + wire.NODEHANDLE_B * (p.succ_size + 1))
+        if p.aggressive_join:
+            # the sequential fold adopted each joiner in slot order and
+            # sent each SUCC_HINT to the predecessor adopted SO FAR —
+            # chaining pred -> j1 -> j2.  Reproduce the chain: joiner k's
+            # hint goes to the previous enabled joiner (k=0: the pre-tick
+            # predecessor), so each ex-predecessor learns its new
+            # successor and the ring stays linked through a mass join.
+            idxs = jnp.arange(r_in, dtype=I32)
+            cm = jax.lax.cummax(jnp.where(en, idxs, -1))
+            prev = jnp.concatenate([jnp.full((1,), -1, I32), cm[:-1]])
+            hint_dst = jnp.where(prev >= 0,
+                                 msgs.src[jnp.maximum(prev, 0)], st.pred)
+            ob.send(en & (hint_dst != NO_NODE), now_r, hint_dst,
+                    wire.CHORD_SUCC_HINT, a=msgs.src,
                     size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+            # final adopted predecessor = the LAST enabled joiner
+            any_en = jnp.any(en)
+            last_j = r_in - 1 - jnp.argmax(en[::-1]).astype(I32)
+            pred2 = jnp.where(any_en,
+                              msgs.src[jnp.clip(last_j, 0, r_in - 1)],
+                              st.pred)
+        else:
+            pred2 = st.pred
+        # empty successor list is seeded by the FIRST enabled joiner
+        first_j = jnp.clip(jnp.argmax(en).astype(I32), 0, r_in - 1)
+        succ2 = jnp.where(jnp.any(en) & (st.succ[0] == NO_NODE),
+                          st.succ.at[0].set(msgs.src[first_j]), st.succ)
+        st = dataclasses.replace(st, pred=pred2, succ=succ2)
 
-            # StabilizeResponse (handleRpcStabilizeResponse)
-            en = v & (m.kind == wire.CHORD_STABILIZE_RES) & (
-                st.state == READY) & (st.stab_op == 1) & (m.src == st.stab_dst)
-            cand = m.a
-            ck = ctx.keys[jnp.maximum(cand, 0)]
-            s0 = st.succ[0]
-            s0k = ctx.keys[jnp.maximum(s0, 0)]
-            succ_empty = s0 == NO_NODE
-            adopt = (cand != NO_NODE) & (succ_empty | K.is_between(
-                ck, me_key, s0k, spec))
-            new_node = jnp.where(adopt, cand,
-                                 jnp.where(succ_empty, m.src, NO_NODE))
-            succ4 = self._succ_add(ctx, me_key, node_idx, st.succ, new_node,
-                                   en)
-            succ4 = jnp.where(en, succ4, st.succ)
-            # notify the (possibly new) successor
-            ob.send(en & (succ4[0] != NO_NODE), now, succ4[0],
-                    wire.CHORD_NOTIFY_CALL,
-                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
-            st = dataclasses.replace(
-                st, succ=succ4,
-                stab_op=jnp.where(en, 2, st.stab_op),
-                stab_dst=jnp.where(en, succ4[0], st.stab_dst),
-                stab_to=jnp.where(en, now + rpc_to_ns, st.stab_to))
+        # JoinResponse (handleRpcJoinResponse): merge every enabled
+        # response's successor candidates in one sorted pass
+        en = v_r & (msgs.kind == wire.CHORD_JOIN_RES) & (st.state == JOINING)
+        cand_jr = jnp.where(
+            en[:, None],
+            jnp.concatenate([msgs.nodes[:, :p.succ_size],
+                             msgs.src[:, None]], axis=1),
+            NO_NODE).reshape(-1)                             # [R*(S+1)]
+        succ3 = self._succ_sorted(ctx, me_key, node_idx, cand_jr)
+        got_succ = jnp.any(en) & (succ3[0] != NO_NODE)
+        joins_cnt += got_succ.astype(I32)
+        hint_ok = en & (msgs.a != NO_NODE)
+        last_h = jnp.clip(r_in - 1 - jnp.argmax(hint_ok[::-1]).astype(I32),
+                          0, r_in - 1)
+        st = dataclasses.replace(
+            st,
+            succ=jnp.where(got_succ, succ3, st.succ),
+            pred=jnp.where(got_succ & jnp.any(hint_ok)
+                           & jnp.bool_(p.aggressive_join),
+                           msgs.a[last_h], st.pred))
+        st = self._become_ready(ctx, st, got_succ,
+                                jnp.max(jnp.where(en, now_r, 0)), rngs[0])
 
-            # NotifyCall (rpcNotify): adopt closer predecessor, reply with
-            # successor list
-            en = v & (m.kind == wire.CHORD_NOTIFY_CALL) & (st.state == READY)
-            sk = ctx.keys[jnp.maximum(m.src, 0)]
-            pk = ctx.keys[jnp.maximum(st.pred, 0)]
-            newpred = en & ((st.pred == NO_NODE)
-                            | K.is_between(sk, pk, me_key, spec))
-            succ5 = jnp.where(newpred & (st.succ[0] == NO_NODE),
-                              st.succ.at[0].set(m.src), st.succ)
-            st = dataclasses.replace(
-                st, pred=jnp.where(newpred, m.src, st.pred), succ=succ5)
-            ob.send(en, now, m.src, wire.CHORD_NOTIFY_RES,
-                    nodes=pad_nodes(st.succ),
-                    size_b=wire.BASE_CALL_B
-                    + wire.NODEHANDLE_B * (p.succ_size + 1))
+        # StabilizeCall -> reply with predecessor (rpcStabilize)
+        en = v_r & (msgs.kind == wire.CHORD_STABILIZE_CALL) & (
+            st.state == READY)
+        ob.send(en, now_r, msgs.src, wire.CHORD_STABILIZE_RES, a=st.pred,
+                size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
 
-            # NotifyResponse (handleRpcNotifyResponse): replace successor
-            # list with successor's list
-            en = v & (m.kind == wire.CHORD_NOTIFY_RES) & (
-                st.state == READY) & (st.stab_op == 2) & (
-                m.src == st.stab_dst) & (m.src == st.succ[0])
-            succ6 = self._succ_sorted(
-                ctx, me_key, node_idx,
-                jnp.concatenate([m.nodes[:p.succ_size], m.src[None]]))
-            fin = v & (m.kind == wire.CHORD_NOTIFY_RES) & (st.stab_op == 2) & (
-                m.src == st.stab_dst)
-            st = dataclasses.replace(
-                st, succ=jnp.where(en, succ6, st.succ),
-                stab_op=jnp.where(fin, 0, st.stab_op),
-                stab_to=jnp.where(fin, T_INF, st.stab_to))
+        # StabilizeResponse (handleRpcStabilizeResponse): at most one
+        # inbox slot matches the single in-flight stabilize RPC
+        en_sr = v_r & (msgs.kind == wire.CHORD_STABILIZE_RES) & (
+            st.state == READY) & (st.stab_op == 1) & (
+            msgs.src == st.stab_dst)
+        any_sr = jnp.any(en_sr)
+        r_sr = jnp.clip(jnp.argmax(en_sr).astype(I32), 0, r_in - 1)
+        src_sr = msgs.src[r_sr]
+        now_sr = msgs.t_deliver[r_sr]
+        cand = msgs.a[r_sr]
+        ck = ctx.keys[jnp.maximum(cand, 0)]
+        s0 = st.succ[0]
+        s0k = ctx.keys[jnp.maximum(s0, 0)]
+        succ_empty = s0 == NO_NODE
+        adopt = (cand != NO_NODE) & (succ_empty | K.is_between(
+            ck, me_key, s0k, spec))
+        new_node = jnp.where(adopt, cand,
+                             jnp.where(succ_empty, src_sr, NO_NODE))
+        succ4 = self._succ_add(ctx, me_key, node_idx, st.succ, new_node,
+                               any_sr)
+        succ4 = jnp.where(any_sr, succ4, st.succ)
+        # notify the (possibly new) successor
+        ob.send(any_sr & (succ4[0] != NO_NODE), now_sr, succ4[0],
+                wire.CHORD_NOTIFY_CALL,
+                size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+        st = dataclasses.replace(
+            st, succ=succ4,
+            stab_op=jnp.where(any_sr, 2, st.stab_op),
+            stab_dst=jnp.where(any_sr, succ4[0], st.stab_dst),
+            stab_to=jnp.where(any_sr, now_sr + rpc_to_ns, st.stab_to))
 
-            # NewSuccessorHint (handleNewSuccessorHint)
-            en = v & (m.kind == wire.CHORD_SUCC_HINT) & (st.state == READY)
-            hk = ctx.keys[jnp.maximum(m.a, 0)]
-            s0k2 = ctx.keys[jnp.maximum(st.succ[0], 0)]
-            take = en & (m.a != NO_NODE) & (
-                (st.succ[0] == NO_NODE)
-                | K.is_between(hk, me_key, s0k2, spec))
-            st = dataclasses.replace(st, succ=jnp.where(
-                take, self._succ_add(ctx, me_key, node_idx, st.succ, m.a,
-                                     take), st.succ))
+        # NotifyCall (rpcNotify): adopt closer predecessor, reply with
+        # successor list.  The sequential fold adopts every strictly
+        # closer notifier in turn; its fixed point is the clockwise-
+        # closest enabled source — pick it with one distance argmin.
+        en = v_r & (msgs.kind == wire.CHORD_NOTIFY_CALL) & (
+            st.state == READY)
+        sk = ctx.keys[jnp.maximum(msgs.src, 0)]              # [R, KL]
+        pk = ctx.keys[jnp.maximum(st.pred, 0)]
+        closer = en & ((st.pred == NO_NODE) | K.is_between(
+            sk, jnp.broadcast_to(pk, sk.shape),
+            jnp.broadcast_to(me_key, sk.shape), spec))
+        d_nc = K.sub(jnp.broadcast_to(me_key, sk.shape), sk, spec)
+        d_nc = jnp.where(closer[:, None], d_nc, UMAX)
+        best_r = _lex_argmin(d_nc)
+        any_nc = jnp.any(closer)
+        newpred_src = msgs.src[best_r]
+        succ5 = jnp.where(any_nc & (st.succ[0] == NO_NODE),
+                          st.succ.at[0].set(newpred_src), st.succ)
+        st = dataclasses.replace(
+            st, pred=jnp.where(any_nc, newpred_src, st.pred), succ=succ5)
+        ob.send(en, now_r, msgs.src, wire.CHORD_NOTIFY_RES,
+                nodes=pad_nodes(st.succ),
+                size_b=wire.BASE_CALL_B
+                + wire.NODEHANDLE_B * (p.succ_size + 1))
 
-            # KBR broadcast (Chord::forwardBroadcast, Chord.cc:1410-1446):
-            # walk fingers+successors by DESCENDING clockwise distance;
-            # every candidate inside (me, limit) gets a copy whose limit
-            # is the previous candidate, shrinking the covered range.
-            # Fan-out is capped at BCAST_FANOUT copies with the closest
-            # successor always last so the near range stays covered
-            # (distinct fingers ≈ log N; the cap only binds at huge N).
-            en_b = v & (m.kind == wire.BROADCAST) & (st.state == READY)
-            bc = jnp.concatenate([st.finger, st.succ])
-            bck = ctx.keys[jnp.maximum(bc, 0)]
-            me_bb = jnp.broadcast_to(me_key, bck.shape)
-            lim_b = jnp.broadcast_to(m.key, bck.shape)
-            ok_b = (bc != NO_NODE) & (bc != node_idx) & ~K.dup_mask(bc) \
+        # NotifyResponse (handleRpcNotifyResponse): replace successor
+        # list with successor's list; at most one slot matches the
+        # in-flight notify
+        fin_m = v_r & (msgs.kind == wire.CHORD_NOTIFY_RES) & (
+            st.stab_op == 2) & (msgs.src == st.stab_dst)
+        any_fin = jnp.any(fin_m)
+        r_nr = jnp.clip(jnp.argmax(fin_m).astype(I32), 0, r_in - 1)
+        take_nr = any_fin & (st.state == READY) & (
+            msgs.src[r_nr] == st.succ[0])
+        succ6 = self._succ_sorted(
+            ctx, me_key, node_idx,
+            jnp.concatenate([msgs.nodes[r_nr][:p.succ_size],
+                             msgs.src[r_nr][None]]))
+        st = dataclasses.replace(
+            st, succ=jnp.where(take_nr, succ6, st.succ),
+            stab_op=jnp.where(any_fin, 0, st.stab_op),
+            stab_to=jnp.where(any_fin, T_INF, st.stab_to))
+
+        # NewSuccessorHint (handleNewSuccessorHint): adopt hinted nodes
+        # inside (me, succ0) — batch = one sorted merge of all taken
+        # hints.  Documented deviation from the sequential fold: the fold
+        # re-checks each hint against the SHRINKING (me, succ0) interval,
+        # so with two same-tick hints h1 < h2 < succ0 it would adopt only
+        # h1; the batch checks both against the pre-tick succ0 and keeps
+        # both (h2 is still a valid, closer-than-old-succ0 successor that
+        # the next stabilize round would have learned anyway).
+        en = v_r & (msgs.kind == wire.CHORD_SUCC_HINT) & (st.state == READY)
+        hk = ctx.keys[jnp.maximum(msgs.a, 0)]
+        s0k2 = ctx.keys[jnp.maximum(st.succ[0], 0)]
+        take = en & (msgs.a != NO_NODE) & (
+            (st.succ[0] == NO_NODE)
+            | K.is_between(hk, jnp.broadcast_to(me_key, hk.shape),
+                           jnp.broadcast_to(s0k2, hk.shape), spec))
+        succ7 = self._succ_sorted(
+            ctx, me_key, node_idx,
+            jnp.concatenate([st.succ, jnp.where(take, msgs.a, NO_NODE)]))
+        st = dataclasses.replace(
+            st, succ=jnp.where(jnp.any(take), succ7, st.succ))
+
+        # KBR broadcast (Chord::forwardBroadcast, Chord.cc:1410-1446):
+        # walk fingers+successors by DESCENDING clockwise distance;
+        # every candidate inside (me, limit) gets a copy whose limit
+        # is the previous candidate, shrinking the covered range.
+        # Fan-out is capped at BCAST_FANOUT copies with the closest
+        # successor always last so the near range stays covered
+        # (distinct fingers ~ log N; the cap only binds at huge N).
+        # The per-slot fanout walk is vmapped; all copies leave in one
+        # vector send.
+        en_b = v_r & (msgs.kind == wire.BROADCAST) & (st.state == READY)
+        bc = jnp.concatenate([st.finger, st.succ])
+        bck = ctx.keys[jnp.maximum(bc, 0)]
+        me_bb = jnp.broadcast_to(me_key, bck.shape)
+        dup_bc = K.dup_mask(bc)
+        d_bc = K.sub(bck, me_bb, spec)          # cw distance me -> cand
+
+        def _bcast_slot(mkey, enb):
+            lim_b = jnp.broadcast_to(mkey, bck.shape)
+            ok_b = (bc != NO_NODE) & (bc != node_idx) & ~dup_bc \
                 & K.is_between(bck, me_bb, lim_b, spec)
-            d_b = K.sub(bck, me_bb, spec)          # cw distance me → cand
-            d_b = jnp.where(ok_b[:, None], d_b, jnp.zeros_like(d_b))
-            (bc_s,) = _sort_lanes(d_b, (jnp.where(ok_b, bc, NO_NODE),))
-            # bc_s ascending by distance with invalid entries (distance
-            # zeroed) at the head; the valid tail holds the real
-            # candidates — walk it from the far end
-            limit = m.key
+            db = jnp.where(ok_b[:, None], d_bc, jnp.zeros_like(d_bc))
+            (bc_s,) = _sort_lanes(db, (jnp.where(ok_b, bc, NO_NODE),))
             n_ok = jnp.sum(ok_b.astype(I32))
-            for j in range(BCAST_FANOUT):
-                idx_j = jnp.clip(bc_s.shape[0] - 1 - j, 0,
-                                 bc_s.shape[0] - 1)
-                tgt_j = jnp.where(j < n_ok, bc_s[idx_j], NO_NODE)
-                fire_b = en_b & (tgt_j != NO_NODE)
-                ob.send(fire_b, now, tgt_j, wire.BROADCAST, key=limit,
-                        a=m.a, b=m.b, hops=m.hops + 1,
-                        size_b=wire.BASE_CALL_B + 20)
-                limit = jnp.where(fire_b, ctx.keys[jnp.maximum(tgt_j, 0)],
-                                  limit)
+            cdim = bc_s.shape[0]
+            j = jnp.arange(BCAST_FANOUT, dtype=I32)
+            idx_j = jnp.clip(cdim - 1 - j, 0, cdim - 1)
+            tgt = jnp.where(j < n_ok, bc_s[idx_j], NO_NODE)  # far -> near
+            # copy j's limit = the previous copy's target key (j=0: mkey)
+            tk = ctx.keys[jnp.maximum(tgt, 0)]               # [F, KL]
+            lim = jnp.concatenate([mkey[None], tk[:-1]], axis=0)
+            fire = enb & (tgt != NO_NODE)
             # cap bound (> FANOUT candidates): one extra copy to the
             # NEAREST candidate carries the remaining (me, limit) range,
             # which it re-splits recursively — without it the near range
-            # would never see the broadcast
-            near = bc_s[jnp.clip(bc_s.shape[0] - n_ok, 0,
-                                 bc_s.shape[0] - 1)]
-            fire_n = en_b & (n_ok > BCAST_FANOUT) & (near != NO_NODE)
-            ob.send(fire_n, now, jnp.maximum(near, 0), wire.BROADCAST,
-                    key=limit, a=m.a, b=m.b, hops=m.hops + 1,
-                    size_b=wire.BASE_CALL_B + 20)
+            # would never see the broadcast.  fire_n requires n_ok >
+            # FANOUT, so the last fired copy is always index FANOUT-1.
+            near = bc_s[jnp.clip(cdim - n_ok, 0, cdim - 1)]
+            fire_n = enb & (n_ok > BCAST_FANOUT) & (near != NO_NODE)
+            lim_n = tk[BCAST_FANOUT - 1]
+            return tgt, lim, fire, near, fire_n, lim_n
 
-            # app-owned message kinds (Common API deliver path,
-            # BaseApp::handleCommonAPIMessage).  Reuse the findNode
-            # sibling flag computed for this slot above: no handler
-            # between there and here fires for an app kind, so the state
-            # it read is unchanged.
-            st = dataclasses.replace(st, app=self.app.on_msg(
-                st.app, m, ctx, ob, ev, sib))
+        tgt_v, lim_v, fire_v, near_v, firen_v, limn_v = jax.vmap(
+            _bcast_slot)(msgs.key, en_b)
+        bshape = (r_in, BCAST_FANOUT)
+        ob.send(fire_v.reshape(-1),
+                jnp.broadcast_to(now_r[:, None], bshape).reshape(-1),
+                tgt_v.reshape(-1), wire.BROADCAST,
+                key=lim_v.reshape(r_in * BCAST_FANOUT, -1),
+                a=jnp.broadcast_to(msgs.a[:, None], bshape).reshape(-1),
+                b=jnp.broadcast_to(msgs.b[:, None], bshape).reshape(-1),
+                hops=jnp.broadcast_to((msgs.hops + 1)[:, None],
+                                      bshape).reshape(-1),
+                size_b=wire.BASE_CALL_B + 20)
+        ob.send(firen_v, now_r, jnp.maximum(near_v, 0), wire.BROADCAST,
+                key=limn_v, a=msgs.a, b=msgs.b, hops=msgs.hops + 1,
+                size_b=wire.BASE_CALL_B + 20)
 
-            # ping (predecessor liveness + generic); the response
-            # piggybacks this node's Vivaldi coordinates (the reference
-            # attaches ncsInfo[] to every RPC response,
-            # CommonMessages.msg:233 / NeighborCache piggybacking)
-            ob.send(v & (m.kind == wire.PING_CALL), now, m.src,
-                    wire.PING_RES, a=m.a,
-                    key=ncs_mod.pack_wire(st.ncs.coords, st.ncs.error,
-                                          spec.lanes),
-                    size_b=wire.BASE_CALL_B + 4 * (self.ncs.dims + 1))
-            en = v & (m.kind == wire.PING_RES) & (m.src == st.cp_dst)
-            rtt_s = (now - st.cp_sent).astype(jnp.float32) / NS
-            nc_row = dict(peer=st.nc.peer, rtt_mean=st.nc.rtt_mean,
-                          rtt_var=st.nc.rtt_var, last=st.nc.last,
-                          live=st.nc.live)
-            nc_row = nc_mod.insert_rtt(nc_row, m.src, rtt_s, now, en)
-            st = dataclasses.replace(st, nc=nc_mod.NcState(**nc_row))
-            if self.ncs.ncs_type in ("vivaldi", "svivaldi"):
-                xj, ej = ncs_mod.unpack_wire(m.key, self.ncs.dims)
-                me_ncs = dict(coords=st.ncs.coords, height=st.ncs.height,
-                              error=st.ncs.error, loss=st.ncs.loss)
-                upd = ncs_mod.update(me_ncs, jnp.where(en, rtt_s, -1.0),
-                                     xj, ej, jnp.float32(0.0), self.ncs)
-                st = dataclasses.replace(st, ncs=ncs_mod.NcsState(**upd))
-            st = dataclasses.replace(
-                st, cp_to=jnp.where(en, T_INF, st.cp_to),
-                cp_dst=jnp.where(en, NO_NODE, st.cp_dst))
+        # app-owned message kinds (Common API deliver path,
+        # BaseApp::handleCommonAPIMessage), with the per-slot findNode
+        # sibling flags computed above
+        if hasattr(self.app, "on_msgs"):
+            st = dataclasses.replace(st, app=self.app.on_msgs(
+                st.app, msgs, ctx, ob, ev, sib_b))
+        else:
+            for r in range(r_in):
+                st = dataclasses.replace(st, app=self.app.on_msg(
+                    st.app, msgs.slot(r), ctx, ob, ev, sib_b[r]))
+
+        # ping (predecessor liveness + generic); the response piggybacks
+        # this node's Vivaldi coordinates (the reference attaches
+        # ncsInfo[] to every RPC response, CommonMessages.msg:233 /
+        # NeighborCache piggybacking)
+        ob.send(v_r & (msgs.kind == wire.PING_CALL), now_r, msgs.src,
+                wire.PING_RES, a=msgs.a,
+                key=ncs_mod.pack_wire(st.ncs.coords, st.ncs.error,
+                                      spec.lanes),
+                size_b=wire.BASE_CALL_B + 4 * (self.ncs.dims + 1))
+        # ping response: at most one slot matches the in-flight
+        # predecessor ping
+        en_p = v_r & (msgs.kind == wire.PING_RES) & (msgs.src == st.cp_dst)
+        any_p = jnp.any(en_p)
+        r_p = jnp.clip(jnp.argmax(en_p).astype(I32), 0, r_in - 1)
+        now_p = msgs.t_deliver[r_p]
+        rtt_s = (now_p - st.cp_sent).astype(jnp.float32) / NS
+        nc_row = dict(peer=st.nc.peer, rtt_mean=st.nc.rtt_mean,
+                      rtt_var=st.nc.rtt_var, last=st.nc.last,
+                      live=st.nc.live)
+        nc_row = nc_mod.insert_rtt(nc_row, msgs.src[r_p], rtt_s, now_p,
+                                   any_p)
+        st = dataclasses.replace(st, nc=nc_mod.NcState(**nc_row))
+        if self.ncs.ncs_type in ("vivaldi", "svivaldi"):
+            xj, ej = ncs_mod.unpack_wire(msgs.key[r_p], self.ncs.dims)
+            me_ncs = dict(coords=st.ncs.coords, height=st.ncs.height,
+                          error=st.ncs.error, loss=st.ncs.loss)
+            upd = ncs_mod.update(me_ncs, jnp.where(any_p, rtt_s, -1.0),
+                                 xj, ej, jnp.float32(0.0), self.ncs)
+            st = dataclasses.replace(st, ncs=ncs_mod.NcsState(**upd))
+        st = dataclasses.replace(
+            st, cp_to=jnp.where(any_p, T_INF, st.cp_to),
+            cp_dst=jnp.where(any_p, NO_NODE, st.cp_dst))
 
         # ------------------------------------------------------- timers ----
         t_end = ctx.t_end
@@ -757,44 +846,56 @@ class ChordLogic:
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
         st = dataclasses.replace(st, lk=new_lk)
+        taken = comp["taken"]                                # [L]
+        suc_l = comp["success"] & (comp["result"] != NO_NODE)
+        pur_l = comp["purpose"]
+        res_l = comp["result"]
         comp_hops_ev = (comp["hops"].astype(jnp.float32),
-                        comp["taken"] & comp["success"])
-        for li in range(lcfg.slots):
-            en = comp["taken"][li]
-            suc = comp["success"][li] & (comp["result"][li] != NO_NODE)
-            res = comp["result"][li]
-            pur = comp["purpose"][li]
-            lksucc_cnt += (en & suc).astype(I32)
-            anyfail_cnt += (en & ~suc).astype(I32)
+                        taken & comp["success"])
+        lksucc_cnt += jnp.sum((taken & suc_l).astype(I32))
+        anyfail_cnt += jnp.sum((taken & ~suc_l).astype(I32))
 
-            # join: contact our successor directly
-            ob.send(en & suc & (pur == P_JOIN), t0, res,
-                    wire.CHORD_JOIN_CALL,
-                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+        # join: contact our successor directly (one vector send)
+        ob.send(taken & suc_l & (pur_l == P_JOIN), t0, res_l,
+                wire.CHORD_JOIN_CALL,
+                size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
 
-            # finger repair result
-            enf = en & (pur == P_FINGER)
-            fi = jnp.clip(comp["aux"][li], 0, spec.bits - 1)
-            st = dataclasses.replace(
-                st,
-                finger=jnp.where(enf & suc,
-                                 st.finger.at[fi].set(res), st.finger),
-                finger_dirty=jnp.where(
-                    enf, st.finger_dirty.at[fi].set(False),
-                    st.finger_dirty))
+        # finger repair results (one scatter per field)
+        enf = taken & (pur_l == P_FINGER)
+        fi_l = jnp.clip(comp["aux"], 0, spec.bits - 1)
+        st = dataclasses.replace(
+            st,
+            finger=st.finger.at[jnp.where(enf & suc_l, fi_l, spec.bits)]
+            .set(res_l, mode="drop"),
+            finger_dirty=st.finger_dirty
+            .at[jnp.where(enf, fi_l, spec.bits)].set(False, mode="drop"))
 
-            # app lookup → app completion hook
-            ena = en & (pur == P_APP)
-            st = dataclasses.replace(st, app=self.app.on_lookup_done(
+        # app lookups → app completion hook (batched when supported)
+        ena_l = taken & (pur_l == P_APP)
+        if hasattr(self.app, "on_lookup_done_batch"):
+            st = dataclasses.replace(st, app=self.app.on_lookup_done_batch(
                 st.app, app_base.LookupDone(
-                    en=ena, success=ena & suc, tag=comp["aux"][li],
-                    target=comp["target"][li], results=comp["results"][li],
-                    hops=comp["hops"][li], t0=comp["t0"][li]),
+                    en=ena_l, success=ena_l & suc_l, tag=comp["aux"],
+                    target=comp["target"], results=comp["results"],
+                    hops=comp["hops"], t0=comp["t0"]),
                 ctx, ob, ev, t0, node_idx))
+        else:
+            for li in range(lcfg.slots):
+                st = dataclasses.replace(st, app=self.app.on_lookup_done(
+                    st.app, app_base.LookupDone(
+                        en=ena_l[li], success=ena_l[li] & suc_l[li],
+                        tag=comp["aux"][li], target=comp["target"][li],
+                        results=comp["results"][li], hops=comp["hops"][li],
+                        t0=comp["t0"][li]),
+                    ctx, ob, ev, t0, node_idx))
 
-            # subclass purposes (Koorde de Bruijn resolution)
-            st = self._on_completion(ctx, st, ob, li, comp, en, suc, res,
-                                     t0)
+        # subclass purposes (Koorde de Bruijn resolution) — the per-slot
+        # hook only traces when a subclass actually overrides it
+        if type(self)._on_completion is not ChordLogic._on_completion:
+            for li in range(lcfg.slots):
+                st = self._on_completion(
+                    ctx, st, ob, li, comp, taken[li], suc_l[li], res_l[li],
+                    t0)
 
         # -------------------------------------------- finger repair pump ---
         dirty_any = (st.state == READY) & jnp.any(st.finger_dirty)
