@@ -34,8 +34,8 @@ import subprocess
 import sys
 import time
 
-PROBE_TIMEOUT_S = int(os.environ.get("OVERSIM_BENCH_PROBE_TIMEOUT", 30))
-DEADLINE_S = int(os.environ.get("OVERSIM_BENCH_DEADLINE", 300))
+PROBE_TIMEOUT_S = int(os.environ.get("OVERSIM_BENCH_PROBE_TIMEOUT", 25))
+DEADLINE_S = int(os.environ.get("OVERSIM_BENCH_DEADLINE", 235))
 _T0 = time.time()
 
 # The reference publishes no benchmark numbers (BASELINE.json published={}).

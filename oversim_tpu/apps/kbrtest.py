@@ -43,6 +43,9 @@ I64 = jnp.int64
 NS = 1_000_000_000
 T_INF = jnp.int64(2**62)
 NO_NODE = jnp.int32(-1)
+ANY_NODE = jnp.int32(-2)   # rpc_dst wildcard: recursive routed call — the
+                           # responder is unknown until the response lands
+                           # (reference BaseRpc matches by nonce, not node)
 
 # test modes (tag low bits)
 M_ONEWAY, M_RPC, M_LOOKUP = 0, 1, 2
@@ -82,10 +85,39 @@ class KbrTestState:
 
 
 class KbrTestApp:
-    """Tier-1 app object (interface: apps/base.py docstring)."""
+    """Tier-1 app object (interface: apps/base.py docstring).
 
-    def __init__(self, params: KbrTestParams = KbrTestParams()):
+    ``rcfg`` is set by a recursive-routing overlay (common/route.py
+    RouteConfig): RPC replies then travel in the transport the routing
+    mode dictates (rt_mod.reply) instead of direct UDP, mirroring
+    BaseRpc's routingType-driven response transport."""
+
+    def __init__(self, params: KbrTestParams = KbrTestParams(), rcfg=None):
         self.p = params
+        self.rcfg = rcfg
+
+    def route_policy(self, tag):
+        """Which of this app's lookup requests a recursive overlay may
+        route as data instead (returns (routable, inner_kind, is_rpc)).
+        One-way and routed-RPC test payloads route; the lookup test needs
+        a sibling resolution and stays on the lookup engine."""
+        mode = tag % 4
+        routable = (mode == M_ONEWAY) | (mode == M_RPC)
+        inner = jnp.where(mode == M_ONEWAY, jnp.int32(wire.APP_ONEWAY),
+                          jnp.int32(wire.APP_RPC_CALL))
+        return routable, inner, mode == M_RPC
+
+    def on_route_fired(self, app, fired, now, tag):
+        """A recursive overlay routed our APP_RPC_CALL payload (no lookup
+        completion will follow): arm the single-outstanding-call state
+        with the ANY_NODE responder wildcard."""
+        return dataclasses.replace(
+            app,
+            rpc_dst=jnp.where(fired, ANY_NODE, app.rpc_dst),
+            rpc_to=jnp.where(fired, now + jnp.int64(
+                int(self.p.rpc_timeout * NS)), app.rpc_to),
+            rpc_t0=jnp.where(fired, now, app.rpc_t0),
+            rpc_nonce=jnp.where(fired, tag, app.rpc_nonce))
 
     def stat_spec(self):
         return dict(
@@ -278,7 +310,7 @@ class KbrTestApp:
                  en_l & right & ctx.measuring)
         return app
 
-    def on_msgs(self, app, msgs, ctx, ob, ev, is_sib):
+    def on_msgs(self, app, msgs, ctx, ob, ev, is_sib, node_idx=None):
         """Batched deliver hook: ``msgs`` is the [R]-batch Msg view and
         ``is_sib[r]`` the receiver's responsibility flag for msgs.key[r].
         Semantics = folding :meth:`on_msg` over the R slots (at most one
@@ -294,15 +326,26 @@ class KbrTestApp:
                  (msgs.t_deliver - msgs.stamp).astype(jnp.float32) / NS,
                  good)
 
-        # routed-RPC server: reply directly (KbrTestCall → Response)
+        # routed-RPC server: reply in the routing mode's transport
+        # (direct UDP unless a recursive overlay set rcfg full/source)
         en = v & (msgs.kind == wire.APP_RPC_CALL)
-        ob.send(en, msgs.t_deliver, msgs.src, wire.APP_RPC_RES,
-                key=msgs.key, a=msgs.a, stamp=msgs.stamp,
-                size_b=wire.BASE_CALL_B)
+        if (self.rcfg is not None and self.rcfg.mode in ("full", "source")
+                and node_idx is not None):
+            from oversim_tpu.common import route as rt_mod
+            rt_mod.reply(ob, self.rcfg, en, msgs.t_deliver, msgs, ctx,
+                         node_idx, wire.APP_RPC_RES, key=msgs.key,
+                         a=msgs.a, stamp=msgs.stamp,
+                         size_b=wire.BASE_CALL_B)
+        else:
+            ob.send(en, msgs.t_deliver, msgs.src, wire.APP_RPC_RES,
+                    key=msgs.key, a=msgs.a, stamp=msgs.stamp,
+                    size_b=wire.BASE_CALL_B)
 
-        # routed-RPC client: RTT + success (nonce-matched)
+        # routed-RPC client: RTT + success (nonce-matched; ANY_NODE
+        # wildcard when the call was routed recursively)
         en = v & (msgs.kind == wire.APP_RPC_RES) & (
-            msgs.src == app.rpc_dst) & (msgs.a == app.rpc_nonce)
+            (msgs.src == app.rpc_dst) | (app.rpc_dst == ANY_NODE)) & (
+            msgs.a == app.rpc_nonce)
         hit = jnp.any(en)
         ev.count("kbr_rpc_success", en & ctx.measuring)
         ev.value("kbr_rpc_rtt_s",

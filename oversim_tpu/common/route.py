@@ -59,6 +59,19 @@ class RouteConfig:
     ack_timeout_ns: int = 1_500_000_000   # rpcUdpTimeout (NextHopCall)
     route_acks: bool = True     # routeMsgAcks (default.ini:245 for pastry)
     overhead_b: int = 28        # BaseRouteMessage header (destKey+visited)
+    # RoutingType (CommonMessages.msg:130-141) for the recursive family:
+    #   "semi"   SEMI_RECURSIVE_ROUTING    — replies travel direct UDP
+    #   "full"   FULL_RECURSIVE_ROUTING    — replies routed by the
+    #            originator's nodeId key (BaseOverlay.cc:1813-1819)
+    #   "source" RECURSIVE_SOURCE_ROUTING  — visitedHops recorded on the
+    #            request, replies source-routed back along the reversed
+    #            path (BaseOverlay.cc:888-908 visited recording)
+    mode: str = "semi"
+    record_route: bool = False  # recordRoute param (BaseOverlay.cc:137)
+
+    @property
+    def records_visited(self) -> bool:
+        return self.mode == "source" or self.record_route
 
 
 @jax.tree_util.register_dataclass
@@ -174,6 +187,163 @@ def forward(rt: RouteState, ob, en, now, next_hop, *, key, inner, a, b, c,
                                       mode="drop"))
 
 
+def forward_batch(rt: RouteState, ob, en, now, next_hop, *, key, inner, a,
+                  b, c, hops, stamp, size_b, visited, cfg: RouteConfig):
+    """Vector-valued :func:`forward`: ``en``/``now``/``next_hop`` and every
+    field carry a leading [R] axis (one lane per inbox slot).  The whole
+    batch leaves in ONE Outbox send; ACK bookkeeping allocates the j-th
+    enabled lane the j-th free slot (same sort-free rank trick as
+    engine/pool.alloc, R and Q both small).  Lanes beyond the free-slot
+    supply are sent un-ACKed, like the scalar path on a full table."""
+    q = rt.active.shape[0]
+    r = en.shape[0]
+    if not cfg.route_acks:
+        ob.send(en, now, next_hop, wire.KBR_ROUTE, key=key, nonce=0,
+                hops=hops, a=a, b=b, c=c, d=inner, nodes=visited,
+                stamp=stamp, size_b=size_b + cfg.overhead_b)
+        return rt
+
+    # rank of each enabled lane / each free slot
+    lane_rank = jnp.cumsum(en.astype(I32)) - 1            # [R]
+    free = ~rt.active
+    slot_rank = jnp.cumsum(free.astype(I32)) - 1          # [Q]
+    n_free = jnp.sum(free.astype(I32))
+    # lane j -> the free slot with rank lane_rank[j]
+    slot_of_rank = jnp.full((q,), q, I32).at[
+        jnp.where(free, slot_rank, q)].set(jnp.arange(q, dtype=I32),
+                                           mode="drop")  # [Q] rank->slot
+    lane_slot = jnp.where(en & (lane_rank < n_free),
+                          slot_of_rank[jnp.clip(lane_rank, 0, q - 1)], q)
+    parked = lane_slot < q                                 # [R]
+    gen = rt.gen[jnp.clip(lane_slot, 0, q - 1)] + 1
+    nonce = jnp.where(parked, _route_nonce(
+        jnp.clip(lane_slot, 0, q - 1), gen, q), 0)
+    ob.send(en, now, next_hop, wire.KBR_ROUTE, key=key, nonce=nonce,
+            hops=hops, a=a, b=b, c=c, d=inner, nodes=visited,
+            stamp=stamp, size_b=size_b + cfg.overhead_b)
+    vis_cap = rt.visited.shape[1]
+    return dataclasses.replace(
+        rt,
+        active=rt.active.at[lane_slot].set(True, mode="drop"),
+        gen=rt.gen.at[lane_slot].set(gen, mode="drop"),
+        dst=rt.dst.at[lane_slot].set(next_hop, mode="drop"),
+        t_to=rt.t_to.at[lane_slot].set(now + cfg.ack_timeout_ns,
+                                       mode="drop"),
+        retries=rt.retries.at[lane_slot].set(0, mode="drop"),
+        key=rt.key.at[lane_slot].set(key, mode="drop"),
+        inner=rt.inner.at[lane_slot].set(
+            jnp.broadcast_to(jnp.asarray(inner, I32), (r,)), mode="drop"),
+        a=rt.a.at[lane_slot].set(jnp.asarray(a, I32), mode="drop"),
+        b=rt.b.at[lane_slot].set(jnp.asarray(b, I32), mode="drop"),
+        c=rt.c.at[lane_slot].set(jnp.asarray(c, I32), mode="drop"),
+        hops=rt.hops.at[lane_slot].set(jnp.asarray(hops, I32), mode="drop"),
+        stamp=rt.stamp.at[lane_slot].set(jnp.asarray(stamp, I64),
+                                         mode="drop"),
+        size_b=rt.size_b.at[lane_slot].set(jnp.asarray(size_b, I32),
+                                           mode="drop"),
+        visited=rt.visited.at[lane_slot].set(visited[:, :vis_cap],
+                                             mode="drop"))
+
+
+def on_acks(rt: RouteState, m):
+    """Batched :func:`on_ack`: ``m`` fields carry an [R] inbox axis.  Each
+    valid ACK addresses a distinct slot (the nonce encodes the slot), so
+    one scatter clears them all."""
+    q = rt.active.shape[0]
+    slot = (m.nonce - 1) % q                               # [R]
+    gen = (m.nonce - 1) // q
+    sc = jnp.clip(slot, 0, q - 1)
+    ok = (m.valid & (m.nonce > 0) & rt.active[sc]
+          & ((rt.gen[sc] & jnp.int32(0x003FFFFF)) == gen)
+          & (rt.dst[sc] == m.src))
+    sl = jnp.where(ok, sc, q)
+    return dataclasses.replace(
+        rt,
+        active=rt.active.at[sl].set(False, mode="drop"),
+        t_to=rt.t_to.at[sl].set(T_INF, mode="drop"))
+
+
+def append_visited(visited, self_idx, en):
+    """recordRoute semantics (BaseOverlay.cc:893-898): append ``self_idx``
+    to each enabled lane's [R, V] visited list (first NO_NODE slot; a full
+    list keeps its prefix — bounded-width deviation, overflow harmless:
+    loop detection just loses the oldest hops)."""
+    r, vcap = visited.shape
+    n_vis = jnp.sum((visited != NO_NODE).astype(I32), axis=1)   # [R]
+    pos = jnp.where(en, jnp.minimum(n_vis, vcap - 1), vcap)
+    return visited.at[jnp.arange(r), pos].set(
+        jnp.where(en, self_idx, NO_NODE), mode="drop")
+
+
+def sroute_send(ob, en, now, *, path, responder, inner, key, a, hops,
+                stamp, size_b, overhead_b=28):
+    """Emit a source-routed reply along the reversed ``path`` [R, V]
+    (the request's visitedHops; path[0] is the originator).  The wire
+    cursor ``b`` indexes the NEXT receiver: we send to path[last] with
+    b=last; each hop at cursor j>0 forwards to path[j-1] with b=j-1;
+    the receiver at b==0 is the originator and delivers (wire.KBR_SROUTE).
+    """
+    n_path = jnp.sum((path != NO_NODE).astype(I32), axis=-1)    # [R]
+    last = jnp.maximum(n_path - 1, 0)
+    first_dst = jnp.take_along_axis(
+        path, last[:, None], axis=1)[:, 0] if path.ndim == 2 else \
+        path[last]
+    en = en & (n_path > 0)
+    ob.send(en, now, first_dst, wire.KBR_SROUTE, key=key, a=a,
+            b=last, c=responder, d=inner, nodes=path, hops=hops,
+            stamp=stamp, size_b=size_b + overhead_b)
+
+
+def sroute_step(ob, msgs, overhead_b=28):
+    """One source-route hop for an [R] inbox batch (the intermediate-hop
+    pop of the reference's nextHops source route, BaseOverlay.cc:896-907).
+
+    Returns ``deliver`` [R] — lanes whose receiver is the originator
+    (cursor 0); the caller rewrites kind := d, src := c for those lanes.
+    Forwarding lanes are sent here."""
+    en = msgs.valid & (msgs.kind == wire.KBR_SROUTE)
+    j = msgs.b
+    deliver = en & (j <= 0)
+    fwd = en & (j > 0)
+    jc = jnp.clip(j - 1, 0, msgs.nodes.shape[-1] - 1)
+    nxt = jnp.take_along_axis(msgs.nodes, jc[:, None], axis=1)[:, 0]
+    ob.send(fwd & (nxt != NO_NODE), msgs.t_deliver, nxt, wire.KBR_SROUTE,
+            key=msgs.key, a=msgs.a, b=jc, c=msgs.c, d=msgs.d,
+            nodes=msgs.nodes, hops=msgs.hops + 1, stamp=msgs.stamp,
+            size_b=msgs.size_b)
+    return deliver
+
+
+def reply(ob, cfg: RouteConfig, en, now, msgs, ctx, node_idx, inner_kind,
+          *, key=None, a=0, stamp=0, size_b=40):
+    """Send an RPC reply for decapsulated routed calls ``msgs`` [R] in the
+    transport the routing mode dictates (BaseRpc::internalSendRpcResponse
+    transport choice, BaseOverlay.cc:1790-1825):
+
+      semi   → direct UDP to the originator (msgs.src after decap);
+      full   → KBR_ROUTE keyed to the originator's nodeId, re-entering the
+               overlay via a self-send (visited starts at [self]);
+      source → KBR_SROUTE along the request's reversed visitedHops
+               (rides msgs.nodes through decapsulation).
+    """
+    if key is None:
+        key = msgs.key
+    if cfg.mode == "full":
+        vis0 = jnp.full(msgs.nodes.shape, NO_NODE, I32).at[:, 0].set(
+            node_idx)
+        ob.send(en, now, node_idx, wire.KBR_ROUTE,
+                key=ctx.keys[jnp.maximum(msgs.src, 0)], nonce=0,
+                hops=0, a=a, d=inner_kind, nodes=vis0, stamp=stamp,
+                size_b=size_b + cfg.overhead_b)
+    elif cfg.mode == "source":
+        sroute_send(ob, en, now, path=msgs.nodes, responder=node_idx,
+                    inner=inner_kind, key=key, a=a, hops=0, stamp=stamp,
+                    size_b=size_b, overhead_b=cfg.overhead_b)
+    else:
+        ob.send(en, now, msgs.src, inner_kind, key=key, a=a, stamp=stamp,
+                size_b=size_b)
+
+
 def on_ack(rt: RouteState, m):
     """Consume a KBR_ROUTE_ACK (NextHopResponse): free the matched slot."""
     q = rt.active.shape[0]
@@ -231,6 +401,35 @@ def reforward(rt: RouteState, ob, slot: int, en, now, next_hop,
         gen=rt.gen.at[sl].set(gen, mode="drop"),
         dst=rt.dst.at[sl].set(next_hop, mode="drop"),
         t_to=rt.t_to.at[sl].set(now + cfg.ack_timeout_ns, mode="drop"))
+
+
+def reforward_batch(rt: RouteState, ob, en, now, next_hop,
+                    cfg: RouteConfig):
+    """Vectorized :func:`reforward` over all Q slots at once: ``en`` [Q]
+    marks slots to re-send, ``next_hop`` [Q] their new hops.  One Outbox
+    send + per-field masked updates (no per-slot python loop)."""
+    q = rt.active.shape[0]
+    en = en & (next_hop != NO_NODE)
+    gen = rt.gen + 1
+    slots = jnp.arange(q, dtype=I32)
+    nonce = jnp.where(en, _route_nonce(slots, gen, q), 0)
+    ob.send(en, now, next_hop, wire.KBR_ROUTE, key=rt.key, nonce=nonce,
+            hops=rt.hops, a=rt.a, b=rt.b, c=rt.c, d=rt.inner,
+            nodes=rt.visited, stamp=rt.stamp,
+            size_b=rt.size_b + cfg.overhead_b)
+    return dataclasses.replace(
+        rt,
+        gen=jnp.where(en, gen, rt.gen),
+        dst=jnp.where(en, next_hop, rt.dst),
+        t_to=jnp.where(en, now + cfg.ack_timeout_ns, rt.t_to))
+
+
+def drop_slots(rt: RouteState, en):
+    """Vectorized :func:`drop_slot`: free every slot marked in ``en`` [Q]."""
+    return dataclasses.replace(
+        rt,
+        active=rt.active & ~en,
+        t_to=jnp.where(en, T_INF, rt.t_to))
 
 
 def drop_slot(rt: RouteState, slot: int, en):

@@ -24,6 +24,13 @@ KBR_ROUTE = 7           # BaseRouteMessage: recursive per-hop forwarding
                         # (destKey, visitedHops, hopCount; encapsulated
                         # payload kind rides in d — common/route.py)
 KBR_ROUTE_ACK = 8       # NextHopCall/Response per-hop ACK (routeMsgAcks)
+KBR_SROUTE = 9          # source-routed reply (RECURSIVE_SOURCE_ROUTING,
+                        # CommonMessages.msg:130-141): BaseRouteMessage with
+                        # an explicit nextHops list instead of a destKey —
+                        # nodes=path, b=cursor (next hop = nodes[b-1]; b==0
+                        # means the receiver IS the originator → deliver),
+                        # c=the responding node (becomes src at delivery),
+                        # d=encapsulated payload kind (common/route.py)
 
 # --- Chord protocol kinds (src/overlay/chord/ChordMessage.msg) ---
 CHORD_JOIN_CALL = 10

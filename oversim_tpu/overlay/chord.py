@@ -45,11 +45,13 @@ import jax.numpy as jnp
 
 from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
+from oversim_tpu.apps import kbrtest
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
 from oversim_tpu.common import malicious as mal_mod
 from oversim_tpu.common import ncs as ncs_mod
 from oversim_tpu.common import neighborcache as nc_mod
+from oversim_tpu.common import route as rt_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -102,6 +104,7 @@ class ChordState:
     cp_to: jnp.ndarray         # [N] i64 pending predecessor-ping timeout
     cp_dst: jnp.ndarray        # [N] i32 the node that ping targeted
     lk: lk_mod.LookupState     # [N, L, ...]
+    rr: rt_mod.RouteState      # [N, Q, ...] pending-ACK recursive routes
     cp_sent: jnp.ndarray       # [N] i64 — predecessor-ping send time (RTT)
     ncs: ncs_mod.NcsState      # [N, ...] Vivaldi coordinates (common/ncs.py)
     nc: nc_mod.NcState         # [N, C] RTT cache (adaptive RPC timeouts)
@@ -129,11 +132,26 @@ class ChordLogic:
                  app=None,
                  mparams: mal_mod.MaliciousParams = mal_mod.MaliciousParams(),
                  ncs_params: ncs_mod.NcsParams = ncs_mod.NcsParams(),
-                 nc_params: nc_mod.NcParams = nc_mod.NcParams()):
+                 nc_params: nc_mod.NcParams = nc_mod.NcParams(),
+                 rcfg: rt_mod.RouteConfig | None = None):
+        """``rcfg=None`` keeps the reference Chord default (iterative
+        routing, default.ini:167-183); a RouteConfig switches the app
+        data path to the recursive family — rcfg.mode selects
+        SEMI_RECURSIVE / FULL_RECURSIVE / RECURSIVE_SOURCE_ROUTING
+        (verify.ini's ChordSource config = mode="source").  App lookups
+        (M_LOOKUP / DHT LookupCall) stay on the iterative engine either
+        way (documented deviation: the reference wraps them in
+        RecursiveLookup; the sibling resolution is equivalent, the
+        FindNode round trips differ)."""
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg
         self.app = app or KbrTestApp()
+        self.rcfg = rcfg
+        # hand the routing mode to the app's RPC-reply path (BaseRpc
+        # response transport follows the call's routingType)
+        if rcfg is not None and getattr(self.app, "rcfg", "no") is None:
+            self.app.rcfg = rcfg
         self.mp = mparams
         self.ncs = ncs_params
         self.ncp = nc_params
@@ -149,7 +167,8 @@ class ChordLogic:
             scalars=tuple(app["scalars"]) + ("lookup_hops",),
             hists=tuple(app["hists"]),
             counters=tuple(app["counters"]) + (
-                "chord_joins", "lookup_success", "lookup_failed"),
+                "chord_joins", "lookup_success", "lookup_failed",
+                "route_dropped"),
         )
 
     def split(self, st: ChordState):
@@ -181,6 +200,9 @@ class ChordLogic:
             cp_dst=jnp.full((n,), NO_NODE, I32),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
+            rr=jax.vmap(lambda _: rt_mod.init(
+                self.rcfg or rt_mod.RouteConfig(), self.key_spec.lanes,
+                16))(jnp.arange(n)),
             cp_sent=jnp.zeros((n,), I64),
             ncs=ncs_mod.init(rng, n, self.ncs),
             nc=nc_mod.init(n, self.ncp),
@@ -215,6 +237,8 @@ class ChordLogic:
         t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
                                      T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        if self.rcfg is not None:
+            t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
 
     # -- internals (all per-node; vmapped by the engine) ---------------------
@@ -380,6 +404,7 @@ class ChordLogic:
         joins_cnt = jnp.int32(0)
         anyfail_cnt = jnp.int32(0)  # failed lookups of any purpose
         lksucc_cnt = jnp.int32(0)
+        routedrop_cnt = jnp.int32(0)
 
         # --------------------------------------------- inbox (batched) -----
         # Kind-major batching: each message kind is handled in ONE masked
@@ -396,14 +421,75 @@ class ChordLogic:
         now_r = msgs.t_deliver                               # [R]
         r_in = v_r.shape[0]
 
-        # FindNodeCall -> findNode + sibling flag (findNodeRpc,
-        # BaseOverlay.cc:1841), vmapped over inbox slots.  Subclasses
-        # (Koorde) override _respond_find for their own hop choice +
-        # lookup extension handling.
-        en_call = v_r & (msgs.kind == wire.FINDNODE_CALL)
+        # FindNode + sibling flag for EVERY inbox slot's key (findNodeRpc,
+        # BaseOverlay.cc:1841), vmapped.  Subclasses (Koorde) override
+        # _respond_find for their own hop choice + lookup extension
+        # handling.  Computed before the recursive-route pre-pass: route
+        # forwarding reuses these results as its next-hop candidates, and
+        # decapsulation preserves msgs.key, so the flags stay valid for
+        # the decapsulated inner kinds below.
         res_b, sib_b = jax.vmap(
             lambda mm: self._respond_find(ctx, st, me_key, node_idx, mm,
                                           rmax, pad_nodes))(msgs)
+
+        if self.rcfg is not None:
+            rcfg = self.rcfg
+            # per-hop ACKs for routes we forwarded (NextHopResponse)
+            st = dataclasses.replace(st, rr=rt_mod.on_acks(
+                st.rr, dataclasses.replace(
+                    msgs,
+                    valid=v_r & (msgs.kind == wire.KBR_ROUTE_ACK))))
+
+            # source-routed replies: pop one hop / deliver at originator
+            en_sro = v_r & (msgs.kind == wire.KBR_SROUTE)
+            deliver_sr = rt_mod.sroute_step(ob, msgs)
+            msgs = dataclasses.replace(
+                msgs,
+                kind=jnp.where(deliver_sr, msgs.d, msgs.kind),
+                src=jnp.where(deliver_sr, msgs.c, msgs.src),
+                valid=v_r & (~en_sro | deliver_sr))
+            v_r = msgs.valid
+
+            # recursive route pre-pass (sendToKey recursive branch,
+            # BaseOverlay.cc:1441-1581): ACK the last hop, then either
+            # decapsulate (responsible) or forward to the first
+            # candidate surviving loop detection.  visitedHops ride
+            # msgs.nodes; the originator is visited[0].
+            en_rt = v_r & (msgs.kind == wire.KBR_ROUTE) & (
+                st.state == READY)
+            ob.send(en_rt & (msgs.nonce > 0), now_r, msgs.src,
+                    wire.KBR_ROUTE_ACK, nonce=msgs.nonce,
+                    size_b=wire.BASE_CALL_B)
+            deliver_rt = en_rt & sib_b
+            nxt_v, found_v = jax.vmap(
+                rt_mod.pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
+                res_b, msgs.nodes, msgs.src, msgs.nodes[:, 0], node_idx,
+                sib_b)
+            fwd = en_rt & ~sib_b & found_v & (msgs.hops < rcfg.hop_max)
+            # visitedHops appended unconditionally (deviation: the
+            # reference records only for source/recordRoute and falls
+            # back to last-hop-only loop detection in semi/full —
+            # recording always makes pick_next_hop's visited check real
+            # in every mode for a few wire bytes; pastry.py does the same)
+            visited2 = rt_mod.append_visited(msgs.nodes, node_idx, fwd)
+            st = dataclasses.replace(st, rr=rt_mod.forward_batch(
+                st.rr, ob, fwd, now_r, nxt_v, key=msgs.key, inner=msgs.d,
+                a=msgs.a, b=msgs.b, c=msgs.c, hops=msgs.hops + 1,
+                stamp=msgs.stamp, size_b=msgs.size_b - rcfg.overhead_b,
+                visited=visited2, cfg=rcfg))
+            routedrop_cnt += jnp.sum((en_rt & ~sib_b & ~fwd).astype(I32))
+            # decapsulate at the responsible node: the payload kind takes
+            # over and src becomes the originator; handlers below (incl.
+            # the app kinds) consume it as if it arrived directly.
+            # msgs.nodes keeps the visitedHops for source-routed replies.
+            msgs = dataclasses.replace(
+                msgs,
+                kind=jnp.where(deliver_rt, msgs.d, msgs.kind),
+                src=jnp.where(deliver_rt, msgs.nodes[:, 0], msgs.src),
+                valid=v_r & (~en_rt | deliver_rt))
+            v_r = msgs.valid
+
+        en_call = v_r & (msgs.kind == wire.FINDNODE_CALL)
         # byzantine switches (common/malicious.py; statically no-op by
         # default).  Only the wire copy is attacked; the honest ``sib_b``
         # feeds the app deliver check below (wrong-node detection,
@@ -668,7 +754,7 @@ class ChordLogic:
         # sibling flags computed above
         if hasattr(self.app, "on_msgs"):
             st = dataclasses.replace(st, app=self.app.on_msgs(
-                st.app, msgs, ctx, ob, ev, sib_b))
+                st.app, msgs, ctx, ob, ev, sib_b, node_idx=node_idx))
         else:
             for r in range(r_in):
                 st = dataclasses.replace(st, app=self.app.on_msg(
@@ -802,10 +888,36 @@ class ChordLogic:
             res_local = jnp.concatenate([res_local, jnp.full(
                 (lcfg.frontier - res_local.shape[0],), NO_NODE, I32)])
         slot, have = lk_mod.free_slot(st.lk)
-        start_app = req.want & ~sib_a & have & (nxt_a != NO_NODE)
+        if self.rcfg is None:
+            start_app = req.want & ~sib_a & have & (nxt_a != NO_NODE)
+            route_fire = jnp.bool_(False)
+        elif hasattr(self.app, "route_policy"):
+            # recursive data path (sendToKey recursive branch at the
+            # originator): payloads the app declares routable are
+            # forwarded hop-by-hop; everything else (lookup test, DHT
+            # LookupCall) keeps the iterative engine.  Gated on the app
+            # speaking the protocol — an app without route_policy never
+            # has its lookups diverted.
+            routable, inner_a, is_rpc = self.app.route_policy(req.tag)
+            route_fire = req.want & ~sib_a & routable & (nxt_a != NO_NODE)
+            vis0 = jnp.full((rmax,), NO_NODE, I32).at[0].set(node_idx)
+            st = dataclasses.replace(st, rr=rt_mod.forward(
+                st.rr, ob, route_fire, now_a, nxt_a, key=req.key,
+                inner=inner_a, a=req.tag, b=jnp.int32(0),
+                c=ctx.measuring.astype(I32), hops=jnp.int32(1),
+                stamp=now_a, size_b=jnp.int32(100), visited=vis0,
+                cfg=self.rcfg))
+            if hasattr(self.app, "on_route_fired"):
+                st = dataclasses.replace(st, app=self.app.on_route_fired(
+                    st.app, route_fire & is_rpc, now_a, req.tag))
+            start_app = (req.want & ~sib_a & ~routable & have
+                         & (nxt_a != NO_NODE))
+        else:
+            start_app = req.want & ~sib_a & have & (nxt_a != NO_NODE)
+            route_fire = jnp.bool_(False)
         # could not even start (no slot / empty local findNode) → failed
         # completion right away
-        insta_fail = req.want & ~sib_a & ~start_app
+        insta_fail = req.want & ~sib_a & ~start_app & ~route_fire
         st = dataclasses.replace(st, app=self.app.on_lookup_done(
             st.app, app_base.LookupDone(
                 en=local | insta_fail, success=local, tag=req.tag,
@@ -837,11 +949,42 @@ class ChordLogic:
             st, cp_to=jnp.where(en, T_INF, st.cp_to),
             cp_dst=jnp.where(en, NO_NODE, st.cp_dst))
 
+        # route-hop ACK timeouts: unresponsive next hops are failures too
+        if self.rcfg is not None:
+            new_rr, rt_failed, rt_retry = rt_mod.on_timeouts(
+                st.rr, t_end, self.rcfg)
+            st = dataclasses.replace(st, rr=new_rr)
+        else:
+            rt_failed = jnp.full((0,), NO_NODE, I32)
+
         # one batched repair pass for every failure source this tick
         st = self._handle_failed(
             ctx, st, me_key, node_idx,
             jnp.concatenate([failed_nodes, stab_failed[None],
-                             cp_failed[None]]), t0)
+                             cp_failed[None], rt_failed]), t0)
+
+        # reroute parked route messages around the failed hop (it was
+        # just dropped from the tables, so findNode picks an alternative;
+        # internalHandleRpcTimeout reroute, BaseOverlay.cc:1697-1729).
+        # One vmapped findNode over the Q slot keys; a node that became
+        # responsible for a parked key meanwhile self-forwards so the
+        # message still delivers (pastry.py does the same).
+        if self.rcfg is not None:
+            nxt_q, sib_q = jax.vmap(
+                lambda kk: self._find_node(ctx, st, me_key, node_idx, kk))(
+                st.rr.key)
+            nxt_q2, found_q = jax.vmap(
+                rt_mod.pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
+                nxt_q[:, None], st.rr.visited, rt_failed,
+                st.rr.visited[:, 0], node_idx, sib_q)
+            nxt_fin = jnp.where(sib_q, node_idx, nxt_q2)
+            ok_q = rt_retry & (sib_q | found_q)
+            st = dataclasses.replace(st, rr=rt_mod.reforward_batch(
+                st.rr, ob, ok_q, t0, nxt_fin, self.rcfg))
+            give_up = rt_retry & ~ok_q
+            st = dataclasses.replace(st, rr=rt_mod.drop_slots(
+                st.rr, give_up))
+            routedrop_cnt += jnp.sum(give_up.astype(I32))
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
@@ -939,6 +1082,7 @@ class ChordLogic:
             "c:chord_joins": joins_cnt,
             "c:lookup_success": lksucc_cnt,
             "c:lookup_failed": anyfail_cnt,
+            "c:route_dropped": routedrop_cnt,
             "s:lookup_hops": comp_hops_ev,
         }
         ev.finish(events, self.app.hist_map)

@@ -1,0 +1,39 @@
+"""Tiny CPU smoke for the recursive routing modes (fast compile at N=8)."""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.modules["zstandard"] = None
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_compilation_cache", False)
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.common import route as rt_mod
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic
+
+N = 8
+for mode in (None, "semi", "full", "source"):
+    t0 = time.time()
+    rcfg = rt_mod.RouteConfig(mode=mode) if mode else None
+    app = KbrTestApp(KbrTestParams(test_interval=10.0, rpc_test=True),
+                     rcfg=rcfg)
+    logic = ChordLogic(app=app, rcfg=rcfg)
+    cp = churn_mod.ChurnParams(model="none", target_num=N, init_interval=0.2)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=60.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=3)
+    st = s.run_until(st, 200.0, chunk=256)
+    out = s.summary(st)
+    print(f"mode={mode}: sent={out['kbr_sent']} del={out['kbr_delivered']} "
+          f"wrong={out['kbr_wrong_node']} rpc={out['kbr_rpc_sent']}/"
+          f"{out['kbr_rpc_success']} drop={out.get('route_dropped')} "
+          f"hops={out['kbr_hopcount']['mean']:.2f} "
+          f"rtt={out['kbr_rpc_rtt_s']['mean']:.3f} "
+          f"({time.time() - t0:.0f}s)", flush=True)

@@ -63,14 +63,69 @@ def measure(overlay: str, n: int, seed: int = 42):
     }
 
 
+def measure_verify(overlay: str, seed: int = 7):
+    """The reference's fingerprint-regression scenario shape
+    (simulations/verify.ini:1-14): 100 nodes, LifetimeChurn
+    lifetimeMean=1000s, DHT+DHTTestApp+GlobalDhtTestMap, 100s
+    transition + 100s measurement.  Deviation documented: the DHT test
+    interval is 10s instead of 60s so the 100s measurement window holds
+    ~1000 operations (the reference pins event hashes, which need no
+    sample density; distribution goldens do)."""
+    from oversim_tpu.apps.dht import DhtApp, DhtParams
+
+    app = DhtApp(DhtParams(test_interval=10.0, num_test_keys=32,
+                           test_ttl=600.0))
+    if overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app)
+    elif overlay == "pastry":
+        from oversim_tpu.overlay.pastry import PastryLogic
+        logic = PastryLogic(app=app)
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app)
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=100,
+                               init_interval=0.1, lifetime_mean=1000.0)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=100.0,
+                              measurement_time=100.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=seed)
+    st = s.run_until(st, cp.init_finished_time + 200.0, chunk=512)
+    out = s.summary(st)
+    puts, gets = out["dht_put_attempts"], out["dht_get_attempts"]
+    return {
+        "seed": seed,
+        "alive": int(out["_alive"]),
+        "put_attempts": int(puts),
+        "put_success_ratio": round(
+            float(out["dht_put_success"]) / max(puts, 1), 4),
+        "get_attempts": int(gets),
+        "get_success_ratio": round(
+            float(out["dht_get_success"]) / max(gets, 1), 4),
+        "get_wrong": int(out["dht_get_wrong"]),
+    }
+
+
 def main():
-    goldens = {}
-    for overlay, n in (("chord", 256), ("kademlia", 256)):
-        print(f"measuring {overlay} N={n} ...", flush=True)
-        goldens[f"{overlay}_{n}"] = measure(overlay, n)
-        print(json.dumps(goldens[f"{overlay}_{n}"]), flush=True)
+    only = sys.argv[1] if len(sys.argv) > 1 else None
     path = Path(__file__).resolve().parent.parent / "tests" / "goldens.json"
-    path.write_text(json.dumps(goldens, indent=1) + "\n")
+    goldens = json.loads(path.read_text()) if path.exists() else {}
+    for overlay, n in (("chord", 256), ("kademlia", 256)):
+        name = f"{overlay}_{n}"
+        if only and only not in (name, "kbr"):
+            continue
+        print(f"measuring {name} ...", flush=True)
+        goldens[name] = measure(overlay, n)
+        print(json.dumps(goldens[name]), flush=True)
+        path.write_text(json.dumps(goldens, indent=1) + "\n")
+    for overlay in ("chord", "kademlia", "pastry"):
+        name = f"verify_{overlay}"
+        if only and only not in (name, "verify"):
+            continue
+        print(f"measuring {name} (verify.ini shape) ...", flush=True)
+        goldens[name] = measure_verify(overlay)
+        print(json.dumps(goldens[name]), flush=True)
+        path.write_text(json.dumps(goldens, indent=1) + "\n")
     print(f"wrote {path}")
 
 

@@ -1,0 +1,86 @@
+"""Timing probe: compile vs per-tick execution cost on the live backend.
+
+Usage: python scripts/perf_probe.py [n] [chunk] [overlay]
+Prints timestamped stages so a hang is attributable to a stage.
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.modules["zstandard"] = None
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
+
+
+import jax
+
+from jax._src import compilation_cache as _cc
+for attr in ("zstandard", "zstd"):
+    if getattr(_cc, attr, None) is not None:
+        setattr(_cc, attr, None)
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+overlay = sys.argv[3] if len(sys.argv) > 3 else "kademlia"
+
+dev = jax.devices()[0]
+log(f"backend up: {dev} platform={dev.platform}")
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps import kbrtest
+from oversim_tpu.apps.kbrtest import KbrTestApp
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.engine import sim as sim_mod
+
+app = KbrTestApp(kbrtest.KbrTestParams(test_interval=0.2))
+if overlay == "chord":
+    from oversim_tpu.overlay.chord import ChordLogic
+    logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
+else:
+    from oversim_tpu.overlay.kademlia import KademliaLogic
+    logic = KademliaLogic(app=app,
+                          lcfg=lk_mod.LookupConfig(slots=8, merge=True))
+cp = churn_mod.ChurnParams(model="none", target_num=n,
+                           init_interval=20.0 / n, init_deviation=2.0 / n)
+ep = sim_mod.EngineParams(window=0.05, inbox_slots=4, pool_factor=4)
+sim = sim_mod.Simulation(logic, cp, engine_params=ep)
+
+s = sim.init(seed=7)
+jax.block_until_ready(s.t_now)
+log("init done")
+
+lowered = sim.run_chunk.lower(sim, s, chunk)
+log("lowered (traced)")
+compiled = lowered.compile()
+log("compiled")
+try:
+    txt = compiled.as_text()
+    log(f"hlo ops≈{txt.count(chr(10))} lines")
+except Exception as e:  # axon may not expose text
+    log(f"as_text unavailable: {e}")
+
+s2 = compiled(s, chunk) if False else None
+# run via the normal path so the jit cache is used
+t = time.perf_counter()
+s = sim.run_chunk(s, chunk)
+jax.block_until_ready(s.t_now)
+log(f"chunk1 ({chunk} ticks): {time.perf_counter() - t:.3f}s")
+for i in range(4):
+    t = time.perf_counter()
+    s = sim.run_chunk(s, chunk)
+    jax.block_until_ready(s.t_now)
+    dt = time.perf_counter() - t
+    log(f"chunk{i + 2}: {dt:.3f}s = {dt / chunk * 1e3:.1f} ms/tick")
+out = sim.summary(s)
+log(f"summary: alive={out['_alive']} ticks={out['_ticks']} "
+    f"sent={out.get('kbr_sent')} delivered={out.get('kbr_delivered')}")
