@@ -116,19 +116,20 @@ def _probe_platform() -> str | None:
             "import jax; d = jax.devices()[0]; "
             "import jax.numpy as jnp; jnp.zeros(()).block_until_ready(); "
             "print(d.platform)")
-    try:
-        r = subprocess.run([sys.executable, "-c", code],
-                           timeout=PROBE_TIMEOUT_S, capture_output=True,
-                           text=True)
-        if r.returncode == 0 and r.stdout.strip():
-            return None                      # ambient backend works
-        sys.stderr.write(
-            "bench: backend probe failed rc=%d\nstderr tail:\n%s\n"
-            % (r.returncode, r.stderr[-2000:]))
-    except subprocess.TimeoutExpired:
-        sys.stderr.write(
-            "bench: backend probe hung >%ds (tunnel stall); using cpu\n"
-            % PROBE_TIMEOUT_S)
+    for attempt in (1, 2):   # tunnel stalls are transient — try twice
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               timeout=PROBE_TIMEOUT_S, capture_output=True,
+                               text=True)
+            if r.returncode == 0 and r.stdout.strip():
+                return None                  # ambient backend works
+            sys.stderr.write(
+                "bench: backend probe %d failed rc=%d\nstderr tail:\n%s\n"
+                % (attempt, r.returncode, r.stderr[-2000:]))
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                "bench: backend probe %d hung >%ds (tunnel stall)\n"
+                % (attempt, PROBE_TIMEOUT_S))
     return "cpu"
 
 
@@ -173,11 +174,15 @@ def child_main():
     # 1M-node rows).
     n = int(os.environ.get("OVERSIM_BENCH_N", "192" if on_cpu else "4096"))
     interval = float(os.environ.get("OVERSIM_BENCH_INTERVAL", 0.2))
-    window = float(os.environ.get("OVERSIM_BENCH_WINDOW", 0.05))
+    # window 0.2 s: the tick graph is op-issue-bound (~0.2 s/tick at
+    # N=4096 regardless of window), so fewer, fatter ticks per sim-s is
+    # the single biggest throughput lever — measured 12k lookups/s at
+    # 0.2 vs ~3k at 0.05 (PERFORMANCE.md round-3 table)
+    window = float(os.environ.get("OVERSIM_BENCH_WINDOW", 0.2))
     warm_extra = float(os.environ.get(
-        "OVERSIM_BENCH_WARM", "20" if on_cpu else "60"))
+        "OVERSIM_BENCH_WARM", "20" if on_cpu else "25"))
     measure_wall = float(os.environ.get(
-        "OVERSIM_BENCH_MEASURE_WALL", "45" if on_cpu else "90"))
+        "OVERSIM_BENCH_MEASURE_WALL", "45"))
     overlay = os.environ.get("OVERSIM_BENCH_OVERLAY", "kademlia")
     chunk = 64
 
@@ -201,7 +206,13 @@ def child_main():
         logic = KademliaLogic(app=app,
                               lcfg=lk_mod.LookupConfig(slots=slots,
                                                        merge=True))
-    ep = sim_mod.EngineParams(window=window, inbox_slots=4, pool_factor=4)
+    inbox = int(os.environ.get("OVERSIM_BENCH_INBOX", 8))
+    # pool_factor 8: at interval 0.2 the in-flight message population is
+    # ~4-6 per node; factor 4 overflowed (tens of thousands of drops →
+    # RPC timeouts → failed lookups at 64% delivery)
+    pool_f = int(os.environ.get("OVERSIM_BENCH_POOL", 8))
+    ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
+                              pool_factor=pool_f)
     sim = sim_mod.Simulation(logic, cp, engine_params=ep)
 
     s = sim.init(seed=7)
@@ -212,6 +223,8 @@ def child_main():
     sys.stderr.write("bench: warmup (%.0f sim-s) took %.1fs wall\n"
                      % (warm_until, time.perf_counter() - t0))
     base = sim.summary(s)
+    sys.stderr.write("bench: post-warm counters %r alive=%d\n"
+                     % (base["_engine"], base["_alive"]))
 
     # measure in wall-clock windows, emitting an updated JSON line after
     # each — the orchestrator relays them, the driver takes the last
@@ -231,8 +244,9 @@ def child_main():
                 f"delivery {delivered}/{sent}, {out['_ticks']} ticks, "
                 f"{wall:.1f}s wall)")
         print(_json_line(rate, unit), flush=True)
-        sys.stderr.write("bench: %.0f lookups/s after %.1fs (%d/%d)\n"
-                         % (rate, wall, delivered, sent))
+        sys.stderr.write("bench: %.0f lookups/s after %.1fs (%d/%d) "
+                         "counters=%r\n"
+                         % (rate, wall, delivered, sent, out["_engine"]))
 
 
 def main():

@@ -1,0 +1,98 @@
+"""Compile the bench-shaped sim step and break the optimized HLO down by
+opcode — evidence for which op classes dominate the op-issue-bound tick.
+
+Usage: python scripts/hlo_breakdown.py [n] [overlay] [window] [inbox]
+Prints: instruction counts by opcode inside the scan body, the largest
+sort/scatter/gather shapes, and fusion count.
+"""
+
+import collections
+import re
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.modules["zstandard"] = None
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - T0:6.1f}s] {msg}", flush=True)
+
+
+import jax
+
+from jax._src import compilation_cache as _cc
+for attr in ("zstandard", "zstd"):
+    if getattr(_cc, attr, None) is not None:
+        setattr(_cc, attr, None)
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+overlay = sys.argv[2] if len(sys.argv) > 2 else "kademlia"
+window = float(sys.argv[3]) if len(sys.argv) > 3 else 0.2
+inbox = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps import kbrtest
+from oversim_tpu.apps.kbrtest import KbrTestApp
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.engine import sim as sim_mod
+
+app = KbrTestApp(kbrtest.KbrTestParams(test_interval=0.2))
+if overlay == "chord":
+    from oversim_tpu.overlay.chord import ChordLogic
+    logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
+else:
+    from oversim_tpu.overlay.kademlia import KademliaLogic
+    logic = KademliaLogic(app=app,
+                          lcfg=lk_mod.LookupConfig(slots=8, merge=True))
+cp = churn_mod.ChurnParams(model="none", target_num=n,
+                           init_interval=20.0 / n, init_deviation=2.0 / n)
+ep = sim_mod.EngineParams(window=window, inbox_slots=inbox, pool_factor=4)
+sim = sim_mod.Simulation(logic, cp, engine_params=ep)
+s = sim.init(seed=7)
+log("init done")
+
+lowered = sim.run_chunk.lower(sim, s, 4)
+log("lowered")
+compiled = lowered.compile()
+log("compiled")
+txt = compiled.as_text()
+log(f"text: {len(txt)} chars, {txt.count(chr(10))} lines")
+
+# find the while-loop body computation (the scan body = one tick)
+# opcode histogram over every computation, plus top-level of body
+op_re = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\]{}, ]+\s+(\w+)\(")
+counts = collections.Counter()
+big = collections.Counter()
+cur_comp = None
+comp_sizes = collections.Counter()
+for line in txt.splitlines():
+    m_hdr = re.match(r"^\s*(?:ENTRY\s+)?(%?[\w.\-]+)\s.*\{\s*(//.*)?$", line)
+    if m_hdr:
+        cur_comp = m_hdr.group(1).lstrip("%")
+    m = op_re.match(line)
+    if m:
+        op = m.group(1)
+        counts[op] += 1
+        comp_sizes[cur_comp] += 1
+        if op in ("sort", "scatter", "gather", "custom-call", "all-to-all",
+                  "while", "dynamic-update-slice", "reduce"):
+            shape = line.split("=", 1)[1].strip().split(" ")[0]
+            big[f"{op} {shape[:70]}"] += 1
+
+log("opcode histogram (all computations):")
+for op, c in counts.most_common(25):
+    print(f"  {op:26s} {c}")
+log("sort/scatter/gather shapes (top 30):")
+for k, c in big.most_common(30):
+    print(f"  {c:4d}x {k}")
+log("largest computations:")
+for name, c in comp_sizes.most_common(10):
+    print(f"  {c:6d} ops  {name}")

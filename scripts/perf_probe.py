@@ -69,7 +69,6 @@ try:
 except Exception as e:  # axon may not expose text
     log(f"as_text unavailable: {e}")
 
-s2 = compiled(s, chunk) if False else None
 # run via the normal path so the jit cache is used
 t = time.perf_counter()
 s = sim.run_chunk(s, chunk)
