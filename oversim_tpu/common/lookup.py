@@ -86,6 +86,15 @@ class LookupConfig:
     parallel_rpcs: int = 1  # R — lookupParallelPaths x lookupParallelRpcs
     retries: int = 0        # per-RPC re-sends before fail (BaseRpc retries)
     exhaustive: bool = False  # EXHAUSTIVE_ITERATIVE_ROUTING
+    # S/Kademlia secure lookups (lookupVerifySiblings, read at
+    # BaseOverlay.cc:144; IterativeLookup::checkStop pings candidate
+    # siblings before accepting them, IterativeLookup.cc:295-340): a
+    # sibling-flagged response no longer completes the lookup — the
+    # head candidate is pinged first, and only a pong completes it.
+    # A verification timeout marks the candidate failed and the lookup
+    # continues from its merged frontier (one verification in flight
+    # per lookup; the reference pings the whole candidate set).
+    verify_siblings: bool = False
     rpc_timeout_ns: int = RPC_TIMEOUT_NS
     deadline_ns: int = LOOKUP_TIMEOUT_NS
     # opaque per-lookup extension words threaded through every FindNode
@@ -108,9 +117,19 @@ class LookupState:
     gen: jnp.ndarray          # [L] i32 — slot generation (stale-response guard)
     frontier: jnp.ndarray     # [L, F] i32 node slots (NO_NODE padded)
     fr_flags: jnp.ndarray     # [L, F] i32 F_* flags
+    fr_src: jnp.ndarray       # [L, F] i32 — who reported each frontier
+                              # entry (NO_NODE = local seed).  Downlist
+                              # provenance: the reference's Downlist maps
+                              # source → dead nodes it returned
+                              # (IterativeLookup lookup->getDownlist(),
+                              # Kademlia.cc:1543-1585)
     visited: jnp.ndarray      # [L, V] i32
     vis_n: jnp.ndarray        # [L] i32 visited write cursor
     pending_dst: jnp.ndarray  # [L, R] i32 (NO_NODE = free RPC slot)
+    pend_prov: jnp.ndarray    # [L, R] i32 — fr_src of the queried entry
+    t_sent: jnp.ndarray       # [L, R] i64 — RPC send time (RTT base for
+                              # NeighborCache sampling, NeighborCache.cc
+                              # updateNode on every RPC response)
     t_to: jnp.ndarray         # [L, R] i64 — per-RPC timeout
     retry: jnp.ndarray        # [L, R] i32 — re-sends used on this RPC
     refire: jnp.ndarray       # [L, R] bool — timed out, re-send pending
@@ -126,6 +145,10 @@ class LookupState:
     res_n: jnp.ndarray        # [L] i32 — accumulated siblings (exhaustive)
     t_done: jnp.ndarray       # [L] i64 — completion time (next_event wake)
     ext: jnp.ndarray          # [L, EW] i32 — opaque per-lookup extension
+    ver_dst: jnp.ndarray      # [L] i32 — sibling candidate under ping
+                              # verification (NO_NODE = none; S/Kademlia)
+    ver_to: jnp.ndarray       # [L] i64 — its ping timeout (T_INF = ping
+                              # not sent yet — pump sends it)
 
 
 def init(cfg: LookupConfig, kl: int) -> LookupState:
@@ -138,9 +161,12 @@ def init(cfg: LookupConfig, kl: int) -> LookupState:
         gen=jnp.zeros((l,), I32),
         frontier=jnp.full((l, f), NO_NODE, I32),
         fr_flags=jnp.zeros((l, f), I32),
+        fr_src=jnp.full((l, f), NO_NODE, I32),
         visited=jnp.full((l, v), NO_NODE, I32),
         vis_n=jnp.zeros((l,), I32),
         pending_dst=jnp.full((l, r), NO_NODE, I32),
+        pend_prov=jnp.full((l, r), NO_NODE, I32),
+        t_sent=jnp.zeros((l, r), I64),
         t_to=jnp.full((l, r), T_INF, I64),
         retry=jnp.zeros((l, r), I32),
         refire=jnp.zeros((l, r), bool),
@@ -154,6 +180,8 @@ def init(cfg: LookupConfig, kl: int) -> LookupState:
         res_n=jnp.zeros((l,), I32),
         t_done=jnp.full((l,), T_INF, I64),
         ext=jnp.zeros((l, cfg.ext_words), I32),
+        ver_dst=jnp.full((l,), NO_NODE, I32),
+        ver_to=jnp.full((l,), T_INF, I64),
     )
 
 
@@ -191,11 +219,16 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
         frontier=lk.frontier.at[slot].set(seed, mode="drop"),
         fr_flags=lk.fr_flags.at[slot].set(jnp.full((f,), F_NEW, I32),
                                           mode="drop"),
+        fr_src=lk.fr_src.at[slot].set(
+            jnp.full((f,), NO_NODE, I32), mode="drop"),
         visited=lk.visited.at[slot].set(
             jnp.full((lk.visited.shape[1],), NO_NODE, I32), mode="drop"),
         vis_n=lk.vis_n.at[slot].set(0, mode="drop"),
         pending_dst=lk.pending_dst.at[slot].set(
             jnp.full((r,), NO_NODE, I32), mode="drop"),
+        pend_prov=lk.pend_prov.at[slot].set(
+            jnp.full((r,), NO_NODE, I32), mode="drop"),
+        t_sent=lk.t_sent.at[slot].set(jnp.zeros((r,), I64), mode="drop"),
         t_to=lk.t_to.at[slot].set(jnp.full((r,), T_INF, I64), mode="drop"),
         retry=lk.retry.at[slot].set(jnp.zeros((r,), I32), mode="drop"),
         refire=lk.refire.at[slot].set(jnp.zeros((r,), bool), mode="drop"),
@@ -212,6 +245,8 @@ def start(lk: LookupState, en, slot, purpose, aux, target, seed_nodes,
         ext=lk.ext.at[slot].set(
             jnp.zeros((cfg.ext_words,), I32) if ext is None else ext,
             mode="drop"),
+        ver_dst=lk.ver_dst.at[slot].set(NO_NODE, mode="drop"),
+        ver_to=lk.ver_to.at[slot].set(T_INF, mode="drop"),
     )
 
 
@@ -258,7 +293,21 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         refire=lk.refire.at[row, j].set(False, mode="drop"),
         hops=lk.hops.at[row].add(1, mode="drop"))
 
-    if not cfg.exhaustive:
+    if cfg.verify_siblings and not cfg.exhaustive:
+        # S/Kademlia: stage the head candidate for ping verification
+        # instead of completing (IterativeLookup.cc:295-340); pump sends
+        # the ping.  The response still merges into the frontier below so
+        # a failed verification continues the lookup.
+        fin = ok & is_sib & (lk.ver_dst[l] == NO_NODE)
+        slot_fin = jnp.where(fin, l, l_dim)
+        lk = dataclasses.replace(
+            lk,
+            ver_dst=lk.ver_dst.at[slot_fin].set(resp_nodes[0], mode="drop"),
+            ver_to=lk.ver_to.at[slot_fin].set(T_INF, mode="drop"),
+            result=lk.result.at[slot_fin].set(resp_nodes[0], mode="drop"),
+            results=lk.results.at[slot_fin].set(resp_nodes, mode="drop"))
+        upd = ok
+    elif not cfg.exhaustive:
         # finished: responder was a sibling → result = first returned node
         fin = ok & is_sib
         slot_fin = jnp.where(fin, l, l_dim)
@@ -296,30 +345,37 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         cand = jnp.concatenate([lk.frontier[l], resp_nodes])
         flags = jnp.concatenate([lk.fr_flags[l],
                                  jnp.full((f,), F_NEW, I32)])
+        srcs = jnp.concatenate([lk.fr_src[l],
+                                jnp.broadcast_to(msg.src, (f,)).astype(I32)])
         # dedupe: a response node equal to an existing frontier entry is
         # invalidated (keeps the entry with its flag state)
         dup = keys_mod.dup_mask(cand) | (cand == NO_NODE)
         cand = jnp.where(dup, NO_NODE, cand)
         dist = metric_fn(cand, lk.target[l])          # [2F, KL]
         dist = jnp.where(dup[:, None], jnp.uint32(0xFFFFFFFF), dist)
-        _, (cand_s, flags_s) = keys_mod.sort_by_distance(dist, (cand, flags))
+        _, (cand_s, flags_s, src_s) = keys_mod.sort_by_distance(
+            dist, (cand, flags, srcs))
         new_frontier = cand_s[:f]
         new_flags = jnp.where(cand_s[:f] == NO_NODE, F_NEW, flags_s[:f])
+        new_src = src_s[:f]
     else:
         # replace mode: frontier := response nodes, in responder order
         # (IterativeLookup.cc:839-841 + push_back add)
         new_frontier = resp_nodes
         new_flags = jnp.full((f,), F_NEW, I32)
+        new_src = jnp.broadcast_to(msg.src, (f,)).astype(I32)
         # if the response was empty keep the old frontier (reference keeps
         # nextHops when ClosestNodesArraySize()==0, IterativeLookup.cc:843)
         new_frontier = jnp.where(has_nodes, new_frontier, lk.frontier[l])
         new_flags = jnp.where(has_nodes, new_flags, lk.fr_flags[l])
+        new_src = jnp.where(has_nodes, new_src, lk.fr_src[l])
 
     slot_upd = jnp.where(upd, l, l_dim)
     lk = dataclasses.replace(
         lk,
         frontier=lk.frontier.at[slot_upd].set(new_frontier, mode="drop"),
-        fr_flags=lk.fr_flags.at[slot_upd].set(new_flags, mode="drop"))
+        fr_flags=lk.fr_flags.at[slot_upd].set(new_flags, mode="drop"),
+        fr_src=lk.fr_src.at[slot_upd].set(new_src, mode="drop"))
     ew = cfg.ext_words
     if ew:
         # responder-updated extension rides the response tail
@@ -378,7 +434,20 @@ def on_responses(lk: LookupState, msgs, metric_fn, cfg: LookupConfig):
         m_rl = pred[:, None] & (l_r[:, None] == lixs[None, :])
         return jnp.any(m_rl, axis=0), jnp.argmax(m_rl, axis=0), m_rl
 
-    if not cfg.exhaustive:
+    if cfg.verify_siblings and not cfg.exhaustive:
+        # S/Kademlia: stage head candidate for ping verification instead
+        # of completing (IterativeLookup.cc:295-340); pump sends the ping
+        fin, win, _ = per_slot(ok & is_sib)
+        fin = fin & (lk.ver_dst == NO_NODE)
+        wnodes = resp_nodes[win]                                # [L, F]
+        lk = dataclasses.replace(
+            lk,
+            ver_dst=jnp.where(fin, wnodes[:, 0], lk.ver_dst),
+            ver_to=jnp.where(fin, T_INF, lk.ver_to),
+            result=jnp.where(fin, wnodes[:, 0], lk.result),
+            results=jnp.where(fin[:, None], wnodes, lk.results))
+        upd = ok
+    elif not cfg.exhaustive:
         fin, win, _ = per_slot(ok & is_sib)
         wnodes = resp_nodes[win]                                # [L, F]
         lk = dataclasses.replace(
@@ -415,27 +484,35 @@ def on_responses(lk: LookupState, msgs, metric_fn, cfg: LookupConfig):
         any_upd, _, m_upd = per_slot(upd)
         contrib = jnp.where(m_upd.T[:, :, None], resp_nodes[None, :, :],
                             NO_NODE).reshape(l_dim, r_in * f)
+        c_src = jnp.where(m_upd.T, msgs.src[None, :], NO_NODE)
+        c_src = jnp.broadcast_to(c_src[:, :, None],
+                                 (l_dim, r_in, f)).reshape(l_dim, r_in * f)
         cand = jnp.concatenate([lk.frontier, contrib], axis=1)  # [L, F+RF]
         flags = jnp.concatenate(
             [lk.fr_flags, jnp.full((l_dim, r_in * f), F_NEW, I32)], axis=1)
+        srcs = jnp.concatenate([lk.fr_src, c_src], axis=1)
         dup = jax.vmap(keys_mod.dup_mask)(cand) | (cand == NO_NODE)
         cand = jnp.where(dup, NO_NODE, cand)
         dist = jax.vmap(metric_fn)(cand, lk.target)
         dist = jnp.where(dup[..., None], jnp.uint32(0xFFFFFFFF), dist)
-        _, (cand_s, flags_s) = keys_mod.sort_by_distance(dist, (cand, flags))
+        _, (cand_s, flags_s, src_s) = keys_mod.sort_by_distance(
+            dist, (cand, flags, srcs))
         new_frontier = cand_s[:, :f]
         new_flags = jnp.where(new_frontier == NO_NODE, F_NEW, flags_s[:, :f])
+        new_src = src_s[:, :f]
     else:
         # replace mode: the first consuming response replaces the frontier
         # (IterativeLookup.cc:839-841); empty responses keep the old one
         any_upd, win_u, _ = per_slot(upd & has_nodes)
         new_frontier = resp_nodes[win_u]
         new_flags = jnp.full((l_dim, f), F_NEW, I32)
+        new_src = jnp.broadcast_to(msgs.src[win_u][:, None], (l_dim, f))
 
     lk = dataclasses.replace(
         lk,
         frontier=jnp.where(any_upd[:, None], new_frontier, lk.frontier),
-        fr_flags=jnp.where(any_upd[:, None], new_flags, lk.fr_flags))
+        fr_flags=jnp.where(any_upd[:, None], new_flags, lk.fr_flags),
+        fr_src=jnp.where(any_upd[:, None], new_src, lk.fr_src))
     ew = cfg.ext_words
     if ew:
         any_e, win_e, _ = per_slot(upd)
@@ -444,20 +521,43 @@ def on_responses(lk: LookupState, msgs, metric_fn, cfg: LookupConfig):
     return lk
 
 
+def response_rtts(lk: LookupState, msgs):
+    """RTT samples from a tick's FINDNODE_RES batch ([R]-masked msgs),
+    computed against the matched pending RPC's send time — the
+    reference's NeighborCache::updateNode on every RPC response
+    (BaseRpc response path).  Call BEFORE on_responses (which clears
+    the pending entries).  Returns (src [R] i32, rtt_s [R] f32, ok [R])."""
+    l_dim = lk.active.shape[0]
+    l_r = jnp.clip(msgs.a, 0, l_dim - 1)
+    match = (lk.pending_dst[l_r] == msgs.src[:, None]) & (
+        msgs.src != NO_NODE)[:, None]                          # [R, Rrpc]
+    ok = (msgs.valid & lk.active[l_r] & (lk.gen[l_r] == msgs.b) &
+          jnp.any(match, axis=1))
+    j = jnp.argmax(match, axis=1).astype(I32)
+    sent = lk.t_sent[l_r, j]
+    rtt_s = (msgs.t_deliver - sent).astype(jnp.float32) / 1e9
+    return jnp.where(ok, msgs.src, NO_NODE), rtt_s, ok
+
+
 def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
     """Expire pending RPCs / deadlines due strictly before ``t_end``.
 
     An expired RPC with retries left is queued for re-send (``refire``,
     BaseRpc.cc:435-449 retry path); otherwise the queried node is
-    reported failed.  Returns (lk', failed_nodes [L*R] i32) — failed
-    nodes feed the overlay's handleFailedNode repair
-    (BaseOverlay.cc:1697-1729; IterativePathLookup::handleTimeout).
+    reported failed.  Returns (lk', failed_nodes [L*R + L] i32,
+    failed_prov [L*R + L] i32) — failed nodes feed the overlay's
+    handleFailedNode repair (BaseOverlay.cc:1697-1729;
+    IterativePathLookup::handleTimeout); ``failed_prov`` pairs each
+    failure with the node that REPORTED the dead contact (downlist
+    provenance, Kademlia.cc:1543-1585; NO_NODE = locally seeded).  The
+    trailing L lanes carry S/Kademlia verification-ping timeouts.
     """
     act = lk.active[:, None]
     exp = act & (lk.pending_dst != NO_NODE) & (lk.t_to < t_end)
     can_retry = exp & (lk.retry < cfg.retries)
     final = exp & ~can_retry
     failed_nodes = jnp.where(final, lk.pending_dst, NO_NODE).reshape(-1)
+    failed_prov = jnp.where(final, lk.pend_prov, NO_NODE).reshape(-1)
 
     # mark finally-failed nodes in the frontier
     fmask = jnp.any(final[:, None, :] &
@@ -465,11 +565,30 @@ def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
                     axis=2)
     fr_flags = jnp.where(fmask, F_FAILED, lk.fr_flags)
     pending_dst = jnp.where(final, NO_NODE, lk.pending_dst)
+    pend_prov = jnp.where(final, NO_NODE, lk.pend_prov)
     t_to = jnp.where(exp, T_INF, lk.t_to)
     refire = lk.refire | can_retry
     retry = lk.retry + can_retry.astype(I32)
     # a finally timed-out round still counts as a hop attempt
     hops = lk.hops + jnp.sum(final, axis=1, dtype=I32)
+
+    # S/Kademlia verification-ping timeout: the candidate sibling is
+    # dead — report it failed, flag it in the frontier, and let the
+    # lookup continue (pump picks the next candidate)
+    if cfg.verify_siblings:
+        vexp = (lk.active & ~lk.done & (lk.ver_dst != NO_NODE)
+                & (lk.ver_to < t_end))
+        failed_nodes = jnp.concatenate(
+            [failed_nodes, jnp.where(vexp, lk.ver_dst, NO_NODE)])
+        failed_prov = jnp.concatenate(
+            [failed_prov, jnp.full((lk.active.shape[0],), NO_NODE, I32)])
+        vmask = vexp[:, None] & (lk.frontier == lk.ver_dst[:, None])
+        fr_flags = jnp.where(vmask, F_FAILED, fr_flags)
+        hops = hops + vexp.astype(I32)
+        ver_dst = jnp.where(vexp, NO_NODE, lk.ver_dst)
+        ver_to = jnp.where(vexp, T_INF, lk.ver_to)
+    else:
+        ver_dst, ver_to = lk.ver_dst, lk.ver_to
 
     # whole-lookup deadline (only for not-yet-done active lookups)
     dead = lk.active & ~lk.done & (lk.deadline < t_end)
@@ -477,9 +596,35 @@ def on_timeouts(lk: LookupState, t_end, now, cfg: LookupConfig):
     t_done = jnp.where(dead, now, lk.t_done)
 
     return dataclasses.replace(
-        lk, fr_flags=fr_flags, pending_dst=pending_dst, t_to=t_to,
-        retry=retry, refire=refire, hops=hops, done=done,
-        t_done=t_done), failed_nodes
+        lk, fr_flags=fr_flags, pending_dst=pending_dst,
+        pend_prov=pend_prov, t_to=t_to, retry=retry, refire=refire,
+        hops=hops, done=done, t_done=t_done, ver_dst=ver_dst,
+        ver_to=ver_to), failed_nodes, failed_prov
+
+
+def on_pongs(lk: LookupState, msgs, cfg: LookupConfig):
+    """Consume PING_RES messages for S/Kademlia sibling verification
+    ([R]-batch; ``msgs.valid`` pre-masked to the ping-response kind with
+    a == lookup slot).  A pong from the staged candidate completes the
+    lookup verified (IterativeLookup::checkStop ping path)."""
+    if not cfg.verify_siblings:
+        return lk
+    l_dim = lk.active.shape[0]
+    l_r = jnp.clip(msgs.a, 0, l_dim - 1)                       # [R]
+    ok = (msgs.valid & lk.active[l_r] & ~lk.done[l_r]
+          & (lk.gen[l_r] == msgs.b)
+          & (lk.ver_dst[l_r] == msgs.src) & (msgs.src != NO_NODE))
+    fin = jnp.zeros((l_dim,), bool).at[jnp.where(ok, l_r, l_dim)].set(
+        True, mode="drop")
+    win = jnp.zeros((l_dim,), I32).at[jnp.where(ok, l_r, l_dim)].set(
+        jnp.arange(msgs.valid.shape[0], dtype=I32), mode="drop")
+    return dataclasses.replace(
+        lk,
+        done=lk.done | fin,
+        success=lk.success | fin,
+        t_done=jnp.where(fin, msgs.t_deliver[win], lk.t_done),
+        ver_dst=jnp.where(fin, NO_NODE, lk.ver_dst),
+        ver_to=jnp.where(fin, T_INF, lk.ver_to))
 
 
 def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
@@ -528,10 +673,22 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         lk = dataclasses.replace(
             lk, t_to=t_to, refire=jnp.zeros_like(lk.refire))
 
+    # ---- S/Kademlia verification pings (one per staged candidate) ----
+    if cfg.verify_siblings:
+        need_ping = (lk.active & ~lk.done & (lk.ver_dst != NO_NODE)
+                     & (lk.ver_to >= T_INF))
+        outbox.send(need_ping, now, lk.ver_dst, wire.PING_CALL,
+                    a=jnp.arange(l_dim, dtype=I32), b=lk.gen,
+                    size_b=wire.BASE_CALL_B)
+        lk = dataclasses.replace(lk, ver_to=jnp.where(
+            need_ping, now + cfg.rpc_timeout_ns, lk.ver_to))
+
     # ---- new fires: fill free RPC slots from the frontier ----
     frontier, fr_flags = lk.frontier, lk.fr_flags
     visited, vis_n = lk.visited, lk.vis_n
     pending_dst, t_to = lk.pending_dst, lk.t_to
+    pend_prov = lk.pend_prov
+    t_sent_arr = lk.t_sent
     retry = lk.retry
     fired_any = jnp.zeros((l_dim,), bool)
     for _ in range(r_dim):
@@ -541,6 +698,7 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         has_cand = jnp.any(cand_ok, axis=1)
         first = jnp.argmax(cand_ok, axis=1).astype(I32)
         cand = jnp.take_along_axis(frontier, first[:, None], axis=1)[:, 0]
+        prov = jnp.take_along_axis(lk.fr_src, first[:, None], axis=1)[:, 0]
 
         free_col_ok = pending_dst == NO_NODE
         has_free = jnp.any(free_col_ok, axis=1)
@@ -555,6 +713,8 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         vis_n = vis_n + fire.astype(I32)
         fr_flags = fr_flags.at[rows, first].set(F_PENDING, mode="drop")
         pending_dst = pending_dst.at[rows, col].set(cand, mode="drop")
+        pend_prov = pend_prov.at[rows, col].set(prov, mode="drop")
+        t_sent_arr = t_sent_arr.at[rows, col].set(now, mode="drop")
         to_ns = (cfg.rpc_timeout_ns if timeout_fn is None
                  else timeout_fn(cand))
         t_to = t_to.at[rows, col].set(now + to_ns, mode="drop")
@@ -574,6 +734,9 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         frontier != node_idx)
     has_cand = jnp.any(cand_ok, axis=1)
     inflight = jnp.any(pending_dst != NO_NODE, axis=1)
+    if cfg.verify_siblings:
+        # a staged verification counts as in-flight work
+        inflight = inflight | (lk.ver_dst != NO_NODE)
     fail = (lk.active & ~lk.done & ~inflight &
             (~has_cand | (lk.hops >= MAX_HOPS)))
 
@@ -590,7 +753,8 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
 
     lk = dataclasses.replace(
         lk, frontier=frontier, fr_flags=fr_flags, visited=visited,
-        vis_n=vis_n, pending_dst=pending_dst, t_to=t_to, retry=retry,
+        vis_n=vis_n, pending_dst=pending_dst, pend_prov=pend_prov,
+        t_sent=t_sent_arr, t_to=t_to, retry=retry,
         success=success, result=result, done=done, t_done=t_done)
     return lk, fired_any
 
@@ -611,6 +775,9 @@ def take_completions(lk: LookupState, t_end):
         active=lk.active & ~taken,
         done=lk.done & ~taken,
         pending_dst=jnp.where(t2, NO_NODE, lk.pending_dst),
+        pend_prov=jnp.where(t2, NO_NODE, lk.pend_prov),
+        ver_dst=jnp.where(taken, NO_NODE, lk.ver_dst),
+        ver_to=jnp.where(taken, T_INF, lk.ver_to),
         t_to=jnp.where(t2, T_INF, lk.t_to),
         retry=jnp.where(t2, 0, lk.retry),
         refire=jnp.where(t2, False, lk.refire),
@@ -625,6 +792,13 @@ def next_event(lk: LookupState):
     t = jnp.min(jnp.where(act, lk.t_to, T_INF), axis=1)
     t = jnp.minimum(t, jnp.where(lk.active & ~lk.done, lk.deadline, T_INF))
     t = jnp.minimum(t, jnp.where(lk.done, lk.t_done, T_INF))
+    # staged verification: wake immediately when the ping is unsent
+    # (ver_to == T_INF), then at its timeout
+    t = jnp.where(lk.active & ~lk.done & (lk.ver_dst != NO_NODE)
+                  & (lk.ver_to >= T_INF), jnp.int64(0),
+                  jnp.minimum(t, jnp.where(
+                      lk.active & ~lk.done & (lk.ver_dst != NO_NODE),
+                      lk.ver_to, T_INF)))
     # a queued re-send must wake the node immediately (refire can only
     # be set when retries are in play; the engine passes no cfg here so
     # the cheap mask-any stays — it folds to False when never set)

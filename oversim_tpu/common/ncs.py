@@ -40,14 +40,32 @@ NS = 1_000_000_000
 
 @dataclasses.dataclass(frozen=True)
 class NcsParams:
-    """Vivaldi.ned / SVivaldi.ned defaults."""
+    """Vivaldi.ned / SVivaldi.ned / Nps.ned defaults."""
 
     ncs_type: str = "vivaldi"     # "none"|"vivaldi"|"svivaldi"|"simple"
-    dims: int = 2                 # vivaldiDimConfig
+                                  # |"gnp"|"nps"
+    dims: int = 2                 # vivaldiDimConfig / npsDimensions
     coord_c: float = 0.25         # vivaldiCoordConfig (cc)
-    error_c: float = 0.5          # vivaldiErrorConfig (ce)
+    error_c: float = 0.5         # vivaldiErrorConfig (ce)
     enable_height: bool = False   # enableHeightVector
     loss_c: float = 0.5           # SVivaldi loss smoothing
+    # --- GNP / NPS landmark system (src/common/Nps.{h,cc},
+    # src/common/cbr/Landmark.{h,cc}) ---
+    num_landmarks: int = 8        # landmark count (Landmark module
+                                  # instances; slots [0, L) act as
+                                  # landmarks in the vectorized build)
+    ref_points: int = 4           # reference points per node (NPS uses
+                                  # a landmark subset / lower-layer
+                                  # nodes, Nps.h:119-133)
+    gd_iters: int = 12            # coordinate solver iterations —
+                                  # gradient descent on the embedding
+                                  # stress replaces the reference's
+                                  # downhill-simplex (simplex.cc yang.cc)
+    probe_interval: float = 10.0  # host-overlay probe cadence (s)
+
+    @property
+    def is_landmark_type(self) -> bool:
+        return self.ncs_type in ("gnp", "nps")
 
 
 @jax.tree_util.register_dataclass
@@ -57,24 +75,51 @@ class NcsState:
     height: jnp.ndarray   # [N] f32
     error: jnp.ndarray    # [N] f32 — local error estimate (starts 1.0)
     loss: jnp.ndarray     # [N] f32 — SVivaldi loss factor
+    # GNP/NPS landmark-layer fields (zero-width K for other types):
+    layer: jnp.ndarray    # [N] i32 — NPS layer (-1 unresolved, 0 landmark;
+                          # node layer = max(ref layers)+1, Nps.h:119-133)
+    ref_rtt: jnp.ndarray  # [N, K] f32 — RTT samples to reference points
+                          # (-1 = empty slot)
+    ref_xy: jnp.ndarray   # [N, K, D] f32 — their coordinates
+    ref_layer: jnp.ndarray  # [N, K] i32 — their layers
+    ref_n: jnp.ndarray    # [N] i32 — ring write cursor
 
 
 def init(rng, n: int, p: NcsParams) -> NcsState:
-    """Coords start uniform in [-0.2, 0.2] (Vivaldi.cc:46-49)."""
+    """Coords start uniform in [-0.2, 0.2] (Vivaldi.cc:46-49).  For
+    gnp/nps, slots [0, num_landmarks) are the landmark layer (the
+    reference deploys dedicated Landmark modules; the vectorized build
+    pins them to the first slots)."""
+    k = p.ref_points if p.is_landmark_type else 0
+    if p.is_landmark_type:
+        layer = jnp.where(jnp.arange(n) < p.num_landmarks, 0, -1)
+    else:
+        layer = jnp.full((n,), -1)
+    layer = layer.astype(jnp.int32)
     return NcsState(
         coords=jax.random.uniform(rng, (n, p.dims), F32, -0.2, 0.2),
         height=jnp.zeros((n,), F32),
         error=jnp.ones((n,), F32),
-        loss=jnp.zeros((n,), F32))
+        loss=jnp.zeros((n,), F32),
+        layer=layer,
+        ref_rtt=jnp.full((n, k), -1.0, F32),
+        ref_xy=jnp.zeros((n, k, p.dims), F32),
+        ref_layer=jnp.full((n, k), -1, jnp.int32),
+        ref_n=jnp.zeros((n,), jnp.int32))
 
 
 def from_underlay(coords, delay_per_unit: float = 0.001) -> NcsState:
     """SimpleNcs: perfect coordinates from the underlay ground truth."""
-    n = coords.shape[0]
+    n, d = coords.shape
     return NcsState(coords=jnp.asarray(coords, F32) * delay_per_unit,
                     height=jnp.zeros((n,), F32),
                     error=jnp.full((n,), 1e-6, F32),
-                    loss=jnp.ones((n,), F32))
+                    loss=jnp.ones((n,), F32),
+                    layer=jnp.full((n,), -1, jnp.int32),
+                    ref_rtt=jnp.zeros((n, 0), F32),
+                    ref_xy=jnp.zeros((n, 0, d), F32),
+                    ref_layer=jnp.zeros((n, 0), jnp.int32),
+                    ref_n=jnp.zeros((n,), jnp.int32))
 
 
 def distance(xi, hi, xj, hj):
@@ -121,6 +166,108 @@ def update(me: dict, rtt_s, xj, ej, hj, p: NcsParams):
 def slice_of(st: NcsState, idx):
     return dict(coords=st.coords[idx], height=st.height[idx],
                 error=st.error[idx], loss=st.loss[idx])
+
+
+# ---------------------------------------------------------------------------
+# GNP / NPS landmark-layered coordinates (src/common/Nps.{h,cc};
+# Landmark.{h,cc}).  A node measures RTTs to reference points (GNP: the
+# landmarks only; NPS: any already-positioned node, its layer becoming
+# max(ref layers)+1) and solves min_x Σ_k (|x−c_k| − rtt_k)² for its own
+# coordinates.  The reference minimizes with downhill simplex
+# (simplex.cc / yang.cc); here the same objective runs ``gd_iters`` of
+# vectorized gradient descent — identical fixed points, jit-friendly.
+# ---------------------------------------------------------------------------
+
+def nps_accepts(p: NcsParams, my_layer, peer_layer):
+    """May a sample from ``peer_layer`` serve as my reference point?
+    GNP: landmarks only (layer 0).  NPS: any positioned node of a lower
+    layer than the ceiling; landmarks themselves only use fellow
+    landmarks (Landmark coordinate bootstrap)."""
+    if p.ncs_type == "gnp":
+        ok = peer_layer == 0
+    else:
+        ok = peer_layer >= 0
+    return ok & jnp.where(my_layer == 0, peer_layer == 0, True)
+
+
+def nps_add_sample(me: dict, rtt_s, xj, layer_j, p: NcsParams):
+    """Ring-insert one (coords, rtt, layer) reference sample into a
+    node's slice dict (ref_rtt [K], ref_xy [K, D], ref_layer [K],
+    ref_n, layer)."""
+    ok = (rtt_s > 0) & nps_accepts(p, me["layer"], layer_j)
+    k = me["ref_rtt"].shape[0]
+    if k == 0:
+        return me
+    pos = jnp.where(ok, me["ref_n"] % k, k)
+    return dict(
+        me,
+        ref_rtt=me["ref_rtt"].at[pos].set(jnp.asarray(rtt_s, F32),
+                                          mode="drop"),
+        ref_xy=me["ref_xy"].at[pos].set(jnp.asarray(xj, F32), mode="drop"),
+        ref_layer=me["ref_layer"].at[pos].set(layer_j, mode="drop"),
+        ref_n=me["ref_n"] + ok.astype(jnp.int32))
+
+
+def nps_solve(me: dict, p: NcsParams):
+    """Solve this node's coordinates from its reference samples
+    (Nps::doTriangulation equivalent): ``gd_iters`` gradient steps on
+    Σ_k (|x−c_k| − rtt_k)², then error := mean |residual|/rtt and
+    layer := max(ref layers)+1 (landmarks stay layer 0).  No-op until
+    ≥ dims+1 samples are present."""
+    have = me["ref_rtt"] > 0                                  # [K]
+    n_have = jnp.sum(have.astype(jnp.int32))
+    ready = n_have >= p.dims + 1
+    x = me["coords"]
+    lr = jnp.float32(0.5)
+
+    def step(x, _):
+        d = x[None, :] - me["ref_xy"]                         # [K, D]
+        dist = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)      # [K]
+        resid = jnp.where(have, dist - me["ref_rtt"], 0.0)
+        grad = jnp.sum((resid / dist)[:, None] * d, axis=0)
+        return x - lr * grad / jnp.maximum(n_have, 1), None
+
+    x2, _ = jax.lax.scan(step, x, None, length=p.gd_iters)
+    d = x2[None, :] - me["ref_xy"]
+    dist = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
+    rel = jnp.where(have, jnp.abs(dist - me["ref_rtt"])
+                    / jnp.maximum(me["ref_rtt"], 1e-6), 0.0)
+    new_err = jnp.sum(rel) / jnp.maximum(n_have, 1)
+    new_layer = jnp.where(
+        me["layer"] == 0, 0,
+        jnp.max(jnp.where(have, me["ref_layer"], -1)) + 1)
+    return dict(
+        me,
+        coords=jnp.where(ready, x2, x),
+        error=jnp.where(ready, jnp.clip(new_err, 0.0, 10.0), me["error"]),
+        layer=jnp.where(ready, new_layer, me["layer"]))
+
+
+def nps_slice(st: NcsState, idx):
+    return dict(coords=st.coords[idx], error=st.error[idx],
+                layer=st.layer[idx], ref_rtt=st.ref_rtt[idx],
+                ref_xy=st.ref_xy[idx], ref_layer=st.ref_layer[idx],
+                ref_n=st.ref_n[idx])
+
+
+def pack_wire_nps(coords, error, layer, lanes: int):
+    """pack_wire + the NPS layer word (ncsInfo[] carries coords + layer
+    in the reference, Nps.msg)."""
+    d = coords.shape[-1]
+    if lanes < d + 2:
+        raise ValueError("key lanes too narrow for NPS piggyback")
+    payload = jnp.concatenate([coords.astype(F32), error[None].astype(F32)])
+    words = jax.lax.bitcast_convert_type(payload, jnp.uint32)
+    out = jnp.zeros((lanes,), jnp.uint32).at[:d + 1].set(words)
+    return out.at[d + 1].set(
+        jnp.asarray(layer, jnp.int32).astype(jnp.uint32))
+
+
+def unpack_wire_nps(key, dims: int):
+    """Inverse of pack_wire_nps: (coords [D], error, layer)."""
+    payload = jax.lax.bitcast_convert_type(key[:dims + 1], F32)
+    layer = key[dims + 1].astype(jnp.int32)
+    return payload[:dims], payload[dims], layer
 
 
 def pack_wire(coords, error, lanes: int):

@@ -126,3 +126,76 @@ def slice_of(st: NcState, idx):
     return dict(peer=st.peer[idx], rtt_mean=st.rtt_mean[idx],
                 rtt_var=st.rtt_var[idx], last=st.last[idx],
                 live=st.live[idx])
+
+
+def insert_rtts_batch(nc_row: dict, peers, rtt_s, now, en):
+    """Batched :func:`insert_rtt` for a tick's [R] samples in ONE pass
+    (no unrolled per-sample scatter chains — the round-2 perf lesson).
+
+    Deviation from the sequential fold, documented: several same-tick
+    samples for ONE peer collapse to the last lane (one EWMA step
+    instead of R); new peers land in distinct least-recently-updated
+    columns.  Both effects are sub-sample noise for an estimator fed
+    every tick."""
+    c = nc_row["peer"].shape[0]
+    r = peers.shape[0]
+    en = en & (peers != NO_NODE) & (rtt_s > 0)
+    # last-lane-wins for duplicate peers in the batch
+    later_dup = jnp.any(
+        (peers[None, :] == peers[:, None])
+        & en[None, :] & jnp.tril(jnp.ones((r, r), bool), k=-1).T, axis=1)
+    en = en & ~later_dup
+    hit = (nc_row["peer"][None, :] == peers[:, None]) & en[:, None]  # [R,C]
+    found = jnp.any(hit, axis=1)
+    col_hit = jnp.argmax(hit, axis=1).astype(I32)
+    # misses take distinct LRU columns: rank misses, pair with the
+    # columns ordered by last-update (hit columns pushed to the back)
+    hit_col_any = jnp.any(hit, axis=0)                       # [C]
+    order = jnp.argsort(
+        jnp.where(hit_col_any, jnp.int64(2**62), nc_row["last"])
+    ).astype(I32)                                            # [C] LRU first
+    miss = en & ~found
+    miss_rank = jnp.cumsum(miss.astype(I32)) - 1
+    col_miss = order[jnp.clip(miss_rank, 0, c - 1)]
+    col = jnp.where(found, col_hit, col_miss)
+    col = jnp.where(en & (found | (miss_rank < c)), col, c)  # OOB drop
+    old_mean = jnp.where(found, nc_row["rtt_mean"][jnp.clip(col, 0, c - 1)],
+                         -1.0)
+    has_hist = found & (old_mean >= 0)
+    mean = jnp.where(has_hist,
+                     (1 - ALPHA) * old_mean + ALPHA * rtt_s, rtt_s)
+    var = jnp.where(has_hist,
+                    (1 - BETA) * nc_row["rtt_var"][jnp.clip(col, 0, c - 1)]
+                    + BETA * jnp.abs(rtt_s - old_mean), 0.0)
+    return dict(
+        peer=nc_row["peer"].at[col].set(peers, mode="drop"),
+        rtt_mean=nc_row["rtt_mean"].at[col].set(
+            mean.astype(F32), mode="drop"),
+        rtt_var=nc_row["rtt_var"].at[col].set(var.astype(F32),
+                                              mode="drop"),
+        last=nc_row["last"].at[col].set(now, mode="drop"),
+        live=nc_row["live"].at[col].set(S_ALIVE, mode="drop"))
+
+
+def feed_response_rtts(nc: NcState, rtt_src, rtt_s, now, ok) -> NcState:
+    """Fold a tick's RPC-response RTT samples (from
+    lookup.response_rtts) into the cache — one batched pass
+    (NeighborCache::updateNode on every RPC response)."""
+    row = dict(peer=nc.peer, rtt_mean=nc.rtt_mean, rtt_var=nc.rtt_var,
+               last=nc.last, live=nc.live)
+    row = insert_rtts_batch(row, rtt_src, rtt_s, now, ok)
+    return NcState(**row)
+
+
+def adaptive_timeout_fn(nc: NcState, default_ns: int):
+    """Per-destination RPC timeout callback for lookup.pump
+    (optimizeTimeouts, BaseRpc.cc:197-205 → getNodeTimeout,
+    NeighborCache.cc:802).  ``nc`` is this node's slice."""
+    def fn(cands):
+        row = dict(peer=nc.peer, rtt_mean=nc.rtt_mean,
+                   rtt_var=nc.rtt_var, last=nc.last, live=nc.live)
+        t_s = jax.vmap(lambda cnd: node_timeout(
+            row, cnd, default_ns / 1e9))(cands)
+        return jnp.clip((t_s * 1e9).astype(I64),
+                        jnp.int64(int(0.2e9)), jnp.int64(default_ns))
+    return fn

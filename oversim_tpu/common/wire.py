@@ -78,6 +78,10 @@ I3_DELIVER = 103        # server → trigger owner (matched forward)
 # --- Kademlia (src/overlay/kademlia) ---
 KAD_PING_CALL = 40      # routingAdd liveness ping (maintenance)
 KAD_PING_RES = 41
+KAD_DOWNLIST = 42       # KademliaDownlistMessage (Kademlia.cc:1567-1585):
+                        # a=dead node the sender learned from us; receiver
+                        # pings it before evicting (downlist modification,
+                        # enableDownlists)
 
 # --- Pastry / Bamboo (src/overlay/pastry, bamboo; PastryMessage.msg) ---
 PASTRY_STATE_CALL = 20  # RequestStateMessage / leafset push-pull

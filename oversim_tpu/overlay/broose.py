@@ -830,7 +830,7 @@ class BrooseLogic:
             seed_a[:lcfg.frontier], now_a, lcfg, ext=ext_a))
 
         # ------------------------------------------------ lookup timeouts --
-        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        new_lk, failed_nodes, _ = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
         st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes)
 

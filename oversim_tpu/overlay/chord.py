@@ -106,7 +106,12 @@ class ChordState:
     lk: lk_mod.LookupState     # [N, L, ...]
     rr: rt_mod.RouteState      # [N, Q, ...] pending-ACK recursive routes
     cp_sent: jnp.ndarray       # [N] i64 — predecessor-ping send time (RTT)
-    ncs: ncs_mod.NcsState      # [N, ...] Vivaldi coordinates (common/ncs.py)
+    t_nps: jnp.ndarray         # [N] i64 — GNP/NPS landmark-probe timer
+    nps_dst: jnp.ndarray       # [N] i32 — in-flight probe target
+    nps_sent: jnp.ndarray      # [N] i64 — its send time (RTT base)
+    ncs: ncs_mod.NcsState      # [N, ...] coordinates (common/ncs.py:
+                               # vivaldi/svivaldi or gnp/nps landmark
+                               # layers, Nps.h:119-133)
     nc: nc_mod.NcState         # [N, C] RTT cache (adaptive RPC timeouts)
     app: object                # [N, ...] tier-app state (apps/base.py)
     app_glob: object           # simulation-global app state (oracle maps)
@@ -155,7 +160,8 @@ class ChordLogic:
         self.mp = mparams
         self.ncs = ncs_params
         self.ncp = nc_params
-        if spec.lanes < ncs_params.dims + 1:
+        if spec.lanes < ncs_params.dims + (
+                2 if ncs_params.is_landmark_type else 1):
             raise ValueError("key lanes too narrow for the NCS piggyback")
         self._pow2 = K.pow2_table(spec)          # [B, KL] finger offsets
 
@@ -204,6 +210,9 @@ class ChordLogic:
                 self.rcfg or rt_mod.RouteConfig(), self.key_spec.lanes,
                 16))(jnp.arange(n)),
             cp_sent=jnp.zeros((n,), I64),
+            t_nps=jnp.full((n,), T_INF, I64),
+            nps_dst=jnp.full((n,), NO_NODE, I32),
+            nps_sent=jnp.zeros((n,), I64),
             ncs=ncs_mod.init(rng, n, self.ncs),
             nc=nc_mod.init(n, self.ncp),
             app=self.app.init(n),
@@ -237,6 +246,8 @@ class ChordLogic:
         t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
                                      T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        if self.ncs.is_landmark_type:
+            t = jnp.minimum(t, jnp.where(ready, st.t_nps, T_INF))
         if self.rcfg is not None:
             t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
@@ -378,6 +389,9 @@ class ChordLogic:
             t_cp=jnp.where(en, now + jnp.int64(int(p.check_pred_delay * NS)),
                            st.t_cp),
             app=self.app.on_ready(st.app, en, now, rng))
+        if self.ncs.is_landmark_type:
+            st = dataclasses.replace(st, t_nps=jnp.where(
+                en, now + jnp.int64(int(0.3 * NS)), st.t_nps))
         return st
 
     # -- the per-node step ---------------------------------------------------
@@ -764,14 +778,22 @@ class ChordLogic:
         # this node's Vivaldi coordinates (the reference attaches
         # ncsInfo[] to every RPC response, CommonMessages.msg:233 /
         # NeighborCache piggybacking)
+        if self.ncs.is_landmark_type:
+            ping_key = ncs_mod.pack_wire_nps(
+                st.ncs.coords, st.ncs.error, st.ncs.layer, spec.lanes)
+        else:
+            ping_key = ncs_mod.pack_wire(st.ncs.coords, st.ncs.error,
+                                         spec.lanes)
         ob.send(v_r & (msgs.kind == wire.PING_CALL), now_r, msgs.src,
-                wire.PING_RES, a=msgs.a,
-                key=ncs_mod.pack_wire(st.ncs.coords, st.ncs.error,
-                                      spec.lanes),
-                size_b=wire.BASE_CALL_B + 4 * (self.ncs.dims + 1))
+                wire.PING_RES, a=msgs.a, key=ping_key,
+                size_b=wire.BASE_CALL_B + 4 * (
+                    self.ncs.dims
+                    + (2 if self.ncs.is_landmark_type else 1)))
         # ping response: at most one slot matches the in-flight
-        # predecessor ping
-        en_p = v_r & (msgs.kind == wire.PING_RES) & (msgs.src == st.cp_dst)
+        # predecessor ping (a == -3 marks NPS probe pongs — the probe
+        # target can BE the predecessor, so src alone is ambiguous)
+        en_p = v_r & (msgs.kind == wire.PING_RES) & (
+            msgs.src == st.cp_dst) & (msgs.a != -3)
         any_p = jnp.any(en_p)
         r_p = jnp.clip(jnp.argmax(en_p).astype(I32), 0, r_in - 1)
         now_p = msgs.t_deliver[r_p]
@@ -788,10 +810,37 @@ class ChordLogic:
                           error=st.ncs.error, loss=st.ncs.loss)
             upd = ncs_mod.update(me_ncs, jnp.where(any_p, rtt_s, -1.0),
                                  xj, ej, jnp.float32(0.0), self.ncs)
-            st = dataclasses.replace(st, ncs=ncs_mod.NcsState(**upd))
+            st = dataclasses.replace(
+                st, ncs=dataclasses.replace(st.ncs, **upd))
         st = dataclasses.replace(
             st, cp_to=jnp.where(any_p, T_INF, st.cp_to),
             cp_dst=jnp.where(any_p, NO_NODE, st.cp_dst))
+
+        # GNP/NPS landmark-probe pong: RTT sample to the reference point
+        # → triangulate own coords (Nps::doTriangulation equivalent) and
+        # adopt layer = max(ref layers)+1 (Nps.h:119-133)
+        if self.ncs.is_landmark_type:
+            en_np = (v_r & (msgs.kind == wire.PING_RES)
+                     & (msgs.src == st.nps_dst) & (msgs.a == -3))
+            any_np = jnp.any(en_np)
+            r_np = jnp.clip(jnp.argmax(en_np).astype(I32), 0, r_in - 1)
+            xj, ej, lj = ncs_mod.unpack_wire_nps(msgs.key[r_np],
+                                                 self.ncs.dims)
+            rtt_np = (msgs.t_deliver[r_np] - st.nps_sent).astype(
+                jnp.float32) / NS
+            me_np = dict(coords=st.ncs.coords, error=st.ncs.error,
+                         layer=st.ncs.layer, ref_rtt=st.ncs.ref_rtt,
+                         ref_xy=st.ncs.ref_xy, ref_layer=st.ncs.ref_layer,
+                         ref_n=st.ncs.ref_n)
+            me_np = ncs_mod.nps_add_sample(
+                me_np, jnp.where(any_np, rtt_np, -1.0), xj, lj, self.ncs)
+            me_np = ncs_mod.nps_solve(me_np, self.ncs)
+            st = dataclasses.replace(
+                st,
+                ncs=dataclasses.replace(st.ncs, **{
+                    k: jnp.where(any_np, v, getattr(st.ncs, k))
+                    for k, v in me_np.items()}),
+                nps_dst=jnp.where(any_np, NO_NODE, st.nps_dst))
 
         # ------------------------------------------------------- timers ----
         t_end = ctx.t_end
@@ -812,6 +861,43 @@ class ChordLogic:
         st = dataclasses.replace(st, t_join=jnp.where(
             en_j & ~alone_start,
             now_j + jnp.int64(int(p.join_delay * NS)), st.t_join))
+
+        # GNP/NPS probe timer: measure RTT to a reference point — GNP
+        # pings landmarks only; NPS alternates landmarks and random
+        # positioned nodes so higher layers form (Nps.h:119-133)
+        if self.ncs.is_landmark_type:
+            en_np = (st.state == READY) & (st.t_nps < t_end)
+            now_np = jnp.maximum(st.t_nps, t0)
+            # a probe still unanswered when the next probe tick arrives
+            # has timed out (probe_interval >> rpc timeout): clear it so
+            # a lost pong never wedges probing (lost-probe expiry)
+            st = dataclasses.replace(st, nps_dst=jnp.where(
+                en_np, NO_NODE, st.nps_dst))
+            r_a, r_b, r_c = jax.random.split(
+                jax.random.fold_in(rngs[5], 17), 3)
+            lm = jax.random.randint(r_a, (), 0,
+                                    self.ncs.num_landmarks).astype(I32)
+            lm = jnp.where(ctx.alive[jnp.clip(lm, 0, ctx.alive.shape[0]
+                                              - 1)], lm, NO_NODE)
+            if self.ncs.ncs_type == "nps":
+                alt = ctx.sample_ready(r_b, node_idx)
+                use_alt = (jax.random.uniform(r_c, ()) < 0.5) & (
+                    alt != NO_NODE)
+                target_np = jnp.where(use_alt, alt, lm)
+            else:
+                target_np = lm
+            target_np = jnp.where(target_np == node_idx, NO_NODE,
+                                  target_np)
+            fire_np = en_np & (target_np != NO_NODE)
+            ob.send(fire_np, now_np, target_np, wire.PING_CALL,
+                    a=jnp.int32(-3), size_b=wire.BASE_CALL_B)
+            st = dataclasses.replace(
+                st,
+                nps_dst=jnp.where(fire_np, target_np, st.nps_dst),
+                nps_sent=jnp.where(fire_np, now_np, st.nps_sent),
+                t_nps=jnp.where(
+                    en_np, now_np + jnp.int64(
+                        int(self.ncs.probe_interval * NS)), st.t_nps))
 
         # stabilize (handleStabilizeTimerExpired)
         en_s = (st.state == READY) & (st.t_stab < t_end)
@@ -931,7 +1017,7 @@ class ChordLogic:
             lcfg))
 
         # ------------------------------------------------ lookup timeouts --
-        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        new_lk, failed_nodes, _ = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
 
         # stabilize / notify RPC timeout → failed successor
@@ -1063,18 +1149,10 @@ class ChordLogic:
         # ------------------------------------------------------- pump ------
         # adaptive per-destination RPC timeouts from the RTT cache
         # (NeighborCache::getNodeTimeout, NeighborCache.cc:802)
-        def _adaptive_to(cands, _st=st):
-            row = dict(peer=_st.nc.peer, rtt_mean=_st.nc.rtt_mean,
-                       rtt_var=_st.nc.rtt_var, last=_st.nc.last,
-                       live=_st.nc.live)
-            t_s = jax.vmap(lambda c: nc_mod.node_timeout(
-                row, c, lcfg.rpc_timeout_ns / NS))(cands)
-            return jnp.clip((t_s * NS).astype(I64),
-                            jnp.int64(int(0.2 * NS)),
-                            jnp.int64(lcfg.rpc_timeout_ns))
-
-        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[4],
-                                lcfg, timeout_fn=_adaptive_to)
+        new_lk, _ = lk_mod.pump(
+            st.lk, ob, ctx, node_idx, t0, rngs[4], lcfg,
+            timeout_fn=nc_mod.adaptive_timeout_fn(st.nc,
+                                                  lcfg.rpc_timeout_ns))
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----

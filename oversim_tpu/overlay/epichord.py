@@ -650,7 +650,7 @@ class EpiChordLogic:
             seed_a[:lcfg.frontier], now_a, lcfg))
 
         # ------------------------------------------------ lookup timeouts --
-        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        new_lk, failed_nodes, _ = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
         st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes,
                                  t0)

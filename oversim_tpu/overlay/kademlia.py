@@ -20,8 +20,25 @@ minSibling/BucketRefreshInterval=1000s).  State is structure-of-arrays:
     insert; stale-entry replacement).  Nodes learned from
     FindNodeResponse payloads are added unverified (isAlive=false,
     Kademlia.cc:1412): they merge into the sibling table and fill FREE
-    bucket slots only — no displacement (the reference's replacement
-    cache and bucket-ping machinery are TODO);
+    bucket slots only — no displacement;
+  * replacement cache (enableReplacementCache/replacementCandidates,
+    Kademlia.h:86-89): alive candidates rejected by a full bucket enter
+    a per-bucket candidate ring; evictions promote from it
+    (_promote_from_cache); replacementCachePing probes the
+    least-recently-seen entry of a cache-fed bucket;
+  * bucket pings (bucketPingInterval): periodic liveness probe of the
+    oldest-seen routing-table entry, via a bounded per-node ping table
+    (KAD_PING kinds);
+  * downlists (enableDownlists, Kademlia.cc:1543-1585): when a lookup's
+    RPC target finally times out, the responder that reported it gets a
+    KAD_DOWNLIST naming the dead node and pings it before evicting
+    (downlist forwarding to siblings is not modeled);
+  * S/Kademlia secure lookups via LookupConfig(verify_siblings=True)
+    (common/lookup.py: candidate siblings are ping-verified before a
+    lookup completes, IterativeLookup.cc:295-340);
+  * R/Kademlia recursive routing via rcfg (common/route.py:
+    recursiveRoutingHook equivalent — per-hop forwarding over k-bucket
+    findNode with ACK/reroute; Kademlia.cc:1022, Heep ATNAC 2010);
   * isSiblingFor (Kademlia.cc:888): table smaller than numSiblings →
     true; key farther than the furthest sibling while full → false;
     otherwise membership of self in the numSiblings closest of
@@ -37,8 +54,8 @@ minSibling/BucketRefreshInterval=1000s).  State is structure-of-arrays:
     (handleBucketRefreshTimerExpired Kademlia.cc:1591) — repaired one
     lookup at a time off a dirty mask (bounded concurrency);
   * handleFailedNode (Kademlia.cc:979): drop from siblings; stale+1 in
-    buckets, evict when staleCount > maxStaleCount;
-  * downlists (lookupFinished Kademlia.cc:1543) are TODO.
+    buckets, evict when staleCount > maxStaleCount, promote a
+    replacement-cache candidate into the freed slot.
 """
 
 from __future__ import annotations
@@ -53,6 +70,8 @@ from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
 from oversim_tpu.common import malicious as mal_mod
+from oversim_tpu.common import neighborcache as nc_mod
+from oversim_tpu.common import route as rt_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -84,6 +103,24 @@ class KademliaParams:
     bucket_refresh: float = 1000.0    # minBucketRefreshInterval
     redundant_nodes: int = 8      # lookupRedundantNodes
     rpc_timeout: float = 1.5
+    # --- routingAdd depth knobs (Kademlia.h:86-107) ---
+    replacement_cands: int = 0    # replacementCandidates per bucket
+                                  # (0 = enableReplacementCache off)
+    replacement_cache_ping: bool = False  # replacementCachePing: ping the
+                                  # least-recently-seen bucket entry when a
+                                  # candidate enters the cache
+    bucket_ping_interval: float = 0.0  # bucketPingInterval (0 = off):
+                                  # periodic ping of the oldest-seen
+                                  # routing-table entry (NICE-style pings)
+    enable_downlists: bool = False  # enableDownlists (Kademlia.cc:1567):
+                                  # tell responders about dead nodes they
+                                  # returned; receiver pings before evicting
+    ping_slots: int = 4           # bounded concurrent maintenance pings
+    adaptive_timeouts: bool = False  # optimizeTimeouts (BaseRpc.cc:197-
+                                  # 205): RPC timeouts from the
+                                  # NeighborCache RTT estimator
+                                  # (getNodeTimeout, NeighborCache.cc:802)
+                                  # fed by FindNode response RTTs
 
 
 @jax.tree_util.register_dataclass
@@ -99,6 +136,13 @@ class KademliaState:
     t_join: jnp.ndarray     # [N] i64
     t_refresh: jnp.ndarray  # [N] i64 — periodic bucket/sibling refresh tick
     sib_used: jnp.ndarray   # [N] i64 — sibling table lastUsage
+    rc_nodes: jnp.ndarray   # [N, B, RC] i32 — replacement cache ring
+    rc_pos: jnp.ndarray     # [N, B] i32 — its write cursor
+    ping_dst: jnp.ndarray   # [N, Pp] i32 — in-flight maintenance pings
+    ping_to: jnp.ndarray    # [N, Pp] i64 — their timeouts
+    t_bping: jnp.ndarray    # [N] i64 — periodic bucket-ping timer
+    rr: object              # rt_mod.RouteState — R/Kademlia recursive hook
+    nc: object              # nc_mod.NcState — RTT cache (adaptive timeouts)
     lk: lk_mod.LookupState
     app: object                # [N, ...] tier-app state (apps/base.py)
     app_glob: object           # simulation-global app state (oracle maps)
@@ -111,12 +155,22 @@ class KademliaLogic:
                  params: KademliaParams = KademliaParams(),
                  lcfg: lk_mod.LookupConfig | None = None,
                  app=None,
-                 mparams: mal_mod.MaliciousParams = mal_mod.MaliciousParams()):
+                 mparams: mal_mod.MaliciousParams = mal_mod.MaliciousParams(),
+                 rcfg: rt_mod.RouteConfig | None = None):
+        """``rcfg`` switches the app data path to R/Kademlia recursive
+        routing (Kademlia::recursiveRoutingHook, Kademlia.cc:1022;
+        B. Heep, R/Kademlia, ATNAC 2010) — per-hop forwarding over the
+        same k-bucket findNode, with the route engine's ACK/reroute
+        machinery; mode full/source selects the reply transport."""
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg or lk_mod.LookupConfig(merge=True)
         self.app = app or KbrTestApp()
         self.mp = mparams
+        self.rcfg = rcfg
+        # the app's RPC replies follow the call's routing mode
+        if rcfg is not None and getattr(self.app, "rcfg", "no") is None:
+            self.app.rcfg = rcfg
         self._pow2 = K.pow2_table(spec)
 
     # -- engine interface ---------------------------------------------------
@@ -137,7 +191,8 @@ class KademliaLogic:
             scalars=tuple(app["scalars"]) + ("lookup_hops",),
             hists=tuple(app["hists"]),
             counters=tuple(app["counters"]) + (
-                "kad_joins", "lookup_success", "lookup_failed"),
+                "kad_joins", "lookup_success", "lookup_failed",
+                "route_dropped"),
         )
 
     def init(self, rng, n: int) -> KademliaState:
@@ -153,6 +208,17 @@ class KademliaLogic:
             t_join=jnp.full((n,), T_INF, I64),
             t_refresh=jnp.full((n,), T_INF, I64),
             sib_used=jnp.zeros((n,), I64),
+            rc_nodes=jnp.full((n, p.num_buckets, p.replacement_cands),
+                              NO_NODE, I32),
+            rc_pos=jnp.zeros((n, p.num_buckets), I32),
+            ping_dst=jnp.full((n, p.ping_slots), NO_NODE, I32),
+            ping_to=jnp.full((n, p.ping_slots), T_INF, I64),
+            t_bping=jnp.full((n,), T_INF, I64),
+            rr=jax.vmap(lambda _: rt_mod.init(
+                self.rcfg or rt_mod.RouteConfig(), self.key_spec.lanes,
+                16))(jnp.arange(n)),
+            nc=nc_mod.init(n, nc_mod.NcParams(
+                capacity=16 if p.adaptive_timeouts else 1)),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
             app=self.app.init(n),
@@ -183,6 +249,11 @@ class KademliaLogic:
         t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
                                      T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        t = jnp.minimum(t, jnp.min(st.ping_to, axis=1))
+        if self.p.bucket_ping_interval > 0:
+            t = jnp.minimum(t, jnp.where(ready, st.t_bping, T_INF))
+        if self.rcfg is not None:
+            t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
 
     # -- key-space helpers (single node slice) -------------------------------
@@ -282,12 +353,45 @@ class KademliaLogic:
         rows = jnp.where(okc, bi_c, num_b)
         vals = cands[idx_s]
         al_v = a_s == 0
-        return dataclasses.replace(
+        st = dataclasses.replace(
             st,
             buckets=buckets.at[rows, col].set(vals, mode="drop"),
             b_seen=b_seen.at[rows, col].set(
                 jnp.where(al_v, now, jnp.int64(0)), mode="drop"),
             b_stale=b_stale.at[rows, col].set(0, mode="drop"))
+
+        # --- replacement cache (enableReplacementCache, Kademlia.cc:
+        # routingAdd full-bucket branch): alive candidates that found no
+        # slot enter the bucket's bounded candidate ring; a later
+        # eviction promotes one (see _handle_failed).  Ring overwrite
+        # replaces the reference's LRU-bounded cache list.
+        rc = p.replacement_cands
+        if rc:
+            rej = (b_s < num_b) & ~okc & al_v
+            rej_rank = rank - limit
+            pos = (st.rc_pos[bi_c] + jnp.maximum(rej_rank, 0)) % rc
+            rrows = jnp.where(rej, bi_c, num_b)
+            new_rc = st.rc_nodes.at[rrows, pos].set(vals, mode="drop")
+            rej_per_b = jnp.zeros((num_b,), I32).at[rrows].add(
+                1, mode="drop")
+            st = dataclasses.replace(
+                st, rc_nodes=new_rc,
+                rc_pos=(st.rc_pos + rej_per_b) % rc)
+            # replacementCachePing: give the least-recently-seen entry
+            # of each cache-fed bucket a liveness check so stale entries
+            # make room (one ping candidate per tick, bounded ping slots)
+            if p.replacement_cache_ping:
+                fed = jnp.zeros((num_b,), bool).at[rrows].set(
+                    True, mode="drop")
+                seen_k = jnp.where(
+                    (st.buckets != NO_NODE) & fed[:, None],
+                    st.b_seen, T_INF)
+                flat_i = jnp.argmin(seen_k.reshape(-1))
+                cand_p = st.buckets.reshape(-1)[flat_i]
+                rc_ping = jnp.where(
+                    jnp.any(fed) & (cand_p != NO_NODE), cand_p, NO_NODE)
+                return st, rc_ping
+        return st, NO_NODE
 
     def _routing_add_batch(self, ctx, st, me_key, node_idx, cands, alive,
                            now):
@@ -322,7 +426,39 @@ class KademliaLogic:
                               jnp.where(became_sib, NO_NODE, cands)])
         ba = jnp.concatenate([jnp.ones(disp_vec.shape, bool),
                               alive | in_disp])
-        return self._bucket_update_batch(ctx, st, me_key, bc, ba, now)
+        st, rc_ping = self._bucket_update_batch(ctx, st, me_key, bc, ba,
+                                                now)
+        return st, rc_ping
+
+    def _promote_from_cache(self, st, evict):
+        """Replacement-cache promotion: for each bucket that just lost an
+        entry, move one cached candidate into the freed slot (reference
+        routingTimeout pulls from the replacement cache).  ``evict``
+        [B, K] marks the slots freed this pass."""
+        rc = self.p.replacement_cands
+        if not rc:
+            return st
+        have_rc = st.rc_nodes != NO_NODE                       # [B, RC]
+        can = jnp.any(evict, axis=1) & jnp.any(have_rc, axis=1)  # [B]
+        col_rc = jnp.argmax(have_rc, axis=1)                   # [B]
+        col_k = jnp.argmax(evict, axis=1)                      # [B]
+        num_b = evict.shape[0]
+        promoted = st.rc_nodes[jnp.arange(num_b), col_rc]
+        # the ring is not deduplicated: a cached node may have re-entered
+        # its bucket (or hold a second ring copy) since it was cached —
+        # promotion of an already-present node would break the one-slot-
+        # per-node bucket invariant, so such copies are only purged here
+        already = jnp.any(st.buckets == promoted[:, None], axis=1)
+        rows_any = jnp.where(can, jnp.arange(num_b, dtype=I32), num_b)
+        rows = jnp.where(can & ~already,
+                         jnp.arange(num_b, dtype=I32), num_b)
+        return dataclasses.replace(
+            st,
+            buckets=st.buckets.at[rows, col_k].set(promoted, mode="drop"),
+            b_seen=st.b_seen.at[rows, col_k].set(0, mode="drop"),
+            b_stale=st.b_stale.at[rows, col_k].set(0, mode="drop"),
+            rc_nodes=st.rc_nodes.at[rows_any, col_rc].set(NO_NODE,
+                                                          mode="drop"))
 
     def _find_node(self, ctx, st, me_key, node_idx, key, rmax):
         """Top-R closest known nodes by XOR distance (Kademlia.cc:1101).
@@ -400,20 +536,27 @@ class KademliaLogic:
         strikes = jnp.where(st.buckets != NO_NODE, strikes, 0)
         stale = st.b_stale + strikes
         evict = (strikes > 0) & (stale > self.p.max_stale)
-        return dataclasses.replace(
+        st = dataclasses.replace(
             st,
             buckets=jnp.where(evict, NO_NODE, st.buckets),
             b_stale=jnp.where(evict, 0, stale),
             b_seen=jnp.where(evict, 0, st.b_seen))
+        return self._promote_from_cache(st, evict)
 
     def _become_ready(self, ctx, st, en, now, rng):
         p = self.p
+        t_bping = st.t_bping
+        if p.bucket_ping_interval > 0:
+            t_bping = jnp.where(
+                en, now + jnp.int64(int(p.bucket_ping_interval * NS)),
+                t_bping)
         return dataclasses.replace(
             st,
             state=jnp.where(en, READY, st.state),
             t_join=jnp.where(en, T_INF, st.t_join),
             # immediate bucket refresh pass after join (Kademlia.cc:1043)
             t_refresh=jnp.where(en, now, st.t_refresh),
+            t_bping=t_bping,
             app=self.app.on_ready(st.app, en, now, rng))
 
     # -- the per-node step ---------------------------------------------------
@@ -446,6 +589,14 @@ class KademliaLogic:
 
         # FindNodeResponses → lookup engine (one batched pass)
         en_res = v_r & (msgs.kind == wire.FINDNODE_RES)
+        if p.adaptive_timeouts:
+            # RTT samples from this tick's responses feed the
+            # NeighborCache estimator BEFORE the pendings are cleared
+            # (NeighborCache::updateNode on every RPC response)
+            rtt_src, rtt_s, rtt_ok = lk_mod.response_rtts(
+                st.lk, dataclasses.replace(msgs, valid=en_res))
+            st = dataclasses.replace(st, nc=nc_mod.feed_response_rtts(
+                st.nc, rtt_src, rtt_s, t_del_r, rtt_ok))
         st = dataclasses.replace(st, lk=lk_mod.on_responses(
             st.lk, dataclasses.replace(msgs, valid=en_res), metric_fn, lcfg))
 
@@ -460,13 +611,62 @@ class KademliaLogic:
             [jnp.ones((r_in,), bool),
              jnp.zeros((learned.size,), bool)])
         now_add = jnp.max(jnp.where(v_r, t_del_r, 0))
-        st = self._routing_add_batch(ctx, st, me_key, node_idx, add_cands,
-                                     add_alive, now_add)
+        st, rc_ping = self._routing_add_batch(ctx, st, me_key, node_idx,
+                                              add_cands, add_alive, now_add)
 
-        # FindNodeCalls → batched findNode + sibling flags
-        en_call = v_r & (msgs.kind == wire.FINDNODE_CALL)
+        # batched findNode + sibling flags for every inbox key: consumed
+        # by the FindNodeCall responder below AND (R/Kademlia) by the
+        # recursive route pre-pass as its forwarding candidates
         res_b, sib_b = self._find_node_batch(ctx, st, me_key, node_idx,
                                              msgs.key, rmax)
+
+        if self.rcfg is not None:
+            # R/Kademlia recursive hook (Kademlia::recursiveRoutingHook,
+            # Kademlia.cc:1022; generic loop BaseOverlay.cc:1441-1581):
+            # ACK the previous hop, forward or decapsulate — the same
+            # pre-pass chord.py runs, driven by k-bucket findNode results
+            rcfg = self.rcfg
+            st = dataclasses.replace(st, rr=rt_mod.on_acks(
+                st.rr, dataclasses.replace(
+                    msgs,
+                    valid=v_r & (msgs.kind == wire.KBR_ROUTE_ACK))))
+            en_sro = v_r & (msgs.kind == wire.KBR_SROUTE)
+            deliver_sr = rt_mod.sroute_step(ob, msgs)
+            msgs = dataclasses.replace(
+                msgs,
+                kind=jnp.where(deliver_sr, msgs.d, msgs.kind),
+                src=jnp.where(deliver_sr, msgs.c, msgs.src),
+                valid=v_r & (~en_sro | deliver_sr))
+            v_r = msgs.valid
+            en_rt = v_r & (msgs.kind == wire.KBR_ROUTE) & (
+                st.state == READY)
+            ob.send(en_rt & (msgs.nonce > 0), t_del_r, msgs.src,
+                    wire.KBR_ROUTE_ACK, nonce=msgs.nonce,
+                    size_b=wire.BASE_CALL_B)
+            deliver_rt = en_rt & sib_b
+            nxt_v, found_v = jax.vmap(
+                rt_mod.pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
+                res_b, msgs.nodes, msgs.src, msgs.nodes[:, 0], node_idx,
+                sib_b)
+            fwd = en_rt & ~sib_b & found_v & (msgs.hops < rcfg.hop_max)
+            visited2 = rt_mod.append_visited(msgs.nodes, node_idx, fwd)
+            st = dataclasses.replace(st, rr=rt_mod.forward_batch(
+                st.rr, ob, fwd, t_del_r, nxt_v, key=msgs.key, inner=msgs.d,
+                a=msgs.a, b=msgs.b, c=msgs.c, hops=msgs.hops + 1,
+                stamp=msgs.stamp, size_b=msgs.size_b - rcfg.overhead_b,
+                visited=visited2, cfg=rcfg))
+            routedrop_cnt = jnp.sum((en_rt & ~sib_b & ~fwd).astype(I32))
+            msgs = dataclasses.replace(
+                msgs,
+                kind=jnp.where(deliver_rt, msgs.d, msgs.kind),
+                src=jnp.where(deliver_rt, msgs.nodes[:, 0], msgs.src),
+                valid=v_r & (~en_rt | deliver_rt))
+            v_r = msgs.valid
+        else:
+            routedrop_cnt = jnp.int32(0)
+
+        # FindNodeCalls → responder
+        en_call = v_r & (msgs.kind == wire.FINDNODE_CALL)
         # byzantine switches (common/malicious.py; statically no-op by
         # default).  Only the wire copy is attacked; the honest ``sib_b``
         # feeds the app deliver check below (wrong-node detection)
@@ -482,9 +682,38 @@ class KademliaLogic:
                 nodes=res_atk,
                 size_b=wire.findnode_res_b(p.redundant_nodes))
 
-        # ping (generic liveness)
+        # ping (generic liveness; b echoes the caller's generation so
+        # verification pongs can be stale-guarded, lookup.on_pongs)
         ob.send(v_r & (msgs.kind == wire.PING_CALL), t_del_r, msgs.src,
-                wire.PING_RES, a=msgs.a, size_b=wire.BASE_CALL_B)
+                wire.PING_RES, a=msgs.a, b=msgs.b, size_b=wire.BASE_CALL_B)
+
+        # S/Kademlia sibling-verification pongs (lookup engine pings its
+        # staged candidate, IterativeLookup.cc:295-340)
+        if lcfg.verify_siblings:
+            st = dataclasses.replace(st, lk=lk_mod.on_pongs(
+                st.lk, dataclasses.replace(
+                    msgs, valid=v_r & (msgs.kind == wire.PING_RES)), lcfg))
+
+        # maintenance pings (bucket pings / replacement-cache pings /
+        # downlist verification, Kademlia.h bucketPingInterval &
+        # replacementCachePing): KAD_PING kinds keep their pongs separate
+        # from the lookup engine's verification pings
+        ob.send(v_r & (msgs.kind == wire.KAD_PING_CALL), t_del_r, msgs.src,
+                wire.KAD_PING_RES, a=msgs.a, size_b=wire.BASE_CALL_B)
+        en_kpr = v_r & (msgs.kind == wire.KAD_PING_RES)
+        pong_hit = jnp.any(
+            st.ping_dst[:, None] == jnp.where(en_kpr, msgs.src,
+                                              NO_NODE)[None, :], axis=1)
+        st = dataclasses.replace(
+            st,
+            ping_dst=jnp.where(pong_hit, NO_NODE, st.ping_dst),
+            ping_to=jnp.where(pong_hit, T_INF, st.ping_to))
+
+        # downlist receive (KademliaDownlistMessage, Kademlia.cc:1305-
+        # 1319): ping each reported-dead node before believing it —
+        # queued into the bounded ping table below
+        dl_cands = jnp.where(
+            v_r & (msgs.kind == wire.KAD_DOWNLIST), msgs.a, NO_NODE)
 
         # app-owned message kinds (Common API deliver path)
         if hasattr(self.app, "on_msgs"):
@@ -540,6 +769,66 @@ class KademliaLogic:
         sib_stale = en_r & (st.sib_used + jnp.int64(
             int(p.sibling_refresh * NS)) < now_r)
 
+        # ----------------------------------------- maintenance pings ----
+        # ping timeouts: unresponsive pinged nodes are failures
+        ping_exp = (st.ping_dst != NO_NODE) & (st.ping_to < t_end)
+        ping_failed = jnp.where(ping_exp, st.ping_dst, NO_NODE)   # [Pp]
+        st = dataclasses.replace(
+            st,
+            ping_dst=jnp.where(ping_exp, NO_NODE, st.ping_dst),
+            ping_to=jnp.where(ping_exp, T_INF, st.ping_to))
+
+        # bucket-ping timer (bucketPingInterval): probe the oldest-seen
+        # routing-table entry so silent deaths surface between refreshes
+        if p.bucket_ping_interval > 0:
+            en_bp = (st.state == READY) & (st.t_bping < t_end)
+            now_bp = jnp.maximum(st.t_bping, t0)
+            seen_all = jnp.where(st.buckets != NO_NODE, st.b_seen, T_INF)
+            flat_bp = jnp.argmin(seen_all.reshape(-1))
+            bp_cand = jnp.where(en_bp, st.buckets.reshape(-1)[flat_bp],
+                                NO_NODE)
+            st = dataclasses.replace(st, t_bping=jnp.where(
+                en_bp,
+                now_bp + jnp.int64(int(p.bucket_ping_interval * NS)),
+                st.t_bping))
+        else:
+            bp_cand = NO_NODE
+
+        # queue this tick's ping candidates (downlist verifications, the
+        # replacement-cache ping, the bucket ping) into free ping slots —
+        # the same rank trick as route.forward_batch; overflow lanes drop
+        # (retried next downlist/interval)
+        ping_cands = jnp.concatenate(
+            [dl_cands,
+             jnp.stack([jnp.asarray(rc_ping, I32),
+                        jnp.asarray(bp_cand, I32)])])            # [R+2]
+        # skip nodes already being pinged
+        dup_p = jnp.any(
+            ping_cands[:, None] == st.ping_dst[None, :], axis=1)
+        ping_cands = jnp.where(dup_p | K.dup_mask(ping_cands), NO_NODE,
+                               ping_cands)
+        en_p = ping_cands != NO_NODE
+        lane_rank = jnp.cumsum(en_p.astype(I32)) - 1
+        free_p = st.ping_dst == NO_NODE
+        slot_rank = jnp.cumsum(free_p.astype(I32)) - 1
+        n_free_p = jnp.sum(free_p.astype(I32))
+        pp = p.ping_slots
+        slot_of_rank = jnp.full((pp,), pp, I32).at[
+            jnp.where(free_p, slot_rank, pp)].set(
+            jnp.arange(pp, dtype=I32), mode="drop")
+        lane_slot = jnp.where(
+            en_p & (lane_rank < n_free_p),
+            slot_of_rank[jnp.clip(lane_rank, 0, pp - 1)], pp)
+        sent_p = lane_slot < pp
+        ob.send(sent_p, t0, ping_cands, wire.KAD_PING_CALL,
+                size_b=wire.BASE_CALL_B)
+        st = dataclasses.replace(
+            st,
+            ping_dst=st.ping_dst.at[lane_slot].set(ping_cands,
+                                                   mode="drop"),
+            ping_to=st.ping_to.at[lane_slot].set(
+                t0 + jnp.int64(int(p.rpc_timeout * NS)), mode="drop"))
+
         # app timer
         # graceful-leave: hand app data to the closest sibling and stop
         # firing app tests during the grace window (apps/base.py on_leave)
@@ -591,8 +880,29 @@ class KademliaLogic:
             res_local = jnp.concatenate([res_local, jnp.full(
                 (lcfg.frontier - res_local.shape[0],), NO_NODE, I32)])
         slot, have = lk_mod.free_slot(st.lk)
-        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
-        insta_fail = req.want & ~sib_a & ~start_app
+        if self.rcfg is not None and hasattr(self.app, "route_policy"):
+            # R/Kademlia data path: payloads the app declares routable
+            # are forwarded hop-by-hop (recursiveRoutingHook at the
+            # originator); the rest keep the iterative engine
+            routable, inner_a, is_rpc = self.app.route_policy(req.tag)
+            route_fire = (req.want & ~sib_a & routable
+                          & (seed_a[0] != NO_NODE))
+            vis0 = jnp.full((rmax,), NO_NODE, I32).at[0].set(node_idx)
+            st = dataclasses.replace(st, rr=rt_mod.forward(
+                st.rr, ob, route_fire, now_a, seed_a[0], key=req.key,
+                inner=inner_a, a=req.tag, b=jnp.int32(0),
+                c=ctx.measuring.astype(I32), hops=jnp.int32(1),
+                stamp=now_a, size_b=jnp.int32(100), visited=vis0,
+                cfg=self.rcfg))
+            if hasattr(self.app, "on_route_fired"):
+                st = dataclasses.replace(st, app=self.app.on_route_fired(
+                    st.app, route_fire & is_rpc, now_a, req.tag))
+            start_app = (req.want & ~sib_a & ~routable & have
+                         & (seed_a[0] != NO_NODE))
+        else:
+            route_fire = jnp.bool_(False)
+            start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app & ~route_fire
         st = dataclasses.replace(st, app=self.app.on_lookup_done(
             st.app, app_base.LookupDone(
                 en=local | insta_fail, success=local, tag=req.tag,
@@ -605,10 +915,44 @@ class KademliaLogic:
             seed_a[:lcfg.frontier], now_a, lcfg))
 
         # ------------------------------------------------ lookup timeouts --
-        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        new_lk, failed_nodes, failed_prov = lk_mod.on_timeouts(
+            st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
-        # one batched repair for the tick's [L * parallel_rpcs] failures
-        st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes)
+        # downlists (Kademlia.cc:1543-1585): tell each responder which of
+        # the nodes it returned turned out dead; the receiver pings them
+        # (KAD_DOWNLIST handler above) before evicting
+        if p.enable_downlists:
+            en_dl = (failed_nodes != NO_NODE) & (failed_prov != NO_NODE)
+            ob.send(en_dl, t0, failed_prov, wire.KAD_DOWNLIST,
+                    a=failed_nodes,
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+        # one batched repair for the tick's failures: lookup RPC
+        # timeouts + maintenance-ping timeouts
+        st = self._handle_failed(
+            ctx, st, me_key, node_idx,
+            jnp.concatenate([failed_nodes, ping_failed]))
+        # R/Kademlia: reroute parked route messages around failed hops
+        # (the failed hop was just dropped from the tables; a node that
+        # became responsible meanwhile self-delivers)
+        if self.rcfg is not None:
+            new_rr, rt_failed, rt_retry = rt_mod.on_timeouts(
+                st.rr, t_end, self.rcfg)
+            st = dataclasses.replace(st, rr=new_rr)
+            st = self._handle_failed(ctx, st, me_key, node_idx, rt_failed)
+            nxt_q, sib_q = self._find_node_batch(
+                ctx, st, me_key, node_idx, st.rr.key, rmax)
+            nxt_q2, found_q = jax.vmap(
+                rt_mod.pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
+                nxt_q, st.rr.visited, rt_failed,
+                st.rr.visited[:, 0], node_idx, sib_q)
+            nxt_fin = jnp.where(sib_q, node_idx, nxt_q2)
+            ok_q = rt_retry & (sib_q | found_q)
+            st = dataclasses.replace(st, rr=rt_mod.reforward_batch(
+                st.rr, ob, ok_q, t0, nxt_fin, self.rcfg))
+            give_up = rt_retry & ~ok_q
+            st = dataclasses.replace(st, rr=rt_mod.drop_slots(
+                st.rr, give_up))
+            routedrop_cnt += jnp.sum(give_up.astype(I32))
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
@@ -685,8 +1029,13 @@ class KademliaLogic:
                             target_ref, seed_r[:lcfg.frontier], t0, lcfg))
 
         # ------------------------------------------------------- pump ------
+        # adaptive per-destination RPC timeouts from the RTT cache
+        # (getNodeTimeout, NeighborCache.cc:802; optimizeTimeouts)
+        timeout_fn = (nc_mod.adaptive_timeout_fn(st.nc, lcfg.rpc_timeout_ns)
+                      if p.adaptive_timeouts else None)
         new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[6], lcfg,
-                                num_redundant=p.redundant_nodes)
+                                num_redundant=p.redundant_nodes,
+                                timeout_fn=timeout_fn)
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----
@@ -694,6 +1043,7 @@ class KademliaLogic:
             "c:kad_joins": joins_cnt,
             "c:lookup_success": lksucc_cnt,
             "c:lookup_failed": anyfail_cnt,
+            "c:route_dropped": routedrop_cnt,
             "s:lookup_hops": comp_hops_ev,
         }
         ev.finish(events, self.app.hist_map)

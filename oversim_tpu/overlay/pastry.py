@@ -58,6 +58,7 @@ from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import neighborcache as nc_mod
 from oversim_tpu.common import route as rt_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
@@ -92,6 +93,10 @@ class PastryParams:
     routing_mode: str = "semi-recursive"   # or "iterative"
     route_acks: bool = True       # routeMsgAcks
     rec_redundant: int = 4        # recNumRedundantNodes (default.ini:386: 3)
+    adaptive_timeouts: bool = False  # optimizeTimeouts (BaseRpc.cc:197-
+                                  # 205): iterative-lookup RPC timeouts
+                                  # from the NeighborCache estimator
+                                  # (getNodeTimeout, NeighborCache.cc:802)
 
     @property
     def cols(self) -> int:
@@ -116,6 +121,7 @@ class PastryState:
     t_gt: jnp.ndarray       # [N] i64 global tuning
     lk: lk_mod.LookupState
     rr: rt_mod.RouteState   # [N, Q, ...] pending-ACK recursive routes
+    nc: object              # nc_mod.NcState — RTT cache (adaptive timeouts)
     app: object
     app_glob: object
 
@@ -170,6 +176,8 @@ class PastryLogic:
                 jnp.arange(n)),
             rr=jax.vmap(lambda _: rt_mod.init(
                 self.rcfg, self.key_spec.lanes, 16))(jnp.arange(n)),
+            nc=nc_mod.init(n, nc_mod.NcParams(
+                capacity=16 if self.p.adaptive_timeouts else 1)),
             app=self.app.init(n),
             app_glob=self.app.glob_init(rng),
         )
@@ -417,6 +425,15 @@ class PastryLogic:
         routedrop_cnt = jnp.int32(0)
 
         # ------------------------------------------------------- inbox -----
+        if p.adaptive_timeouts:
+            # FindNode RTT samples feed the NeighborCache estimator
+            # before the per-slot handlers clear the pendings
+            # (NeighborCache::updateNode on every RPC response)
+            en_rtt = msgs.valid & (msgs.kind == wire.FINDNODE_RES)
+            rtt_src, rtt_s, rtt_ok = lk_mod.response_rtts(
+                st.lk, dataclasses.replace(msgs, valid=en_rtt))
+            st = dataclasses.replace(st, nc=nc_mod.feed_response_rtts(
+                st.nc, rtt_src, rtt_s, msgs.t_deliver, rtt_ok))
         for r in range(msgs.valid.shape[0]):
             m = msgs.slot(r)
             now = m.t_deliver
@@ -629,7 +646,7 @@ class PastryLogic:
                 seed_a[:lcfg.frontier], now_a, lcfg))
 
         # ------------------------------------------------ lookup timeouts --
-        new_lk, failed_nodes = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
+        new_lk, failed_nodes, _ = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
         # route-hop ACK timeouts: unresponsive next hops are failures too
         new_rr, rt_failed, rt_retry = rt_mod.on_timeouts(st.rr, t_end,
@@ -692,7 +709,11 @@ class PastryLogic:
                 ctx, ob, ev, t0, node_idx))
 
         # ------------------------------------------------------- pump ------
-        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[0], lcfg)
+        # getNodeTimeout (NeighborCache.cc:802) per destination
+        timeout_fn = (nc_mod.adaptive_timeout_fn(st.nc, lcfg.rpc_timeout_ns)
+                      if p.adaptive_timeouts else None)
+        new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[0], lcfg,
+                                timeout_fn=timeout_fn)
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----

@@ -44,7 +44,8 @@ def test_vivaldi_converges():
                 jnp.where(pr == i, -1.0, rtt[i, pr]),
                 st.coords[pr], st.error[pr], st.height[pr], p))(
                     jnp.arange(n), peers)
-        return ncs_mod.NcsState(**upd)
+        import dataclasses as _dc
+        return _dc.replace(st, **upd)
 
     for i in range(300):
         st = one_round(st, jax.random.PRNGKey(100 + i))
@@ -103,3 +104,104 @@ def test_timeout_state():
     row = nc_mod.set_state(row, jnp.int32(7), nc_mod.S_TIMEOUT)
     _, alive = nc_mod.get_prox(row, jnp.int32(7))
     assert not bool(alive)
+
+
+def _nps_round(st, p, n, rng, rtt):
+    """One probe round: every node samples one reference point (GNP:
+    a landmark; NPS: a landmark or a random positioned node)."""
+    import dataclasses as dc
+    r1, r2, r3 = jax.random.split(rng, 3)
+    lm = jax.random.randint(r1, (n,), 0, p.num_landmarks)
+    if p.ncs_type == "nps":
+        alt = jax.random.randint(r2, (n,), 0, n)
+        use_alt = (jax.random.uniform(r3, (n,)) < 0.5) & (
+            st.layer[alt] >= 0)
+        peers = jnp.where(use_alt, alt, lm)
+    else:
+        peers = lm
+    peers = jnp.where(peers == jnp.arange(n), (peers + 1) % n, peers)
+
+    def per_node(i, pr):
+        me = dict(coords=st.coords[i], error=st.error[i],
+                  layer=st.layer[i], ref_rtt=st.ref_rtt[i],
+                  ref_xy=st.ref_xy[i], ref_layer=st.ref_layer[i],
+                  ref_n=st.ref_n[i])
+        me = ncs_mod.nps_add_sample(me, rtt[i, pr], st.coords[pr],
+                                    st.layer[pr], p)
+        return ncs_mod.nps_solve(me, p)
+
+    upd = jax.vmap(per_node)(jnp.arange(n), peers)
+    return dc.replace(st, **upd)
+
+
+def test_gnp_landmark_embedding():
+    """GNP: landmarks anchor the space; every other node resolves layer-1
+    coordinates whose pairwise predictions track the true RTT matrix."""
+    n, p = 24, ncs_mod.NcsParams(ncs_type="gnp", num_landmarks=4,
+                                 ref_points=4)
+    rtt, _ = _true_rtts(n, jax.random.PRNGKey(2))
+    st = ncs_mod.init(jax.random.PRNGKey(3), n, p)
+    assert int((np.asarray(st.layer) == 0).sum()) == 4
+    err0 = _embedding_err(st, rtt)
+    for i in range(60):
+        st = _nps_round(st, p, n, jax.random.PRNGKey(200 + i), rtt)
+    layer = np.asarray(st.layer)
+    assert (layer[:4] == 0).all()
+    assert (layer[4:] == 1).all(), layer       # all resolved via landmarks
+    err1 = _embedding_err(st, rtt)
+    assert err1 < err0 / 3, (err0, err1)
+
+
+def test_nps_layers_form():
+    """NPS: nodes may triangulate off positioned non-landmarks, so layers
+    above 1 appear (layer = max(ref layers)+1, Nps.h:119-133)."""
+    n, p = 24, ncs_mod.NcsParams(ncs_type="nps", num_landmarks=4,
+                                 ref_points=4)
+    rtt, _ = _true_rtts(n, jax.random.PRNGKey(4))
+    st = ncs_mod.init(jax.random.PRNGKey(5), n, p)
+    for i in range(80):
+        st = _nps_round(st, p, n, jax.random.PRNGKey(400 + i), rtt)
+    layer = np.asarray(st.layer)
+    assert (layer[:4] == 0).all()
+    assert (layer[4:] >= 1).all(), layer
+    assert (layer > 1).any(), layer            # hierarchy actually formed
+    err1 = _embedding_err(st, rtt)
+    assert err1 < 0.05, err1
+
+
+def test_nps_wire_roundtrip():
+    p = ncs_mod.NcsParams(ncs_type="nps")
+    coords = jnp.asarray([0.01, -0.02], jnp.float32)
+    key = ncs_mod.pack_wire_nps(coords, jnp.float32(0.3), jnp.int32(2), 5)
+    c2, e2, l2 = ncs_mod.unpack_wire_nps(key, 2)
+    np.testing.assert_allclose(np.asarray(c2), np.asarray(coords))
+    assert abs(float(e2) - 0.3) < 1e-6
+    assert int(l2) == 2
+
+
+def test_gnp_hosted_by_chord():
+    """End-to-end: Chord hosts GNP probing (t_nps timer + PING piggyback);
+    non-landmark nodes resolve layer-1 coordinates whose predicted RTTs
+    are physically plausible for the underlay's coord field."""
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu.engine import sim as sim_mod
+    from oversim_tpu.overlay.chord import ChordLogic
+
+    p = ncs_mod.NcsParams(ncs_type="gnp", num_landmarks=4, ref_points=4,
+                          probe_interval=5.0)
+    logic = ChordLogic(ncs_params=p)
+    cp = churn_mod.ChurnParams(model="none", target_num=12,
+                               init_interval=0.5)
+    ep = sim_mod.EngineParams(window=0.020, transition_time=20.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=13)
+    st = s.run_until(st, 200.0, chunk=256)
+    layer = np.asarray(st.logic.ncs.layer)
+    assert (layer[:4] == 0).all()
+    assert (layer[4:] >= 1).all(), layer
+    # coordinates embed one-way delays: for the default 150x150 field at
+    # 0.001 s/unit, predicted distances must land in (0, ~0.5 s)
+    coords = np.asarray(st.logic.ncs.coords)
+    d = np.linalg.norm(coords[:, None, :] - coords[None, :, :], axis=-1)
+    off = ~np.eye(12, dtype=bool)
+    assert 0.0 < d[off].mean() < 0.5, d[off].mean()
