@@ -27,6 +27,22 @@ the overlay logic drives from inside its vmapped per-node step:
       # NF_OVERLAY_NODE_GRACEFUL_LEAVE): hand state over to ``handover``
       # (the overlay's succession candidate) before the final kill
 
+Optional hooks (overlays probe with hasattr; absent = zero graph cost):
+
+  forward(state_n, msgs, ctx) -> veto bool (same shape as msgs.valid)
+      # Common API forward() (BaseApp.h:214, BaseOverlay::callForward
+      # :523): inspect messages being recursively routed THROUGH this
+      # node; True vetoes the hop (the message is dropped — the
+      # reference's forwardResponse without a next hop)
+  on_update(state_n, en, ctx, ob, ev, now, node_idx, added) -> state_n
+      # Common API update() (BaseApp.h:223, BaseOverlay::callUpdate
+      # :640): ``added`` lists nodes that ENTERED this node's
+      # sibling/replica set this tick (NO_NODE padded); the DHT uses it
+      # for update()-driven maintenance re-replication
+  on_tick(state_n, ctx, ob, ev, node_idx) -> state_n
+      # every-tick outbox access (paced pumps); called by
+      # ``leave_protocol`` from every overlay step
+
 All hooks are pure functions over one node's slice (vmapped), except
 ``init/glob_init/post_step/on_ready/on_stop/next_event`` which see full
 [N, ...] arrays.  ``ev`` is an `AppEvents` accumulator; ``ob`` the
@@ -67,10 +83,14 @@ class LookupDone:
 
 def leave_protocol(app_obj, app_state, ctx, ob, ev, t0, node_idx,
                    handover, ready):
-    """Per-tick graceful-leave sequence shared by every overlay step:
-    graceful leavers hand data to ``handover`` (on_leave), and every
-    leaver parks its app timers (on_stop — the reference's
-    BaseApp::handleNodeLeaveNotification cancels the periodic tests)."""
+    """Per-tick app housekeeping shared by every overlay step: graceful
+    leavers hand data to ``handover`` (on_leave), every leaver parks its
+    app timers (on_stop — the reference's
+    BaseApp::handleNodeLeaveNotification cancels the periodic tests),
+    and apps with an ``on_tick`` hook (e.g. the DHT's update()-driven
+    maintenance-replication pump) get their per-tick outbox access."""
+    if hasattr(app_obj, "on_tick"):
+        app_state = app_obj.on_tick(app_state, ctx, ob, ev, node_idx)
     app_state = app_obj.on_leave(
         app_state, ctx.graceful[node_idx] & ready, ctx, ob, ev, t0,
         node_idx, handover)

@@ -27,10 +27,15 @@ handover pushes stored records to the overlay succession candidate
 during the grace window (on_leave; reference GRACEFUL_LEAVE
 notification + DHT maintenance puts).
 
-Simplifications vs the reference (documented): one outstanding DHT
+Maintenance replication: graceful-leave handover (on_leave) AND
+update()-driven puts — when the overlay reports a node entering this
+node's replica set (Common API update(), BaseApp.h:223), stored
+records replicate to it via the on_update/on_tick pump, so crash-kill
+churn re-replicates without a graceful leave (DHT.cc update path).
+
+Simplification vs the reference (documented): one outstanding DHT
 operation per node (the reference allows several concurrent CAPI
-calls); the update()-driven maintenance puts on every sibling-set
-change are approximated by the graceful-leave handover only.
+calls).
 """
 
 from __future__ import annotations
@@ -103,6 +108,11 @@ class DhtState:
     commit_g: jnp.ndarray      # [N] i32 oracle slot (-1 = none)
     commit_val: jnp.ndarray    # [N] i32
     commit_expire: jnp.ndarray  # [N] i64
+    # update()-driven maintenance replication (BaseApp::update,
+    # BaseApp.h:223; DHT.cc update path): a node that entered my
+    # replica set receives my stored records, paced 2 per tick
+    mnt_dst: jnp.ndarray       # [N] i32 — replication target (NO_NODE idle)
+    mnt_pos: jnp.ndarray       # [N] i32 — next storage slot to push
 
 
 @jax.tree_util.register_dataclass
@@ -138,7 +148,8 @@ class DhtApp:
             counters=("dht_put_attempts", "dht_put_success",
                       "dht_get_attempts", "dht_get_success",
                       "dht_get_wrong", "dht_get_notfound",
-                      "dht_lookup_failed", "dht_stored"))
+                      "dht_lookup_failed", "dht_stored",
+                      "dht_mnt_puts"))
 
     def init(self, n: int) -> DhtState:
         p, kl = self.p, self.spec.lanes
@@ -180,6 +191,8 @@ class DhtApp:
             commit_g=jnp.full((n,), -1, I32),
             commit_val=jnp.full((n,), NO_VAL, I32),
             commit_expire=jnp.zeros((n,), I64),
+            mnt_dst=jnp.full((n,), NO_NODE, I32),
+            mnt_pos=jnp.zeros((n,), I32),
         )
 
     def glob_init(self, rng) -> DhtGlobal:
@@ -229,10 +242,54 @@ class DhtApp:
             app,
             t_test=jnp.where(en, T_INF, app.t_test),
             op=jnp.where(en, OP_NONE, app.op),
-            op_to=jnp.where(en, T_INF, app.op_to))
+            op_to=jnp.where(en, T_INF, app.op_to),
+            mnt_dst=jnp.where(en, NO_NODE, app.mnt_dst))
 
     def next_event(self, app):
-        return jnp.minimum(app.t_test, app.op_to)
+        t = jnp.minimum(app.t_test, app.op_to)
+        # an active maintenance replication pumps every tick until done
+        return jnp.where(app.mnt_dst != NO_NODE, jnp.int64(0), t)
+
+    def on_update(self, app, en, ctx, ob, ev, now, node_idx, added):
+        """BaseApp::update (BaseApp.h:223) — the overlay reports a node
+        that ENTERED this node's replica/sibling set; my stored records
+        replicate to it (the reference DHT's update()-driven maintenance
+        puts).  ``added`` [A] NO_NODE-padded; one target is staged at a
+        time and pumped 2 records/tick by on_timer."""
+        first = added[jnp.argmax(added != NO_NODE)]
+        # an active pump is never preempted — the in-flight target would
+        # silently lose its tail records; a member missed while busy is
+        # re-replicated on its next set delta (bounded-state tradeoff,
+        # the reference issues one maintenance put series per update())
+        en = en & (first != NO_NODE) & (first != node_idx) & jnp.any(
+            app.s_val != NO_VAL) & (app.mnt_dst == NO_NODE)
+        return dataclasses.replace(
+            app,
+            mnt_dst=jnp.where(en, first, app.mnt_dst),
+            mnt_pos=jnp.where(en, 0, app.mnt_pos))
+
+    def on_tick(self, app, ctx, ob, ev, node_idx):
+        """Maintenance-replication pump: 2 stored records per tick to
+        the staged new replica-set member (apps/base.py on_tick hook).
+        Skips empty storage slots so a sparse store finishes in
+        ceil(records/2) ticks instead of slots/2 (the pump holds the
+        sim-wide event horizon down while active)."""
+        d = app.s_val.shape[0]
+        idx = jnp.arange(d, dtype=I32)
+        for _ in range(2):
+            cand = (app.s_val != NO_VAL) & (idx >= app.mnt_pos)
+            m_en = (app.mnt_dst != NO_NODE) & jnp.any(cand)
+            col = jnp.argmax(cand).astype(I32)
+            ob.send(m_en, ctx.t_start, app.mnt_dst, wire.DHT_PUT_CALL,
+                    key=app.s_key[col], a=app.s_val[col], b=jnp.int32(-1),
+                    stamp=app.s_expire[col],
+                    size_b=wire.BASE_CALL_B + 20 + 8)
+            ev.count("dht_mnt_puts", m_en)
+            app = dataclasses.replace(
+                app, mnt_pos=jnp.where(m_en, col + 1, app.mnt_pos))
+        done = ~jnp.any((app.s_val != NO_VAL) & (idx >= app.mnt_pos))
+        return dataclasses.replace(
+            app, mnt_dst=jnp.where(done, NO_NODE, app.mnt_dst))
 
     # -- timers --------------------------------------------------------------
 
@@ -248,6 +305,7 @@ class DhtApp:
             app,
             op=jnp.where(to, OP_NONE, app.op),
             op_to=jnp.where(to, T_INF, app.op_to))
+
 
         if self.trace is not None:
             # trace-driven commands (DHTTestApp::handleTraceMessage)
@@ -380,14 +438,23 @@ class DhtApp:
 
     # -- inbound messages ----------------------------------------------------
 
-    def _store(self, app, en, key, val, expire):
+    def _store(self, app, en, key, val, expire, maintenance=None):
         """DHTDataStorage::addData: overwrite same key, else free slot,
-        else evict the earliest-expiring entry."""
-        same = en & jnp.any(jnp.all(app.s_key == key[None, :], axis=-1)
-                            & (app.s_val != NO_VAL))
-        col_same = jnp.argmax(
-            jnp.all(app.s_key == key[None, :], axis=-1)
-            & (app.s_val != NO_VAL)).astype(I32)
+        else evict the earliest-expiring entry.
+
+        ``maintenance`` marks replication copies (update()-driven puts /
+        leave handover): they must never roll a record BACK — a copy
+        whose expiry (= put time + ttl, monotone in put order for one
+        key) is not newer than the stored one is dropped, so a slow
+        replica can't resurrect a stale value into the get quorum."""
+        same_mask = jnp.all(app.s_key == key[None, :], axis=-1) & (
+            app.s_val != NO_VAL)
+        same = en & jnp.any(same_mask)
+        col_same = jnp.argmax(same_mask).astype(I32)
+        if maintenance is not None:
+            stale = maintenance & same & (app.s_expire[col_same] >= expire)
+            en = en & ~stale
+        did = en
         free = app.s_val == NO_VAL
         col_free = jnp.argmax(free).astype(I32)
         col_evict = jnp.argmin(app.s_expire).astype(I32)
@@ -398,7 +465,7 @@ class DhtApp:
             app,
             s_key=app.s_key.at[col].set(key, mode="drop"),
             s_val=app.s_val.at[col].set(val, mode="drop"),
-            s_expire=app.s_expire.at[col].set(expire, mode="drop"))
+            s_expire=app.s_expire.at[col].set(expire, mode="drop")), did
 
     def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
         """Graceful-leave data handover: push stored records to the
@@ -426,11 +493,14 @@ class DhtApp:
         p = self.p
         now = m.t_deliver
 
-        # DHTPutCall → store + ack (DHT::handlePutRequest)
+        # DHTPutCall → store + ack (DHT::handlePutRequest); b == -1 marks
+        # replication copies (maintenance/handover), which may not roll
+        # a newer record back
         en = m.valid & (m.kind == wire.DHT_PUT_CALL)
         expire = m.stamp
-        app = self._store(app, en, m.key, m.a, expire)
-        ev.count("dht_stored", en)
+        app, did_store = self._store(app, en, m.key, m.a, expire,
+                                     maintenance=(m.b == -1))
+        ev.count("dht_stored", did_store)
         ob.send(en, now, m.src, wire.DHT_PUT_RES, key=m.key, b=m.b,
                 size_b=wire.BASE_CALL_B)
 

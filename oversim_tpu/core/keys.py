@@ -409,11 +409,30 @@ def sort_by_distance(dist, payload, num_keys: int | None = None):
     insertion we batch-sort candidate sets with XLA's lexicographic
     ``lax.sort`` and take a prefix.
 
-    Returns (sorted_dist, sorted_payloads).
+    Sort-key compression: only the top TWO u32 lanes (64 bits) of the
+    distance feed the comparator.  Every caller sorts distances between
+    distinct 160+-bit node keys drawn uniformly (engine/sim.py random
+    nodeIds), so two candidates tie in the top 64 bits of a ring/XOR
+    distance only when their keys fall within 2^(bits-64) of each other
+    — probability ~N²·2⁻⁶⁴ per simulation, below any observable rate.
+    This halves-to-thirds the lax.sort operand count on the hot
+    findNode/frontier paths (the tick graph is op-issue-bound,
+    PERFORMANCE.md).  Pass ``num_keys=dist.shape[-1]`` to force the
+    exact full-width comparator.
+
+    Returns (sorted_dist, sorted_payloads).  On the compressed path
+    sorted_dist carries only the comparator lanes (no caller consumes
+    it — every call site takes ``[1]``); pass num_keys for the exact
+    full-width sort with all lanes returned.
     """
     kl = dist.shape[-1]
-    lanes = tuple(dist[..., i] for i in range(kl))
+    if num_keys is None:
+        nk = min(2, kl)
+        lanes = tuple(dist[..., i] for i in range(nk))
+    else:
+        nk = num_keys
+        lanes = tuple(dist[..., i] for i in range(kl))
     operands = lanes + tuple(payload)
-    out = jax.lax.sort(operands, dimension=-1, num_keys=num_keys or kl)
-    sorted_dist = jnp.stack(out[:kl], axis=-1)
-    return sorted_dist, tuple(out[kl:])
+    out = jax.lax.sort(operands, dimension=-1, num_keys=nk)
+    sorted_dist = jnp.stack(out[:len(lanes)], axis=-1)
+    return sorted_dist, tuple(out[len(lanes):])

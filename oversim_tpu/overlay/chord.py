@@ -68,7 +68,7 @@ UMAX = jnp.uint32(0xFFFFFFFF)
 DEAD, JOINING, READY = 0, 1, 2
 
 # lookup purposes (owner dispatch tags)
-P_JOIN, P_FINGER, P_APP = 1, 2, 3
+P_JOIN, P_FINGER, P_APP, P_MERGE = 1, 2, 3, 4
 
 BCAST_FANOUT = 8   # broadcast copies per hop (≥ distinct fingers at test N)
 
@@ -84,6 +84,15 @@ class ChordParams:
     succ_size: int = 8
     aggressive_join: bool = True
     rpc_timeout: float = 1.5        # rpcUdpTimeout, default.ini:483
+    # BootstrapList::mergeOverlayPartitions (BootstrapList.cc:273,
+    # default.ini:436-438, default false): periodically look up an
+    # oracle-drawn candidate's key through the OWN overlay; if the
+    # lookup does not find the candidate, it lives in a foreign
+    # partition (two formed rings after a network heal) →
+    # joinForeignPartition: adopt it as a successor candidate and hint
+    # ourselves to it, knitting the rings back together
+    merge_partitions: bool = False
+    merge_interval: float = 20.0
 
 
 @jax.tree_util.register_dataclass
@@ -106,6 +115,7 @@ class ChordState:
     lk: lk_mod.LookupState     # [N, L, ...]
     rr: rt_mod.RouteState      # [N, Q, ...] pending-ACK recursive routes
     cp_sent: jnp.ndarray       # [N] i64 — predecessor-ping send time (RTT)
+    t_merge: jnp.ndarray       # [N] i64 — partition-merge probe timer
     t_nps: jnp.ndarray         # [N] i64 — GNP/NPS landmark-probe timer
     nps_dst: jnp.ndarray       # [N] i32 — in-flight probe target
     nps_sent: jnp.ndarray      # [N] i64 — its send time (RTT base)
@@ -210,6 +220,7 @@ class ChordLogic:
                 self.rcfg or rt_mod.RouteConfig(), self.key_spec.lanes,
                 16))(jnp.arange(n)),
             cp_sent=jnp.zeros((n,), I64),
+            t_merge=jnp.full((n,), T_INF, I64),
             t_nps=jnp.full((n,), T_INF, I64),
             nps_dst=jnp.full((n,), NO_NODE, I32),
             nps_sent=jnp.zeros((n,), I64),
@@ -248,6 +259,8 @@ class ChordLogic:
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
         if self.ncs.is_landmark_type:
             t = jnp.minimum(t, jnp.where(ready, st.t_nps, T_INF))
+        if self.p.merge_partitions:
+            t = jnp.minimum(t, jnp.where(ready, st.t_merge, T_INF))
         if self.rcfg is not None:
             t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
@@ -392,6 +405,10 @@ class ChordLogic:
         if self.ncs.is_landmark_type:
             st = dataclasses.replace(st, t_nps=jnp.where(
                 en, now + jnp.int64(int(0.3 * NS)), st.t_nps))
+        if p.merge_partitions:
+            st = dataclasses.replace(st, t_merge=jnp.where(
+                en, now + jnp.int64(int(p.merge_interval * NS)),
+                st.t_merge))
         return st
 
     # -- the per-node step ---------------------------------------------------
@@ -419,6 +436,7 @@ class ChordLogic:
         anyfail_cnt = jnp.int32(0)  # failed lookups of any purpose
         lksucc_cnt = jnp.int32(0)
         routedrop_cnt = jnp.int32(0)
+        old_succ = st.succ                   # update() delta base
 
         # --------------------------------------------- inbox (batched) -----
         # Kind-major batching: each message kind is handled in ONE masked
@@ -480,6 +498,12 @@ class ChordLogic:
                 res_b, msgs.nodes, msgs.src, msgs.nodes[:, 0], node_idx,
                 sib_b)
             fwd = en_rt & ~sib_b & found_v & (msgs.hops < rcfg.hop_max)
+            if hasattr(self.app, "forward"):
+                # Common API forward() (BaseApp.h:214 / callForward,
+                # BaseOverlay.cc:523): the app may veto messages being
+                # routed THROUGH this node (veto = drop, the reference's
+                # forwardResponse without a next hop)
+                fwd = fwd & ~self.app.forward(st.app, msgs, ctx)
             # visitedHops appended unconditionally (deviation: the
             # reference records only for source/recordRoute and falls
             # back to last-hop-only loop detection in semi/full —
@@ -899,6 +923,34 @@ class ChordLogic:
                     en_np, now_np + jnp.int64(
                         int(self.ncs.probe_interval * NS)), st.t_nps))
 
+        # partition-merge probe (BootstrapList::locateBootstrapNode,
+        # BootstrapList.cc:268-280; mergeOverlayPartitions): look up an
+        # oracle-drawn candidate's key through the OWN overlay — the
+        # completion handler detects a foreign partition when the lookup
+        # does not come back with the candidate itself
+        if p.merge_partitions:
+            en_m = (st.state == READY) & (st.t_merge < t_end)
+            now_m = jnp.maximum(st.t_merge, t0)
+            cand_m = ctx.sample_ready(jax.random.fold_in(rngs[1], 23),
+                                      node_idx)
+            ck_m = ctx.keys[jnp.maximum(cand_m, 0)]
+            nxt_m, sib_m = self._find_node(ctx, st, me_key, node_idx,
+                                           ck_m)
+            no_merge_lk = ~jnp.any(st.lk.active & (st.lk.purpose
+                                                   == P_MERGE))
+            slot, have = lk_mod.free_slot(st.lk)
+            start_m = (en_m & (cand_m != NO_NODE) & (cand_m != node_idx)
+                       & ~sib_m & no_merge_lk & have
+                       & (nxt_m != NO_NODE))
+            seed_m = jnp.full((lcfg.frontier,), NO_NODE, I32).at[0].set(
+                nxt_m)
+            st = dataclasses.replace(st, lk=lk_mod.start(
+                st.lk, start_m, slot, P_MERGE, cand_m, ck_m, seed_m,
+                now_m, lcfg))
+            st = dataclasses.replace(st, t_merge=jnp.where(
+                en_m, now_m + jnp.int64(int(p.merge_interval * NS)),
+                st.t_merge))
+
         # stabilize (handleStabilizeTimerExpired)
         en_s = (st.state == READY) & (st.t_stab < t_end)
         now_s = jnp.maximum(st.t_stab, t0)
@@ -1089,6 +1141,29 @@ class ChordLogic:
                 wire.CHORD_JOIN_CALL,
                 size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
 
+        # partition-merge probe completions (handleLookupResponse,
+        # BootstrapList.cc:171-195): the candidate's key resolved to a
+        # sibling set that does NOT contain the candidate → it lives in
+        # a foreign formed ring.  joinForeignPartition equivalent: adopt
+        # it as a successor candidate and hint ourselves to it — the
+        # rings then knit via normal stabilize/notify rounds.
+        if p.merge_partitions:
+            enm_l = taken & (pur_l == P_MERGE) & suc_l
+            any_m = jnp.any(enm_l)
+            li_m = jnp.clip(jnp.argmax(enm_l).astype(I32), 0,
+                            lcfg.slots - 1)
+            x_m = comp["aux"][li_m]
+            foreign = any_m & jnp.all(comp["results"][li_m] != x_m) & (
+                x_m != NO_NODE) & ctx.alive[jnp.maximum(x_m, 0)]
+            succ_m = self._succ_sorted(
+                ctx, me_key, node_idx,
+                jnp.concatenate([st.succ,
+                                 jnp.where(foreign, x_m, NO_NODE)[None]]))
+            st = dataclasses.replace(
+                st, succ=jnp.where(foreign, succ_m, st.succ))
+            ob.send(foreign, t0, x_m, wire.CHORD_SUCC_HINT, a=node_idx,
+                    size_b=wire.BASE_CALL_B + wire.NODEHANDLE_B)
+
         # finger repair results (one scatter per field)
         enf = taken & (pur_l == P_FINGER)
         fi_l = jnp.clip(comp["aux"], 0, spec.bits - 1)
@@ -1154,6 +1229,18 @@ class ChordLogic:
             timeout_fn=nc_mod.adaptive_timeout_fn(st.nc,
                                                   lcfg.rpc_timeout_ns))
         st = dataclasses.replace(st, lk=new_lk)
+
+        # Common API update() (BaseOverlay::callUpdate → BaseApp::update,
+        # BaseApp.h:223): nodes that entered the successor list — Chord's
+        # replica/sibling set — trigger app re-replication this tick
+        if hasattr(self.app, "on_update"):
+            new_in = jnp.where(
+                (st.succ != NO_NODE)
+                & ~jnp.any(st.succ[:, None] == old_succ[None, :], axis=1),
+                st.succ, NO_NODE)
+            st = dataclasses.replace(st, app=self.app.on_update(
+                st.app, st.state == READY, ctx, ob, ev, t0, node_idx,
+                new_in))
 
         # ------------------------------------------------------ events -----
         events = {

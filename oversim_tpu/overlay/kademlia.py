@@ -577,6 +577,7 @@ class KademliaLogic:
         joins_cnt = jnp.int32(0)
         anyfail_cnt = jnp.int32(0)
         lksucc_cnt = jnp.int32(0)
+        old_sib = st.sib                     # update() delta base
 
         # --------------------------------------------- inbox (batched) -----
         # All R inbox slots are consumed in ONE pass per handler class —
@@ -649,6 +650,9 @@ class KademliaLogic:
                 res_b, msgs.nodes, msgs.src, msgs.nodes[:, 0], node_idx,
                 sib_b)
             fwd = en_rt & ~sib_b & found_v & (msgs.hops < rcfg.hop_max)
+            if hasattr(self.app, "forward"):
+                # Common API forward() veto (BaseApp.h:214)
+                fwd = fwd & ~self.app.forward(st.app, msgs, ctx)
             visited2 = rt_mod.append_visited(msgs.nodes, node_idx, fwd)
             st = dataclasses.replace(st, rr=rt_mod.forward_batch(
                 st.rr, ob, fwd, t_del_r, nxt_v, key=msgs.key, inner=msgs.d,
@@ -1037,6 +1041,19 @@ class KademliaLogic:
                                 num_redundant=p.redundant_nodes,
                                 timeout_fn=timeout_fn)
         st = dataclasses.replace(st, lk=new_lk)
+
+        # Common API update() (BaseOverlay::callUpdate, BaseOverlay.cc:640
+        # → BaseApp::update, BaseApp.h:223): report nodes that entered
+        # the sibling set this tick so the app can re-replicate (the
+        # DHT's update()-driven maintenance puts)
+        if hasattr(self.app, "on_update"):
+            new_in = jnp.where(
+                (st.sib != NO_NODE)
+                & ~jnp.any(st.sib[:, None] == old_sib[None, :], axis=1),
+                st.sib, NO_NODE)
+            st = dataclasses.replace(st, app=self.app.on_update(
+                st.app, st.state == READY, ctx, ob, ev, t0, node_idx,
+                new_in))
 
         # ------------------------------------------------------ events -----
         events = {

@@ -423,6 +423,8 @@ class PastryLogic:
         anyfail_cnt = jnp.int32(0)
         lksucc_cnt = jnp.int32(0)
         routedrop_cnt = jnp.int32(0)
+        old_leaf = jnp.concatenate([st.leaf_cw, st.leaf_ccw])
+        # update() delta base (the leafset is Pastry's sibling set)
 
         # ------------------------------------------------------- inbox -----
         if p.adaptive_timeouts:
@@ -473,6 +475,9 @@ class PastryLogic:
             nxt_rt, found_rt = rt_mod.pick_next_hop(
                 cands, m.nodes, m.src, m.nodes[0], node_idx, sib)
             fwd = en_rt & ~sib & found_rt & (m.hops < self.rcfg.hop_max)
+            if hasattr(self.app, "forward"):
+                # Common API forward() veto (BaseApp.h:214)
+                fwd = fwd & ~self.app.forward(st.app, m, ctx)
             vis_n = jnp.sum((m.nodes != NO_NODE).astype(I32))
             visited2 = m.nodes.at[jnp.minimum(vis_n, rmax - 1)].set(
                 jnp.where(fwd, node_idx, m.nodes[jnp.minimum(
@@ -717,6 +722,19 @@ class PastryLogic:
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----
+        # Common API update() (BaseOverlay::callUpdate → BaseApp::update,
+        # BaseApp.h:223): nodes that entered the leafset — Pastry's
+        # replica/sibling set — trigger app re-replication
+        if hasattr(self.app, "on_update"):
+            new_leaf = jnp.concatenate([st.leaf_cw, st.leaf_ccw])
+            new_in = jnp.where(
+                (new_leaf != NO_NODE)
+                & ~jnp.any(new_leaf[:, None] == old_leaf[None, :], axis=1),
+                new_leaf, NO_NODE)
+            st = dataclasses.replace(st, app=self.app.on_update(
+                st.app, st.state == READY, ctx, ob, ev, t0, node_idx,
+                new_in))
+
         events = {
             "c:pastry_joins": joins_cnt,
             "c:lookup_success": lksucc_cnt,

@@ -117,3 +117,46 @@ def test_malicious_sibling_attack_degrades_lookups():
     # honest fraction still mostly delivers somewhere (sim stays live)
     total = out["kbr_delivered"] + out["kbr_wrong_node"]
     assert total / out["kbr_sent"] > 0.5, out
+
+
+@pytest.mark.slow
+def test_overlay_partition_merge():
+    """BootstrapList::mergeOverlayPartitions (BootstrapList.cc:171-195,
+    default.ini:436-438): two rings FORM independently during a
+    from-the-start network split; after the heal the merge probes must
+    knit them into ONE global successor cycle — not just restore
+    delivery."""
+    from oversim_tpu.core import keys as K
+    from oversim_tpu.overlay.chord import ChordParams
+
+    n = 16
+    up = underlay_mod.UnderlayParams(
+        num_node_types=2, type_boundaries=(8,),
+        partition_events=(
+            (0.0, 0, 1, False), (0.0, 1, 0, False),
+            (200.0, 0, 1, True), (200.0, 1, 0, True)))
+    cp = churn_mod.ChurnParams(model="none", target_num=n,
+                               init_interval=0.5)
+    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=30.0)),
+                       params=ChordParams(merge_partitions=True,
+                                          merge_interval=15.0))
+    s = sim_mod.Simulation(logic, cp, up,
+                           sim_mod.EngineParams(window=0.02,
+                                                transition_time=60.0))
+    st = s.init(seed=17)
+    st = s.run_until(st, 190.0, chunk=128)
+
+    # two separate rings formed: the global successor graph is NOT one
+    # 16-cycle (each side closes over its own 8 nodes)
+    def cycle_ok(st):
+        keys_int = [K.to_int(k) for k in np.asarray(st.node_keys)]
+        order = sorted(range(n), key=lambda i: keys_int[i])
+        succ = np.asarray(st.logic.succ)
+        return sum(1 for pos, i in enumerate(order)
+                   if succ[i, 0] != order[(pos + 1) % n])
+
+    assert cycle_ok(st) > 0, "rings unexpectedly merged during split"
+
+    st = s.run_until(st, 700.0, chunk=256)
+    bad = cycle_ok(st)
+    assert bad == 0, f"{bad}/{n} successor pointers wrong after merge"
