@@ -236,7 +236,7 @@ class Simulation:
             ul_state, up, r_send, jnp.broadcast_to(node_idx[:, None],
                                                  out_fields["dst"].shape),
             out_fields["dst"], out_fields["size_b"], out_fields["t_send"],
-            out_valid, alive)
+            out_valid, alive, kind=out_fields["kind"])
         flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in out_fields.items()
                 if k != "t_send"}
         flat["t_deliver"] = t_del.reshape(-1)

@@ -170,7 +170,7 @@ def migrate(state: InetUnderlayState, mask, rng,
 
 @partial(jax.jit, static_argnames=("p",))
 def send_batch(state: InetUnderlayState, p: InetUnderlayParams, rng,
-               src, dst, size_bytes, t_send, want, alive):
+               src, dst, size_bytes, t_send, want, alive, kind=None):
     """Same contract as underlay.simple.send_batch (the engine is
     underlay-agnostic): (t_deliver, ok, new_state, drops)."""
     n, m = src.shape

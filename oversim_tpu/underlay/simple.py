@@ -66,6 +66,25 @@ class UnderlayParams:
     # GlobalNodeList.h:232-235 + SimpleUDP.cc:349-358 partition drop;
     # driven by CONNECT/DISCONNECT_NODETYPES trace events,
     # simulations/partition.trace) ---
+    # --- PlanetLab delay-fault model (delayFaultType, SimpleUDP.cc:
+    # 126-141; SimpleNodeEntry::getFaultyDelay :197-254): inject
+    # triangle-inequality-violating delay errors with ratios drawn from
+    # the Kumaraswamy fits of "Network Coordinates in the Wild" Fig. 7.
+    # ""|"live_all"|"live_planetlab"|"simulation".  The error is a
+    # DETERMINISTIC hash of the un-faulted delay (the reference hashes
+    # the delay string) so a given pair distance always gets the same
+    # distortion — stable violations, not jitter.
+    delay_fault_type: str = ""
+    # --- SimpleTCP / BaseTcpSupport (src/underlay/simpleunderlay/
+    # SimpleTCP.{h,cc}, src/common/BaseTcpSupport.{h,cc}): message kinds
+    # listed here ride a simulated TCP stream to their destination —
+    # reliable (a bit error retransmits, adding one RTO-scaled delay,
+    # instead of dropping) and connection-oriented (first contact with a
+    # peer outside the open-connection cache pays a SYN/SYN-ACK/ACK
+    # handshake of 1.5 one-way delays, ExtTCPSocketMap connection
+    # reuse).  Empty = everything is UDP, zero state/graph cost.
+    tcp_kinds: tuple = ()
+    tcp_connection_cache: int = 8     # open connections kept per node
     num_node_types: int = 1
     # slots < type_boundaries[0] are type 0, < [1] type 1, ...; the last
     # type takes the rest (multiple ChurnGenerators = one type each,
@@ -116,6 +135,9 @@ class UnderlayState:
     channel: jnp.ndarray      # [N] i32 index into channel_table
     tx_finished: jnp.ndarray  # [N] i64 ns — when the send queue drains
     node_type: jnp.ndarray    # [N] i32 — churn-generator/partition type
+    tcp_conn: jnp.ndarray     # [N, Ct] i32 — open-connection peer cache
+                              # (SimpleTCP/BaseTcpSupport, zero-width
+                              # when no tcp_kinds are configured)
 
 
 _POOL_CACHE: dict = {}
@@ -148,9 +170,11 @@ def init(rng: jax.Array, n: int, p: UnderlayParams) -> UnderlayState:
     ck, xk = jax.random.split(rng)
     coords = _draw_coords(xk, n, p)
     channel = jax.random.randint(ck, (n,), 0, len(p.channel_types), dtype=jnp.int32)
+    ct = p.tcp_connection_cache if p.tcp_kinds else 0
     return UnderlayState(coords=coords, channel=channel,
                          tx_finished=jnp.zeros((n,), dtype=I64),
-                         node_type=node_types(n, p))
+                         node_type=node_types(n, p),
+                         tcp_conn=jnp.full((n, ct), -1, jnp.int32))
 
 
 def migrate(state: UnderlayState, mask, rng, p: UnderlayParams) -> UnderlayState:
@@ -160,13 +184,22 @@ def migrate(state: UnderlayState, mask, rng, p: UnderlayParams) -> UnderlayState
     new_coords = _draw_coords(rng, n, p)
     coords = jnp.where(mask[:, None], new_coords, state.coords)
     tx_finished = jnp.where(mask, jnp.int64(0), state.tx_finished)
+    if state.tcp_conn.shape[1]:
+        # connections die with either endpoint (ExtTCPSocketMap): clear
+        # the migrated node's own row AND every stale entry pointing at
+        # the recycled slot in other nodes' caches
+        stale_to = mask[jnp.clip(state.tcp_conn, 0, n - 1)] & (
+            state.tcp_conn >= 0)
+        state = dataclasses.replace(
+            state, tcp_conn=jnp.where(mask[:, None] | stale_to, -1,
+                                      state.tcp_conn))
     return dataclasses.replace(state, coords=coords,
                                tx_finished=tx_finished)
 
 
 @partial(jax.jit, static_argnames=("p",))
 def send_batch(state: UnderlayState, p: UnderlayParams, rng,
-               src, dst, size_bytes, t_send, want, alive):
+               src, dst, size_bytes, t_send, want, alive, kind=None):
     """Compute deliver times and drop decisions for an outbox batch.
 
     Args:
@@ -226,6 +259,57 @@ def send_batch(state: UnderlayState, p: UnderlayParams, rng,
     else:
         total_ns = jnp.full((n, m), jnp.int64(p.constant_delay * NS))
 
+    # --- PlanetLab delay faults (getFaultyDelay, SimpleNodeEntry.cc:
+    # 197-254): errorRatio = Kumaraswamy⁻¹(hash(delay)) + shift, sign
+    # from hash parity, negative ratios clamped at 0.6.  splitmix64
+    # replaces the reference's SHA1-of-delay-string as the
+    # deterministic delay→uniform hash (same role, integer-native).
+    if p.delay_fault_type:
+        a_b_shift = {"live_all": (2.03, 14.0, 0.04),
+                     "live_planetlab": (1.95, 50.0, 0.105),
+                     "simulation": (1.96, 23.0, 0.02)}[p.delay_fault_type]
+        ka, kb, kshift = a_b_shift
+        # hash the PAIR-STABLE propagation delay (coordinate distance),
+        # not the full per-message delay — queue wait and serialization
+        # vary per packet and would turn the stable triangle violations
+        # into jitter; the ratio then distorts that propagation term
+        prop_ns = (coord_delay * NS).astype(I64)
+        h = prop_ns.astype(jnp.uint64)
+        h = (h ^ (h >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        h = h ^ (h >> 31)
+        frac = (h >> 40).astype(F32) / jnp.float32(1 << 24)
+        ratio = (1.0 - frac ** (1.0 / kb)) ** (1.0 / ka) + kshift
+        neg = (h & 1) == 1
+        ratio = jnp.where(neg, -jnp.minimum(ratio, 0.6), ratio)
+        total_ns = total_ns + (ratio * prop_ns.astype(F32)).astype(I64)
+
+    # --- SimpleTCP (tcp_kinds; SimpleTCP.cc / BaseTcpSupport):
+    # direct-mapped open-connection cache — a first contact pays the
+    # SYN/SYN-ACK/ACK handshake (1.5 one-way delays); a collision
+    # evicts the older connection (ExtTCPSocketMap reuse semantics,
+    # bounded state)
+    if p.tcp_kinds and kind is not None:
+        is_tcp = jnp.zeros((n, m), bool)
+        for k in p.tcp_kinds:
+            is_tcp = is_tcp | (kind == k)
+        is_tcp = is_tcp & queued
+        ct = p.tcp_connection_cache
+        col_c = jnp.clip(dst % ct, 0, ct - 1)
+        rows_c = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m))
+        open_hit = state.tcp_conn[rows_c, col_c] == dst
+        handshake = is_tcp & ~open_hit
+        one_way_ns = ((tx_access + coord_delay + rx_access) * NS).astype(I64)
+        total_ns = total_ns + jnp.where(handshake,
+                                        (one_way_ns * 3) // 2,
+                                        jnp.int64(0))
+        # cache write deferred until the drop decisions are known — a
+        # handshake on a message lost to a partition cut / dead peer /
+        # queue overrun establishes nothing
+    else:
+        is_tcp = jnp.zeros((n, m), bool)
+        handshake = is_tcp
+
     # --- jitter: positive half-normal, sigma = jitter * delay
     # (SimpleUDP.cc:360-373 truncnormal(0, delay*jitter)) ---
     if p.jitter > 0:
@@ -236,6 +320,12 @@ def send_batch(state: UnderlayState, p: UnderlayParams, rng,
     bit_err_p = 1.0 - (1.0 - tx_ber) ** bits * (1.0 - rx_ber) ** bits
     u = jax.random.uniform(jax.random.fold_in(rng, 1), (n, m), dtype=F32)
     bit_error = queued & (u < bit_err_p)
+    # TCP retransmits instead of losing the segment: one RTO-scaled
+    # extra delay (doubled transfer time), no drop
+    if p.tcp_kinds and kind is not None:
+        retrans = bit_error & is_tcp
+        total_ns = total_ns + jnp.where(retrans, total_ns, jnp.int64(0))
+        bit_error = bit_error & ~is_tcp
     dest_dead = want & ~alive[dst]
 
     # node-type partition drop (SimpleUDP.cc:349-358:
@@ -248,6 +338,12 @@ def send_batch(state: UnderlayState, p: UnderlayParams, rng,
 
     ok = want & ~overrun & ~bit_error & ~dest_dead & ~part_cut
     t_deliver = jnp.where(self_send, t_send, t_send + total_ns)
+
+    if p.tcp_kinds and kind is not None:
+        new_conn = state.tcp_conn.at[
+            jnp.where(handshake & ok, rows_c, n), col_c].set(
+            dst, mode="drop")
+        state = dataclasses.replace(state, tcp_conn=new_conn)
 
     new_state = dataclasses.replace(state, tx_finished=new_tx_finished)
     drops = {

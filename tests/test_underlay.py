@@ -124,3 +124,92 @@ def test_tx_queue_carries_across_batches():
         jnp.full((4, 1), NS + 1, jnp.int64), one)
     tx_fin = np.asarray(st2.tx_finished)
     assert (np.asarray(t_del2)[0, 0] >= tx_fin[0])
+
+
+def test_planetlab_delay_faults():
+    """getFaultyDelay (SimpleNodeEntry.cc:197-254): with a delay-fault
+    mode on, delays distort deterministically (same input -> same
+    output), both signs occur, and negative ratios are clamped at 0.6."""
+    n, m = 8, 6
+    base_kw = dict(jitter=0.0)
+    p0 = ul.UnderlayParams(**base_kw)
+    p1 = ul.UnderlayParams(delay_fault_type="live_planetlab", **base_kw)
+    st = ul.init(jax.random.PRNGKey(3), n, p0)
+    src = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m)).astype(jnp.int32)
+    dst = (src + 1 + jnp.arange(m)[None, :]) % n
+    size = jnp.full((n, m), 500, jnp.int32)
+    ts = jnp.zeros((n, m), jnp.int64)
+    want = jnp.ones((n, m), bool)
+    alive = jnp.ones(n, bool)
+    rng = jax.random.PRNGKey(4)
+    t0, ok0, _, _ = ul.send_batch(st, p0, rng, src, dst, size, ts, want,
+                                  alive)
+    t1, ok1, _, _ = ul.send_batch(st, p1, rng, src, dst, size, ts, want,
+                                  alive)
+    t1b, _, _, _ = ul.send_batch(st, p1, rng, src, dst, size, ts, want,
+                                 alive)
+    a0, a1, a1b = (np.asarray(t0, np.float64), np.asarray(t1, np.float64),
+                   np.asarray(t1b, np.float64))
+    np.testing.assert_array_equal(a1, a1b)          # deterministic
+    ratio = (a1 - a0) / np.maximum(a0, 1)
+    assert (ratio > 0.05).any() and (ratio < -0.05).any(), ratio
+    assert (ratio >= -0.6 - 1e-6).all()             # negative clamp
+    # live_planetlab shift: positive errors exceed +10.5% of the
+    # propagation term (ratio here is vs total incl. serialization)
+    assert ratio[ratio > 0].min() > 0.05
+    # PAIR-STABLE: the absolute distortion must not depend on message
+    # size (it hashes the coordinate propagation delay only)
+    size2 = jnp.full((n, m), 5000, jnp.int32)
+    t0b, _, _, _ = ul.send_batch(st, p0, rng, src, dst, size2, ts, want,
+                                 alive)
+    t1c, _, _, _ = ul.send_batch(st, p1, rng, src, dst, size2, ts, want,
+                                 alive)
+    np.testing.assert_array_equal(np.asarray(t1 - t0),
+                                  np.asarray(t1c - t0b))
+
+
+def test_simpletcp_handshake_and_reliability():
+    """SimpleTCP (tcp_kinds): first contact pays the 1.5 one-way
+    handshake, an open connection doesn't; bit errors retransmit
+    (delay) instead of dropping."""
+    n, m = 4, 2
+    src = jnp.broadcast_to(jnp.arange(n)[:, None], (n, m)).astype(jnp.int32)
+    dst = (src + 1) % n
+    size = jnp.full((n, m), 4000, jnp.int32)
+    ts = jnp.zeros((n, m), jnp.int64)
+    want = jnp.zeros((n, m), bool).at[:, 0].set(True)
+    alive = jnp.ones(n, bool)
+    rng = jax.random.PRNGKey(6)
+    kind_udp = jnp.full((n, m), 1, jnp.int32)
+    kind_tcp = jnp.full((n, m), 7, jnp.int32)
+
+    # --- handshake semantics on a clean channel ---
+    p = ul.UnderlayParams(jitter=0.0, tcp_kinds=(7,))
+    st = ul.init(jax.random.PRNGKey(5), n, p)
+    tu, _, _, _ = ul.send_batch(st, p, rng, src, dst, size, ts, want,
+                                alive, kind=kind_udp)
+    tt, _, st2, _ = ul.send_batch(st, p, rng, src, dst, size, ts, want,
+                                  alive, kind=kind_tcp)
+    assert (np.asarray(tt)[:, 0] > np.asarray(tu)[:, 0]).all()
+    # open connection: no handshake (baseline from the SAME queue state)
+    tu2, _, _, _ = ul.send_batch(st2, p, rng, src, dst, size, ts, want,
+                                 alive, kind=kind_udp)
+    tt2, _, _, _ = ul.send_batch(st2, p, rng, src, dst, size, ts, want,
+                                 alive, kind=kind_tcp)
+    assert (np.asarray(tt2)[:, 0] == np.asarray(tu2)[:, 0]).all()
+
+    # --- reliability on a lossy channel: tcp never bit-error-drops,
+    # retransmissions delay instead ---
+    pl = ul.UnderlayParams(jitter=0.0, tcp_kinds=(7,),
+                           channel_types=("simple_ethernetline_lossy",))
+    stl = ul.init(jax.random.PRNGKey(5), n, pl)
+    many = jnp.ones((n, m), bool)
+    big = jnp.full((n, m), 60000, jnp.int32)
+    _, ok_u, _, du = ul.send_batch(stl, pl, jax.random.PRNGKey(7), src,
+                                   dst, big, ts, many, alive,
+                                   kind=kind_udp)
+    t_t, ok_t, _, dt = ul.send_batch(stl, pl, jax.random.PRNGKey(7), src,
+                                     dst, big, ts, many, alive,
+                                     kind=kind_tcp)
+    assert int(du["bit_error_lost"]) > 0      # lossy channel really drops
+    assert int(dt["bit_error_lost"]) == 0     # ...but not for tcp kinds
