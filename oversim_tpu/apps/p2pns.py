@@ -294,7 +294,9 @@ class P2pnsApp:
             r_val=app.r_val.at[col].set(m.b, mode="drop"),
             r_expire=app.r_expire.at[col].set(m.stamp, mode="drop"))
         ev.count("p2pns_stored", en)
-        ob.send(en, now, m.src, wire.P2PNS_REG_RES, a=m.a,
+        # b echoes the caller's op nonce (external XML-RPC register
+        # matches its ack on it; in-sim callers ignore it)
+        ob.send(en, now, m.src, wire.P2PNS_REG_RES, a=m.a, b=m.b,
                 size_b=wire.BASE_CALL_B)
 
         # ResolveCall → storage probe

@@ -306,9 +306,6 @@ def send_batch(state: UnderlayState, p: UnderlayParams, rng,
         # cache write deferred until the drop decisions are known — a
         # handshake on a message lost to a partition cut / dead peer /
         # queue overrun establishes nothing
-    else:
-        is_tcp = jnp.zeros((n, m), bool)
-        handshake = is_tcp
 
     # --- jitter: positive half-normal, sigma = jitter * delay
     # (SimpleUDP.cc:360-373 truncnormal(0, delay*jitter)) ---

@@ -99,9 +99,11 @@ class XmlRpcInterface:
         new_pool, _ = pool_mod.alloc(s.pool, out, jnp.asarray([True]))
         self.state = dataclasses.replace(s, pool=new_pool)
 
-    def _collect(self, kinds, nonce, max_ticks: int = 400):
+    def _collect(self, kinds, nonce, max_ticks: int = 400,
+                 want_payload: bool = False, a_match=None):
         """Step until responses with our nonce arrive (drained between
-        ticks so the injector node's app never sees them)."""
+        ticks so the injector node's app never sees them).  Each hit is
+        (kind, a) — or (kind, a, c, [nodes...]) with ``want_payload``."""
         got = []
         for _ in range(max_ticks):
             self.state = self.sim.step(self.state)
@@ -110,12 +112,22 @@ class XmlRpcInterface:
             kind = np.asarray(pool.kind)
             dst = np.asarray(pool.dst)
             b = np.asarray(pool.b)
-            hits = np.nonzero(valid & np.isin(kind, kinds) &
-                              (dst == self.slot) & (b == nonce))[0]
+            sel = (valid & np.isin(kind, kinds) & (dst == self.slot)
+                   & (b == nonce))
+            if a_match is not None:
+                sel = sel & (np.asarray(pool.a) == a_match)
+            hits = np.nonzero(sel)[0]
             if len(hits):
                 a = np.asarray(pool.a)
+                c = np.asarray(pool.c)
+                nodes = np.asarray(pool.nodes)
                 for i in hits:
-                    got.append((int(kind[i]), int(a[i])))
+                    if want_payload:
+                        got.append((int(kind[i]), int(a[i]), int(c[i]),
+                                    [int(x) for x in nodes[i]
+                                     if x != NO_NODE]))
+                    else:
+                        got.append((int(kind[i]), int(a[i])))
                 mask = jnp.zeros(pool.valid.shape, bool).at[
                     jnp.asarray(hits, I32)].set(True)
                 self.state = dataclasses.replace(
@@ -174,6 +186,123 @@ class XmlRpcInterface:
         got = self._collect([int(wire.DHT_GET_RES)], nonce)
         return got[0][1] if got else -1
 
+    def lookup(self, key_hex: str, num_siblings: int = 4):
+        """Full KBR lookup over the real wire (XmlRpcInterface::lookup →
+        LookupCall): iterative FindNode rounds driven from the injector
+        slot — the same FINDNODE_CALL/RES exchange the in-sim lookup
+        engine performs — until a responder flags sibling
+        responsibility.  Returns the sibling slot list ([] on failure)."""
+        key = self._key(key_hex)
+        frontier = self._closest_ready(key, 1)
+        visited: set = set()
+        for _ in range(16):
+            cand = next((h for h in frontier if h not in visited), None)
+            if cand is None:
+                return []
+            visited.add(cand)
+            nonce = (int(self.state.t_now) // 1000) % (2**30) + 21
+            self._inject(cand, wire.FINDNODE_CALL, key, b=nonce)
+            got = self._collect([int(wire.FINDNODE_RES)], nonce,
+                                want_payload=True)
+            if not got:
+                continue
+            _, _, sib_flag, nodes = got[0]
+            if sib_flag and nodes:
+                return nodes[:num_siblings]
+            frontier = nodes + [h for h in frontier if h not in visited]
+        return []
+
+    @staticmethod
+    def _name_id(name: str) -> int:
+        import hashlib
+        return int.from_bytes(
+            hashlib.sha1(name.encode()).digest()[:4], "big") & 0x7FFFFFFF
+
+    def register(self, name: str, value: int, ttl: float = 3600.0):
+        """P2PNS register (XmlRpcInterface::register → P2pnsRegisterCall):
+        binds name→value at the node responsible for sha1(name).
+        Returns True on the registrar's ack.  Requires the P2PNS tier
+        (apps/p2pns.py) in the running stack, as in the reference."""
+        nid = self._name_id(name)
+        key = keys_mod.sha1_key(name.encode(), self.sim.spec)
+        holders = self.lookup(
+            hex(keys_mod.to_int(key))[2:], 1) or self._closest_ready(key, 1)
+        if not holders:
+            return False
+        expire = int(self.state.t_now) + int(ttl * NS)
+        # wire protocol: a=name id, b=VALUE (stored by the registrar);
+        # the ack echoes both — matching on (a, b) keeps in-sim P2PNS
+        # traffic to the injector slot from false-acking us
+        self._inject(holders[0], wire.P2PNS_REG_CALL, key, a=nid,
+                     b=int(value), stamp=expire)
+        got = self._collect([int(wire.P2PNS_REG_RES)], int(value),
+                            a_match=nid)
+        return bool(got)
+
+    def resolve(self, name: str):
+        """P2PNS resolve (XmlRpcInterface::resolve → P2pnsResolveCall):
+        returns the registered value or -1."""
+        nid = self._name_id(name)
+        key = keys_mod.sha1_key(name.encode(), self.sim.spec)
+        holders = self.lookup(
+            hex(keys_mod.to_int(key))[2:], 1) or self._closest_ready(key, 1)
+        if not holders:
+            return -1
+        nonce = (int(self.state.t_now) // 1000) % (2**30) + 29
+        self._inject(holders[0], wire.P2PNS_RES_CALL, key, a=nid, b=nonce)
+        got = self._collect([int(wire.P2PNS_RES_RES)], nonce,
+                            want_payload=True)
+        return got[0][2] if got else -1
+
+    def dump_dht(self):
+        """Aggregate every live node's DHTDataStorage
+        (XmlRpcInterface::dumpDht → DHTdump): [[key_hex, value], ...].
+        Reads storage state directly, as the reference dumps the local
+        DHT module's storage map."""
+        app = getattr(self.state.logic, "app", None)
+        if app is None or not hasattr(app, "s_key"):
+            return []
+        alive = np.asarray(self.state.alive)
+        s_key = np.asarray(app.s_key)
+        s_val = np.asarray(app.s_val)
+        out = []
+        lanes = s_key.shape[-1]
+        for i in np.nonzero(alive)[0]:
+            for d in range(s_val.shape[1]):
+                if s_val[i, d] != -1:
+                    k = 0
+                    for l in range(lanes):
+                        k = (k << 32) + int(s_key[i, d, l])
+                    out.append([hex(k)[2:], int(s_val[i, d])])
+        return out
+
+    def join_overlay(self):
+        """Spawn a node into the overlay (XmlRpcInterface::joinOverlay):
+        revives a dead slot with a fresh nodeId and schedules its join.
+        Returns the slot index, or -1 when every slot is alive."""
+        import jax
+        alive = np.asarray(self.state.alive)
+        dead = np.nonzero(~alive)[0]
+        if not len(dead):
+            return -1
+        slot = int(dead[0])
+        s = self.state
+        n = alive.shape[0]
+        mask = jnp.zeros((n,), bool).at[slot].set(True)
+        rng, r_key, r_reset, r_mig = jax.random.split(s.rng, 4)
+        fresh_keys = jnp.where(
+            mask[:, None],
+            keys_mod.random_keys(r_key, (n,), self.sim.spec), s.node_keys)
+        logic2 = self.sim.logic.reset(s.logic, mask, mask, s.t_now,
+                                      r_reset)
+        # mirror the engine's churn-create path (engine/sim.py):
+        # fresh coordinates, reset queues, dead TCP connections cleared
+        ul2 = self.sim.ul.migrate(s.underlay, mask, r_mig, self.sim.up)
+        self.state = dataclasses.replace(
+            s, rng=rng, alive=s.alive | mask, node_keys=fresh_keys,
+            underlay=ul2, logic=logic2)
+        return slot
+
 
 def serve(iface: XmlRpcInterface, host: str = "127.0.0.1",
           port: int = 0):
@@ -181,7 +310,9 @@ def serve(iface: XmlRpcInterface, host: str = "127.0.0.1",
     port).  Mirrors XmlRpcInterface's abyss-server setup (:102)."""
     server = SimpleXMLRPCServer((host, port), allow_none=True,
                                 logRequests=False)
-    for name in ("stats", "advance", "local_lookup", "put", "get"):
+    for name in ("stats", "advance", "local_lookup", "lookup", "put",
+                 "get", "register", "resolve", "dump_dht",
+                 "join_overlay"):
         server.register_function(getattr(iface, name), name)
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()

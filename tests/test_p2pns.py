@@ -47,3 +47,14 @@ def test_no_engine_losses(p2pns_run):
     eng = s.summary(st)["_engine"]
     assert eng["pool_overflow"] == 0
     assert eng["outbox_overflow"] == 0
+
+
+def test_xmlrpc_register_resolve(p2pns_run):
+    """External XML-RPC register/resolve through the P2PNS tier
+    (XmlRpcInterface.h register/resolve → P2pns calls)."""
+    from oversim_tpu.xmlrpcif import XmlRpcInterface
+    s, st = p2pns_run
+    iface = XmlRpcInterface(s, st, injector_slot=0)
+    assert iface.register("alice.example", 31337, ttl=900.0)
+    assert iface.resolve("alice.example") == 31337
+    assert iface.resolve("nobody.example") == -1
