@@ -2,16 +2,28 @@
 
 Rebuild of the reference SimpleGameClient movement family
 (src/applications/simplegameclient/MovementGenerator.{h,cc} +
-RandomRoaming.cc, HotspotRoaming.cc, TraverseRoaming.cc,
-GreatGathering.cc; selected by ``movementGenerator``, default.ini game
-client namespace).  Every generator advances [N, 2] positions by
-``speed``·dt toward a per-node waypoint and redraws the waypoint when
-reached:
+randomRoaming/hotspotRoaming/traverseRoaming/greatGathering/
+groupRoaming/realWorldRoaming.cc; selected by ``movementGenerator``,
+default.ini game client namespace).  Every generator advances [N, 2]
+positions by ``speed``·dt toward a per-node waypoint and redraws the
+waypoint when reached:
 
   * randomRoaming — uniform waypoints in the field;
   * hotspotRoaming — waypoints biased into a hotspot disc (nodes flock);
   * traverseRoaming — waypoints on the field corners (long crossings);
-  * greatGathering — everyone converges on the field center.
+  * greatGathering — everyone converges on the field center;
+  * groupRoaming — nodes form groups of ``group_size`` sharing one
+    roaming target (groupRoaming.cc: the GlobalCoordinator stores a
+    per-group target that a reaching member redraws).  The vectorized
+    build derives the shared target deterministically from
+    (group, epoch) with epoch = t / traversal-period — the same
+    all-members-chase-one-target dynamics without cross-node shared
+    state (documented deviation: redraws are time-sliced instead of
+    member-triggered);
+  * realWorldRoaming — positions driven by an external trajectory
+    (realWorldRoaming.cc::setPosition fed from GlobalCoordinator
+    scenery): a supplied waypoint script [W, 2] is played back with a
+    per-node phase offset.
 
 Used by the game overlays (Vast/Quon/NTree/PubSubMMOG) and SimMud: the
 same positions feed AOI neighborhoods / region subscriptions.
@@ -26,13 +38,16 @@ import jax.numpy as jnp
 
 F32 = jnp.float32
 
-GEN_RANDOM, GEN_HOTSPOT, GEN_TRAVERSE, GEN_GATHER = 0, 1, 2, 3
+(GEN_RANDOM, GEN_HOTSPOT, GEN_TRAVERSE, GEN_GATHER, GEN_GROUP,
+ GEN_REALWORLD) = 0, 1, 2, 3, 4, 5
 
 GENERATORS = {
     "randomRoaming": GEN_RANDOM,
     "hotspotRoaming": GEN_HOTSPOT,
     "traverseRoaming": GEN_TRAVERSE,
     "greatGathering": GEN_GATHER,
+    "groupRoaming": GEN_GROUP,
+    "realWorldRoaming": GEN_REALWORLD,
 }
 
 
@@ -42,6 +57,14 @@ class MoveParams:
     field: float = 1000.0         # areaDimension
     speed: float = 5.0            # movementSpeed (units/s)
     hotspot_radius: float = 100.0
+    group_size: int = 8           # groupRoaming groupSize
+    group_seed: int = 7           # seeds the shared per-(group, epoch)
+                                  # target draw — NOT the per-step rng,
+                                  # which changes every tick and would
+                                  # turn the held target into a walk
+    # realWorldRoaming trajectory script: ((x, y), ...) waypoints the
+    # external feed would deliver; played back cyclically per node
+    script: tuple = ((0.0, 0.0), (500.0, 500.0), (1000.0, 0.0))
 
 
 def init_positions(rng, n: int, p: MoveParams):
@@ -51,11 +74,43 @@ def init_positions(rng, n: int, p: MoveParams):
     return pos, draw_waypoints(r2, pos, p)
 
 
-def draw_waypoints(rng, pos, p: MoveParams):
-    """Per-generator waypoint draw (shape-agnostic: works on a [N, 2]
-    batch or a single [2] position inside a vmapped handler)."""
+def draw_waypoints(rng, pos, p: MoveParams, t_s=0.0):
+    """Per-generator waypoint draw.  Shape-agnostic ([N, 2] batch or a
+    single [2] position) for the classic generators; the time-sliced
+    ones (group/realWorld) need the FULL [N, 2] batch — node identity
+    is positional (slot // group_size, slot phase) and a per-node
+    vmapped call would collapse every node onto slot 0.
+    ``t_s`` (sim seconds) drives their epoch."""
     batch = pos.shape[:-1]
     g = GENERATORS[p.generator]
+    if g in (GEN_GROUP, GEN_REALWORLD) and not batch:
+        raise ValueError(
+            f"{p.generator} requires the all-[N] form (node identity is "
+            "positional); call with the full position batch")
+    if g == GEN_GROUP:
+        # shared per-group target, epoch-rotated: every member of group
+        # gid derives the SAME uniform draw from the FIXED group seed —
+        # the per-step rng must not leak in or the held target would
+        # resample every tick (groupRoaming.cc holds it for a whole
+        # traversal)
+        n = batch[0]
+        gid = jnp.arange(n) // p.group_size
+        period = p.field / max(p.speed, 1e-6)          # ~one traversal
+        epoch = jnp.asarray(t_s / period, jnp.int32)
+        base = jax.random.PRNGKey(p.group_seed)
+        def one(g_i):
+            k = jax.random.fold_in(jax.random.fold_in(base, g_i), epoch)
+            return jax.random.uniform(k, (2,), F32, 0.0, p.field)
+        return jax.vmap(one)(gid.astype(jnp.int32))
+    if g == GEN_REALWORLD:
+        # external trajectory playback: script waypoint per node phase
+        script = jnp.asarray(p.script, F32)            # [W, 2]
+        w = script.shape[0]
+        n = batch[0]
+        period = p.field / max(p.speed, 1e-6)
+        epoch = jnp.asarray(t_s / period, jnp.int32)
+        idx = (jnp.arange(n) + epoch) % w
+        return script[idx]
     if g == GEN_RANDOM:
         return jax.random.uniform(rng, pos.shape, F32, 0.0, p.field)
     if g == GEN_HOTSPOT:
@@ -78,7 +133,7 @@ def draw_waypoints(rng, pos, p: MoveParams):
     raise ValueError(p.generator)
 
 
-def step(pos, wp, dt_s, rng, p: MoveParams):
+def step(pos, wp, dt_s, rng, p: MoveParams, t_s=0.0):
     """Advance toward the waypoint; redraw reached waypoints.
 
     All-[N] form (callers slice per node if needed)."""
@@ -88,5 +143,12 @@ def step(pos, wp, dt_s, rng, p: MoveParams):
     reach = dist[..., 0] <= stepv
     unit = d / jnp.maximum(dist, 1e-6)
     new_pos = jnp.where(reach[..., None], wp, pos + unit * stepv)
-    new_wp = jnp.where(reach[..., None], draw_waypoints(rng, pos, p), wp)
+    g = GENERATORS[p.generator]
+    if g in (GEN_GROUP, GEN_REALWORLD):
+        # time-sliced generators retarget on epoch rotation regardless
+        # of per-node arrival (the shared target moves for everyone)
+        new_wp = draw_waypoints(rng, pos, p, t_s)
+    else:
+        new_wp = jnp.where(reach[..., None],
+                           draw_waypoints(rng, pos, p, t_s), wp)
     return new_pos, new_wp

@@ -186,7 +186,9 @@ class NTreeApp:
         r_mv, _ = jax.random.split(rng)
         npos, nwp = move_mod.step(app.pos, app.wp,
                                   jnp.float32(p.move_interval), r_mv,
-                                  p.move)
+                                  p.move,
+                                  t_s=ctx.t_start.astype(
+                                      jnp.float32) / NS)
         app = dataclasses.replace(
             app,
             pos=jnp.where(mv, npos, app.pos),

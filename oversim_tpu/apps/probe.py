@@ -1,0 +1,70 @@
+"""ConnectivityProbe: global connectivity metrics for spatial overlays.
+
+Rebuild of the reference ConnectivityProbeApp
+(src/applications/simplegameclient/ConnectivityProbeApp.{h,cc}): a
+global function that periodically extracts the game overlay's topology
+and records, against the ground-truth AOI neighborhoods implied by the
+actual positions:
+
+  * node count;
+  * nodes with ZERO missing AOI neighbors;
+  * average / maximum missing-neighbor count;
+  * average drift between a node's own position and where its
+    neighbors believe it is (cOV_AverageDrift).
+
+Host-side analysis over the overlay's [N, ...] state arrays — the
+reference's probe also reads every SimpleGameClient's state directly
+(extractTopology); no wire traffic is involved in either build.
+Works for any overlay exposing (pos [N,2], nbr [N,D], nbr_pos
+[N,D,2]) — Vast and Quon do.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NO_NODE = -1
+
+
+def connectivity_probe(pos, alive, nbr, nbr_pos, aoi: float) -> dict:
+    """Compute the ConnectivityProbeApp metric set.
+
+    Args: pos [N,2] actual positions; alive [N] bool; nbr [N,D] overlay
+    neighbor slots (NO_NODE padded); nbr_pos [N,D,2] the believed
+    positions of those neighbors; aoi — the AOI radius.
+    """
+    pos = np.asarray(pos, np.float64)
+    alive = np.asarray(alive, bool)
+    nbr = np.asarray(nbr)
+    nbr_pos = np.asarray(nbr_pos, np.float64)
+    n = pos.shape[0]
+
+    d = np.linalg.norm(pos[:, None, :] - pos[None, :, :], axis=-1)
+    truth = (d <= aoi) & alive[:, None] & alive[None, :]
+    np.fill_diagonal(truth, False)
+
+    known = np.zeros_like(truth)
+    rows = np.repeat(np.arange(n), nbr.shape[1])
+    cols = nbr.reshape(-1)
+    okm = (cols != NO_NODE) & alive[rows]
+    known[rows[okm], np.clip(cols[okm], 0, n - 1)] = True
+
+    missing = (truth & ~known).sum(axis=1)[alive]
+    node_count = int(alive.sum())
+
+    # drift: |believed position of neighbor - its actual position|
+    drift_num, drift_den = 0.0, 0
+    for i in np.nonzero(alive)[0]:
+        for j, slot in enumerate(nbr[i]):
+            if slot != NO_NODE and alive[slot]:
+                drift_num += float(
+                    np.linalg.norm(nbr_pos[i, j] - pos[slot]))
+                drift_den += 1
+
+    return {
+        "node_count": node_count,
+        "zero_missing": int((missing == 0).sum()) if node_count else 0,
+        "avg_missing": float(missing.mean()) if node_count else 0.0,
+        "max_missing": int(missing.max()) if node_count else 0,
+        "avg_drift": drift_num / drift_den if drift_den else 0.0,
+    }

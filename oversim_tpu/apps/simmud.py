@@ -102,7 +102,9 @@ class SimMudApp(ScribeApp):
         r_mv, r_rest = jax.random.split(rng)
         new_pos, new_wp = move_mod.step(app.pos, app.wp,
                                         jnp.float32(p.move_interval),
-                                        r_mv, p.move)
+                                        r_mv, p.move,
+                                        t_s=ctx.t_start.astype(
+                                            jnp.float32) / NS)
         new_pos = jnp.where(mv, new_pos, app.pos)
         new_wp = jnp.where(mv, new_wp, app.wp)
         new_region = self._region_of(new_pos)

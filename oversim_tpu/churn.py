@@ -66,6 +66,11 @@ class ChurnParams:
     lifetime_par1: float = 1.0        # lifetimeDistPar1
     graceful_leave_delay: float = 15.0        # gracefulLeaveDelay, default.ini:493
     graceful_leave_probability: float = 0.5   # default.ini:494
+    # per-peer rejoin context (GlobalNodeList::getContext/storeContext,
+    # GlobalNodeList.h:194; BaseOverlay.cc:823-831: a node created in a
+    # recycled slot reclaims the slot's previous nodeId and flags
+    # instead of drawing fresh ones — LifetimeChurn context slots)
+    rejoin_context: bool = False
     # RandomChurn (RandomChurn.{h,cc}): periodic probabilistic events
     churn_change_interval: float = 10.0   # churnChangeInterval
     creation_probability: float = 0.5     # creationProbability

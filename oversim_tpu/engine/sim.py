@@ -162,10 +162,17 @@ class Simulation:
         # SimpleUnderlayConfigurator.cc:350)
         pre_killed = churn_state.t_dead < T_INF
         # created slots get fresh nodeIds (BaseOverlay::join draws a random
-        # nodeId, BaseOverlay.cc:597-608) and fresh coordinates
-        node_keys = jnp.where(
-            created[:, None], keys_mod.random_keys(r_keys, (n,), self.spec),
-            s.node_keys)
+        # nodeId, BaseOverlay.cc:597-608) and fresh coordinates — unless
+        # rejoin_context keeps the slot's previous identity
+        # (GlobalNodeList::getContext/restoreContext, BaseOverlay.cc:
+        # 823-831: the rejoining peer reclaims its nodeId + flags)
+        if cp.rejoin_context:
+            node_keys = s.node_keys
+        else:
+            node_keys = jnp.where(
+                created[:, None],
+                keys_mod.random_keys(r_keys, (n,), self.spec),
+                s.node_keys)
         ul_state = self.ul.migrate(s.underlay, created, r_mig, up)
         # clear both created and killed slots; created ones schedule a join
         logic_state = logic.reset(s.logic, created | killed, created, t_next,

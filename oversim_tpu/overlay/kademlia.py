@@ -556,6 +556,12 @@ class KademliaLogic:
             t_join=jnp.where(en, T_INF, st.t_join),
             # immediate bucket refresh pass after join (Kademlia.cc:1043)
             t_refresh=jnp.where(en, now, st.t_refresh),
+            # ...and an immediate sibling-table refresh (own-key lookup)
+            # so a partially seeded table converges to the true closest
+            # set right away instead of after minSiblingTableRefresh
+            sib_used=jnp.where(
+                en, now - jnp.int64(int(p.sibling_refresh * NS)) - 1,
+                st.sib_used),
             t_bping=t_bping,
             app=self.app.on_ready(st.app, en, now, rng))
 
@@ -969,12 +975,21 @@ class KademliaLogic:
         lksucc_cnt += jnp.sum((taken & suc_l).astype(I32))
         anyfail_cnt += jnp.sum((taken & ~suc_l).astype(I32))
 
-        # join completion → READY (even on failure if we learned nodes;
-        # reference joins as long as the sibling table is non-empty).
+        # join completion → READY.  The reference becomes READY whenever
+        # the sibling table is non-empty (lookupFinished,
+        # Kademlia.cc:1543) — but its join lookup is exhaustive enough
+        # that the table then holds the true closest set.  A node going
+        # READY off a 1-2 entry table claims siblinghood for keys it
+        # does not own (isSiblingFor: not-full tables accept broadly,
+        # Kademlia.cc:888) and black-holes DHT traffic, so the
+        # vectorized build requires a SUCCESSFUL own-key lookup or a
+        # half-full sibling table before serving.
         # At most one join lookup exists per node (no_join_lk gate above).
         enj = taken & (pur_l == P_JOIN)
         any_j = jnp.any(enj)
-        got = any_j & (jnp.any(st.sib != NO_NODE) | jnp.any(enj & suc_l))
+        n_sib_j = jnp.sum((st.sib != NO_NODE).astype(I32))
+        got = any_j & (jnp.any(enj & suc_l)
+                       | (n_sib_j >= min(p.s, 4)))
         joins_cnt += got.astype(I32)
         st = self._become_ready(ctx, st, got, t0, rngs[4])
         # join failed with nothing learned → retry via t_join

@@ -375,7 +375,8 @@ class PubSubMMOGLogic:
         pos = st.pos + delta / dist * step_len
         arrived = en_s & (dist <= p.speed * dt)
         wp = jnp.where(arrived, move_mod.draw_waypoints(
-            rng_wp, pos, self.mp), st.wp)
+            rng_wp, pos, self.mp,
+            t_s=ctx.t_start.astype(jnp.float32) / NS), st.wp)
         st = dataclasses.replace(st, pos=pos, wp=wp)
 
         cur = self._subspace_of(st.pos)

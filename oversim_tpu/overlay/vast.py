@@ -344,7 +344,8 @@ class VastLogic:
         now_m = jnp.maximum(st.t_move, t0)
         new_pos, new_wp = move_mod.step(st.pos, st.wp,
                                         jnp.float32(p.move_interval),
-                                        rngs[2], p.move)
+                                        rngs[2], p.move,
+                                        t_s=t0.astype(jnp.float32) / NS)
         st = dataclasses.replace(
             st,
             pos=jnp.where(en_m, new_pos, st.pos),

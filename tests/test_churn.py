@@ -97,3 +97,26 @@ def test_chord_under_churn_stays_consistent():
     ratio = out["kbr_delivered"] / max(out["kbr_sent"], 1)
     assert ratio > 0.7
     assert out["_engine"]["pool_overflow"] == 0
+
+
+@pytest.mark.slow
+def test_rejoin_context_preserves_identity():
+    """GlobalNodeList::getContext/restoreContext (GlobalNodeList.h:194,
+    BaseOverlay.cc:823-831): with rejoin_context on, churned slots keep
+    their nodeId across death/rebirth — the key table never changes."""
+    import numpy as np
+    from oversim_tpu.overlay.kademlia import KademliaLogic
+
+    logic = KademliaLogic()
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=12,
+                               init_interval=0.5, lifetime_mean=60.0,
+                               rejoin_context=True)
+    ep = sim_mod.EngineParams(window=0.05, transition_time=20.0)
+    s = sim_mod.Simulation(logic, cp, engine_params=ep)
+    st = s.init(seed=5)
+    keys0 = np.asarray(st.node_keys).copy()
+    st = s.run_until(st, 250.0, chunk=256)
+    np.testing.assert_array_equal(np.asarray(st.node_keys), keys0)
+    # and the overlay still works across the rejoins
+    out = s.summary(st)
+    assert out["kbr_delivered"] >= 0.6 * max(out["kbr_sent"], 1)

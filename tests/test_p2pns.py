@@ -58,3 +58,68 @@ def test_xmlrpc_register_resolve(p2pns_run):
     assert iface.register("alice.example", 31337, ttl=900.0)
     assert iface.resolve("alice.example") == 31337
     assert iface.resolve("nobody.example") == -1
+
+
+def test_i3_prefix_anycast_and_stack():
+    """i3 longest-prefix anycast + trigger stacks (I3.h:56-120) at the
+    table level: a packet to an unregistered id matches the trigger with
+    the longest shared prefix; a continuation id chains before
+    delivering."""
+    import dataclasses as dc
+    import jax
+    import jax.numpy as jnp
+    from oversim_tpu.apps.i3 import I3App, I3Params
+    from oversim_tpu.common import wire as w
+    from oversim_tpu.engine.logic import Outbox, Msg
+
+    app_obj = I3App(I3Params(min_prefix_bits=8), num_slots=4)
+    st = app_obj.init(1)
+    st = jax.tree.map(lambda x: x[0], st)        # single-node slice
+    # trigger A: id 0b1010...0 owner 2; trigger B: id 0x0F000000 owner 3
+    # with a continuation to A's id
+    ida = jnp.int32(0x50F0F0F0)
+    idb = jnp.int32(0x0F000000)
+    st = dc.replace(
+        st,
+        tr_id=st.tr_id.at[0].set(ida).at[1].set(idb),
+        tr_owner=st.tr_owner.at[0].set(2).at[1].set(3),
+        tr_expire=st.tr_expire.at[0].set(10**15).at[1].set(10**15),
+        tr_next=st.tr_next.at[1].set(ida))
+
+    from oversim_tpu.apps.i3 import I3Global
+
+    class Ctx:  # minimal ctx stub for on_msg
+        glob = I3Global(trigger_ids=jnp.zeros((4, 5), jnp.uint32))
+        measuring = jnp.bool_(True)
+
+    def mk_msg(pkt_id, hops=0):
+        z = jnp.int32(0)
+        return Msg(valid=jnp.bool_(True), t_deliver=jnp.int64(1000),
+                   src=jnp.int32(1), dst=jnp.int32(0),
+                   kind=jnp.int32(w.I3_PACKET),
+                   key=jnp.zeros((5,), jnp.uint32), nonce=z,
+                   hops=jnp.int32(hops), a=jnp.int32(pkt_id), b=z, c=z,
+                   d=z, nodes=jnp.full((8,), -1, jnp.int32),
+                   size_b=jnp.int32(40), stamp=jnp.int64(0))
+
+    class Ev:
+        def count(self, *a): pass
+        def value(self, *a): pass
+
+    # near-A id (shares 24 bits with A, ~4 with B) → anycast to A owner 2
+    ob = Outbox(4, 5, 8)
+    app_obj.on_msg(st, mk_msg(0x50F0F0FF), Ctx(), ob, Ev(),
+                   jnp.bool_(True))
+    fields, valid, _ = ob.finish()
+    sent = [(int(k), int(d)) for k, d, v in
+            zip(fields["kind"], fields["dst"], valid) if v]
+    assert (int(w.I3_DELIVER), 2) in sent, sent
+
+    # B's exact id → stack chaining: re-enters as a packet for A's id
+    ob = Outbox(4, 5, 8)
+    app_obj.on_msg(st, mk_msg(0x0F000000), Ctx(), ob, Ev(),
+                   jnp.bool_(True))
+    fields, valid, _ = ob.finish()
+    sent = [(int(k), int(a)) for k, a, v in
+            zip(fields["kind"], fields["a"], valid) if v]
+    assert (int(w.I3_PACKET), int(ida)) in sent, sent
