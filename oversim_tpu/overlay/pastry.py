@@ -619,36 +619,51 @@ class PastryLogic:
                 results=jnp.where(local, seed_a[:lcfg.frontier], NO_NODE),
                 hops=jnp.int32(0), t0=now_a),
             ctx, ob, ev, now_a, node_idx))
-        if self.p.routing_mode == "semi-recursive":
-            # route the test payload itself (sendToKey at the originator:
-            # same hop logic, visited=[self], hops=1 on the first wire
-            # copy).  KBRTestApp's payload fields: c=measuring, b=tag.
+        # Which app requests ride the recursive data path?  Only the
+        # payloads the app DECLARES routable (route_policy — kbrtest's
+        # one-way/RPC tests).  Everything else (DHT LookupCalls, the
+        # kbr lookup test) needs a SIBLING-SET completion and goes
+        # through the iterative lookup engine even in semi-recursive
+        # mode, exactly like the reference (DHT.cc issues LookupCall
+        # regardless of the overlay's data routingType).  Routing every
+        # request as APP_ONEWAY data was the round-3 verify_pastry
+        # golden's 1%-put-success bug.
+        use_route = (self.p.routing_mode == "semi-recursive"
+                     and hasattr(self.app, "route_policy"))
+        if use_route:
+            routable, inner_a, is_rpc = self.app.route_policy(req.tag)
             vis0 = jnp.full((rmax,), NO_NODE, I32).at[0].set(node_idx)
             nxt0, found0 = rt_mod.pick_next_hop(
                 cands_a, jnp.full((rmax,), NO_NODE, I32), NO_NODE,
                 node_idx, node_idx, sib_a)
-            fire0 = req.want & ~sib_a & found0
+            fire0 = req.want & ~sib_a & routable & found0
             st = dataclasses.replace(st, rr=rt_mod.forward(
                 st.rr, ob, fire0, now_a, nxt0, key=req.key,
-                inner=jnp.int32(wire.APP_ONEWAY), a=jnp.int32(0),
-                b=req.tag, c=ctx.measuring.astype(I32), hops=jnp.int32(1),
+                inner=inner_a, a=req.tag, b=jnp.int32(0),
+                c=ctx.measuring.astype(I32), hops=jnp.int32(1),
                 stamp=now_a, size_b=jnp.int32(100), visited=vis0,
                 cfg=self.rcfg))
-            routedrop_cnt += (req.want & ~sib_a & ~found0).astype(I32)
+            if hasattr(self.app, "on_route_fired"):
+                st = dataclasses.replace(st, app=self.app.on_route_fired(
+                    st.app, fire0 & is_rpc, now_a, req.tag))
+            routedrop_cnt += (req.want & ~sib_a & routable
+                              & ~found0).astype(I32)
         else:
-            slot, have = lk_mod.free_slot(st.lk)
-            start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
-            insta_fail = req.want & ~sib_a & ~start_app
-            st = dataclasses.replace(st, app=self.app.on_lookup_done(
-                st.app, app_base.LookupDone(
-                    en=insta_fail, success=jnp.bool_(False), tag=req.tag,
-                    target=req.key,
-                    results=jnp.full((lcfg.frontier,), NO_NODE, I32),
-                    hops=jnp.int32(0), t0=now_a),
-                ctx, ob, ev, now_a, node_idx))
-            st = dataclasses.replace(st, lk=lk_mod.start(
-                st.lk, start_app, slot, P_APP, req.tag, req.key,
-                seed_a[:lcfg.frontier], now_a, lcfg))
+            routable = jnp.bool_(False)
+        slot, have = lk_mod.free_slot(st.lk)
+        start_app = (req.want & ~sib_a & ~routable & have
+                     & (seed_a[0] != NO_NODE))
+        insta_fail = req.want & ~sib_a & ~routable & ~start_app
+        st = dataclasses.replace(st, app=self.app.on_lookup_done(
+            st.app, app_base.LookupDone(
+                en=insta_fail, success=jnp.bool_(False), tag=req.tag,
+                target=req.key,
+                results=jnp.full((lcfg.frontier,), NO_NODE, I32),
+                hops=jnp.int32(0), t0=now_a),
+            ctx, ob, ev, now_a, node_idx))
+        st = dataclasses.replace(st, lk=lk_mod.start(
+            st.lk, start_app, slot, P_APP, req.tag, req.key,
+            seed_a[:lcfg.frontier], now_a, lcfg))
 
         # ------------------------------------------------ lookup timeouts --
         new_lk, failed_nodes, _ = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)

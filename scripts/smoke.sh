@@ -1,0 +1,10 @@
+#!/bin/bash
+# Fast test tier (<5 min on the 1-core CI box): unit-level files plus
+# `-m "not slow"` filtering.  The multi-minute simulation files run in
+# the full suite (scripts/run_suite.sh -> SUITE_rNN.txt evidence).
+set -eu
+cd "$(dirname "$0")/.."
+exec python -m pytest -q -m "not slow" \
+  tests/test_keys.py tests/test_config.py tests/test_underlay.py \
+  tests/test_recorder.py tests/test_coordpool.py tests/test_trace.py \
+  tests/test_cbr.py tests/test_checkpoint.py "$@"
