@@ -504,7 +504,7 @@ class DhtApp:
         ob.send(en, now, m.src, wire.DHT_PUT_RES, key=m.key, b=m.b,
                 size_b=wire.BASE_CALL_B)
 
-        # DHTPutResponse → ack counting; full quorum = success.  The op
+        # DHTPutResponse → ack counting; majority = success.  The op
         # nonce echoed in b rejects straggler acks from a timed-out op
         # (the reference ties CAPI responses to RPC nonces)
         en = (m.valid & (m.kind == wire.DHT_PUT_RES) & (app.op == OP_PUT)

@@ -650,10 +650,14 @@ class PastryLogic:
                               & ~found0).astype(I32)
         else:
             routable = jnp.bool_(False)
+            fire0 = jnp.bool_(False)
         slot, have = lk_mod.free_slot(st.lk)
         start_app = (req.want & ~sib_a & ~routable & have
                      & (seed_a[0] != NO_NODE))
-        insta_fail = req.want & ~sib_a & ~routable & ~start_app
+        # a routable request with NO next hop must fail its op too
+        # (chord/kademlia: insta_fail = ~start_app & ~route_fire) — else
+        # routed-RPC tests leak into a never-resolved state
+        insta_fail = req.want & ~sib_a & ~start_app & ~fire0
         st = dataclasses.replace(st, app=self.app.on_lookup_done(
             st.app, app_base.LookupDone(
                 en=insta_fail, success=jnp.bool_(False), tag=req.tag,
