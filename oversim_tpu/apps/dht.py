@@ -59,6 +59,10 @@ NO_VAL = jnp.int32(-1)
 
 OP_NONE, OP_PUT, OP_GET = 0, 1, 2
 
+# append-to-pool marker for op_g / commit_g (fresh-key put; mods and
+# trace commands address an existing slot >= 0)
+G_APPEND = jnp.int32(-2)
+
 
 @dataclasses.dataclass(frozen=True)
 class DhtParams:
@@ -70,8 +74,15 @@ class DhtParams:
     test_interval: float = 60.0   # dhtTestApp.testInterval
     test_ttl: float = 300.0       # dhtTestApp.testTtl
     storage_slots: int = 32       # per-node DHTDataStorage capacity
-    num_test_keys: int = 64       # GlobalDhtTestMap key pool size
+    # GlobalDhtTestMap capacity: the reference map grows unboundedly
+    # (every put inserts a FRESH random key, DHTTestApp.cc:334-346);
+    # here it is a ring of this many slots — size it so a run's puts
+    # don't wrap.  A get whose slot IS recycled mid-op counts as
+    # dht_get_notfound (the reference's entry==NULL numGetError path,
+    # DHTTestApp.cc:193-198), never as wrong-data
+    num_test_keys: int = 1024
     op_timeout: float = 10.0      # CAPI timeout (lookup+put round)
+    mod_test: bool = True         # dhttest_mod_timer (re-put known key)
 
 
 @jax.tree_util.register_dataclass
@@ -96,16 +107,18 @@ class DhtState:
     # one outstanding operation
     op: jnp.ndarray        # [N] i32 OP_*
     op_seq: jnp.ndarray    # [N] i32 — op nonce (stale-completion guard)
-    op_g: jnp.ndarray      # [N] i32 oracle slot
+    op_g: jnp.ndarray      # [N] i32 oracle slot (G_APPEND = fresh key)
+    op_key: jnp.ndarray    # [N, KL] u32 — the op's key
     op_val: jnp.ndarray    # [N] i32 value being put
-    op_expect: jnp.ndarray  # [N] i32 truth value for pending GET
     op_pending: jnp.ndarray  # [N] i32 replica responses awaited
     op_acks: jnp.ndarray   # [N] i32
     op_votes: jnp.ndarray  # [N, Q] i32 — GET quorum response values
     op_to: jnp.ndarray     # [N] i64 op timeout
     op_t0: jnp.ndarray     # [N] i64 op start (latency stat)
     # staged truth commit, folded into DhtGlobal by post_step
-    commit_g: jnp.ndarray      # [N] i32 oracle slot (-1 = none)
+    # (-1 = none, G_APPEND = append fresh key, >= 0 = write slot)
+    commit_g: jnp.ndarray      # [N] i32
+    commit_key: jnp.ndarray    # [N, KL] u32
     commit_val: jnp.ndarray    # [N] i32
     commit_expire: jnp.ndarray  # [N] i64
     # update()-driven maintenance replication (BaseApp::update,
@@ -118,11 +131,16 @@ class DhtState:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DhtGlobal:
-    """GlobalDhtTestMap: the key pool and current truth values."""
+    """GlobalDhtTestMap: the known-key pool and current truth values.
 
-    keys: jnp.ndarray   # [G, KL] u32 — fixed random test keys
+    Mirrors the reference's grow-on-put map (GlobalDhtTestMap::insertEntry,
+    GlobalDhtTestMap.cc:86): fresh-key puts APPEND at ``cursor`` (ring);
+    mods/trace commands overwrite their slot."""
+
+    keys: jnp.ndarray   # [G, KL] u32 — keys put so far (ring)
     val: jnp.ndarray    # [G] i32 — current truth (-1 = never put)
     expire: jnp.ndarray  # [G] i64 — truth TTL deadline
+    cursor: jnp.ndarray  # i32 scalar — next append slot
 
 
 class DhtApp:
@@ -136,10 +154,22 @@ class DhtApp:
 
     def __init__(self, params: DhtParams = DhtParams(),
                  spec: keys_mod.KeySpec = keys_mod.DEFAULT_SPEC,
-                 trace=None):
+                 trace=None, dist_fn=None):
         self.p = params
         self.spec = spec
         self.trace = trace
+        # overlay distance metric for the maintenance responsibility
+        # filter (reference overlay->distance in DHT::update,
+        # DHT.cc:732-764).  Signature dist_fn(node_key, record_key) ->
+        # key-shaped distance; None falls back to XOR (exact for the
+        # Kademlia family).  Ring overlays patch theirs in their
+        # constructors (chord.py/pastry.py ``app.dist_fn = ...``), the
+        # same late-binding convention as ``app.rcfg``.
+        self.dist_fn = dist_fn
+
+    @property
+    def dist(self):
+        return self.dist_fn or keys_mod.xor_metric
 
     def stat_spec(self):
         return dict(
@@ -181,14 +211,15 @@ class DhtApp:
             op=jnp.zeros((n,), I32),
             op_seq=jnp.zeros((n,), I32),
             op_g=jnp.zeros((n,), I32),
+            op_key=jnp.zeros((n, kl), U32),
             op_val=jnp.full((n,), NO_VAL, I32),
-            op_expect=jnp.full((n,), NO_VAL, I32),
             op_pending=jnp.zeros((n,), I32),
             op_acks=jnp.zeros((n,), I32),
             op_votes=jnp.full((n, p.num_get_requests), NO_VAL - 1, I32),
             op_to=jnp.full((n,), T_INF, I64),
             op_t0=jnp.zeros((n,), I64),
             commit_g=jnp.full((n,), -1, I32),
+            commit_key=jnp.zeros((n, kl), U32),
             commit_val=jnp.full((n,), NO_VAL, I32),
             commit_expire=jnp.zeros((n,), I64),
             mnt_dst=jnp.full((n,), NO_NODE, I32),
@@ -196,29 +227,49 @@ class DhtApp:
         )
 
     def glob_init(self, rng) -> DhtGlobal:
+        del rng
         if self.trace is not None:
             pool = jnp.asarray(self.trace.key_pool, U32)
             return DhtGlobal(
                 keys=pool,
                 val=jnp.full((pool.shape[0],), NO_VAL, I32),
-                expire=jnp.zeros((pool.shape[0],), I64))
+                expire=jnp.zeros((pool.shape[0],), I64),
+                cursor=jnp.int32(0))
+        # the map starts EMPTY and grows as puts complete, exactly like
+        # GlobalDhtTestMap (first gets find no key and are skipped,
+        # DHTTestApp.cc:356-363 "No key available")
         g = self.p.num_test_keys
         return DhtGlobal(
-            keys=keys_mod.random_keys(rng, (g,), self.spec),
+            keys=jnp.zeros((g, self.spec.lanes), U32),
             val=jnp.full((g,), NO_VAL, I32),
-            expire=jnp.zeros((g,), I64))
+            expire=jnp.zeros((g,), I64),
+            cursor=jnp.int32(0))
 
     def post_step(self, ctx, state: DhtState, glob: DhtGlobal, events):
         """Fold per-node staged put-commits into the truth map (the
-        moment the reference's DHTTestApp stores into GlobalDhtTestMap)."""
+        moment the reference's DHTTestApp stores into GlobalDhtTestMap,
+        DHTTestApp.cc:151-153 — on EVERY put completion, success or
+        not).  Fresh-key puts append at the ring cursor; mod/trace
+        commits overwrite their slot, guarded on the slot still holding
+        the op's key (ring recycling)."""
         del events
-        rows = jnp.where(state.commit_g >= 0, state.commit_g,
-                         glob.val.shape[0])
+        g_n = glob.val.shape[0]
+        slot_w = state.commit_g >= 0
+        gs = jnp.clip(state.commit_g, 0, g_n - 1)
+        still = jnp.all(glob.keys[gs] == state.commit_key, axis=-1)
+        rows = jnp.where(slot_w & still, gs, g_n)
+        val = glob.val.at[rows].set(state.commit_val, mode="drop")
+        expire = glob.expire.at[rows].set(state.commit_expire, mode="drop")
+        app_w = state.commit_g == G_APPEND
+        rank = jnp.cumsum(app_w.astype(I32)) - app_w.astype(I32)
+        pos = jnp.where(app_w, (glob.cursor + rank) % g_n, g_n)
         glob = dataclasses.replace(
             glob,
-            val=glob.val.at[rows].set(state.commit_val, mode="drop"),
-            expire=glob.expire.at[rows].set(state.commit_expire,
-                                            mode="drop"))
+            keys=glob.keys.at[pos].set(state.commit_key, mode="drop"),
+            val=val.at[pos].set(state.commit_val, mode="drop"),
+            expire=expire.at[pos].set(state.commit_expire, mode="drop"),
+            cursor=(glob.cursor
+                    + jnp.sum(app_w.astype(I32), dtype=I32)) % g_n)
         n = state.commit_g.shape[0]
         state = dataclasses.replace(
             state, commit_g=jnp.full((n,), -1, I32))
@@ -250,6 +301,20 @@ class DhtApp:
         # an active maintenance replication pumps every tick until done
         return jnp.where(app.mnt_dst != NO_NODE, jnp.int64(0), t)
 
+    def _stage_commit(self, app, en):
+        """Stage the pending op's (key, value, expiry) as a truth-map
+        commit for post_step — shared by put-complete, put-lookup-fail
+        and put-timeout (the reference inserts into GlobalDhtTestMap on
+        every put response path, DHTTestApp.cc:151-153)."""
+        return dataclasses.replace(
+            app,
+            commit_g=jnp.where(en, app.op_g, app.commit_g),
+            commit_key=jnp.where(en, app.op_key, app.commit_key),
+            commit_val=jnp.where(en, app.op_val, app.commit_val),
+            commit_expire=jnp.where(
+                en, app.op_t0 + jnp.int64(int(self.p.test_ttl * NS)),
+                app.commit_expire))
+
     def on_update(self, app, en, ctx, ob, ev, now, node_idx, added):
         """BaseApp::update (BaseApp.h:223) — the overlay reports a node
         that ENTERED this node's replica/sibling set; my stored records
@@ -273,11 +338,21 @@ class DhtApp:
         the staged new replica-set member (apps/base.py on_tick hook).
         Skips empty storage slots so a sparse store finishes in
         ceil(records/2) ticks instead of slots/2 (the pump holds the
-        sim-wide event horizon down while active)."""
+        sim-wide event horizon down while active).
+
+        Responsibility filter (DHT::update, DHT.cc:732-764): a record
+        replicates only if the target is at least as close to its key as
+        we are — pushing the whole store regardless floods the target
+        with records it is not responsible for (and, with bounded
+        storage, could evict ones it is)."""
         d = app.s_val.shape[0]
         idx = jnp.arange(d, dtype=I32)
+        me_key = ctx.keys[node_idx]
+        tgt_key = ctx.keys[jnp.maximum(app.mnt_dst, 0)]
+        resp = keys_mod.le(self.dist(tgt_key[None, :], app.s_key),
+                           self.dist(me_key[None, :], app.s_key))
         for _ in range(2):
-            cand = (app.s_val != NO_VAL) & (idx >= app.mnt_pos)
+            cand = (app.s_val != NO_VAL) & (idx >= app.mnt_pos) & resp
             m_en = (app.mnt_dst != NO_NODE) & jnp.any(cand)
             col = jnp.argmax(cand).astype(I32)
             ob.send(m_en, ctx.t_start, app.mnt_dst, wire.DHT_PUT_CALL,
@@ -287,7 +362,8 @@ class DhtApp:
             ev.count("dht_mnt_puts", m_en)
             app = dataclasses.replace(
                 app, mnt_pos=jnp.where(m_en, col + 1, app.mnt_pos))
-        done = ~jnp.any((app.s_val != NO_VAL) & (idx >= app.mnt_pos))
+        done = ~jnp.any((app.s_val != NO_VAL) & (idx >= app.mnt_pos)
+                        & resp)
         return dataclasses.replace(
             app, mnt_dst=jnp.where(done, NO_NODE, app.mnt_dst))
 
@@ -298,9 +374,15 @@ class DhtApp:
         glob: DhtGlobal = ctx.glob
         g_n = glob.val.shape[0]
 
-        # op timeout → failed operation
+        # op timeout → failed operation.  A timed-out PUT still records
+        # its value as the truth — the reference's DHTTestApp inserts
+        # into GlobalDhtTestMap on EVERY put response including
+        # isSuccess=false (DHTTestApp.cc:151-153 insertEntry precedes
+        # the success check), so later gets of that key must expect the
+        # failed put's value
         to = (app.op != OP_NONE) & (app.op_to < ctx.t_end)
         ev.count("dht_lookup_failed", to)
+        app = self._stage_commit(app, to & (app.op == OP_PUT))
         app = dataclasses.replace(
             app,
             op=jnp.where(to, OP_NONE, app.op),
@@ -337,8 +419,8 @@ class DhtApp:
                              jnp.where(do_get, OP_GET, app.op)),
                 op_seq=jnp.where(fire, app.seq, app.op_seq),
                 op_g=jnp.where(fire, g, app.op_g),
+                op_key=jnp.where(fire, key, app.op_key),
                 op_val=jnp.where(do_put, val, app.op_val),
-                op_expect=jnp.where(do_get, glob.val[g], app.op_expect),
                 op_pending=jnp.where(fire, 0, app.op_pending),
                 op_acks=jnp.where(fire, 0, app.op_acks),
                 op_to=jnp.where(fire, now + jnp.int64(
@@ -347,40 +429,60 @@ class DhtApp:
             return app, base.LookupReq(want=do_put | do_get, key=key,
                                        tag=app.op_seq)
 
-        # periodic test: alternate PUT / GET (DHTTestApp::handleTimerEvent
-        # issues a put or get per tick of its own timers; we alternate on
-        # the sequence number)
+        # periodic test: cycle PUT (fresh random key) / GET (known key) /
+        # MOD (re-put of a known key) — the reference runs three
+        # independent timers at testInterval each with staggered offsets
+        # (DHTTestApp.cc:104-118); the round-robin at interval/modes
+        # preserves each mode's rate under the one-op-per-timer app
+        # interface.  Fresh-key puts are what keeps concurrent same-key
+        # writes rare in the reference workload (OverlayKey::random()
+        # per put, DHTTestApp.cc:334-346) — a fixed key pool manufactures
+        # write-write collisions whose mixed replica orders surface as
+        # wrong-value gets.
         fire = en & (app.t_test < ctx.t_end) & (app.op == OP_NONE)
-        r_g, r_v = jax.random.split(rng)
-        g = jax.random.randint(r_g, (), 0, g_n, dtype=I32)
-        do_get_pref = (app.seq % 2) == 1
-        truth_ok = (glob.val[g] != NO_VAL) & (glob.expire[g] > now)
-        do_get = fire & do_get_pref & truth_ok
-        do_put = fire & ~do_get
-        ev.count("dht_put_attempts", do_put)
+        due = en & (app.t_test < ctx.t_end)
+        r_g, r_v, r_k = jax.random.split(rng, 3)
+        n_modes = 3 if p.mod_test else 2
+        mode = app.seq % n_modes        # 0 = put, 1 = get, 2 = mod
+        # known-key draw: uniform over live truth entries (getRandomKey)
+        valid = (glob.val != NO_VAL) & (glob.expire > now)
+        vcum = jnp.cumsum(valid.astype(I32))
+        n_valid = vcum[-1]
+        k = jax.random.randint(r_g, (), 0, jnp.maximum(n_valid, 1),
+                               dtype=I32)
+        g = jnp.clip(jnp.searchsorted(vcum, k + 1, side="left").astype(I32),
+                     0, g_n - 1)
+        have_known = n_valid > 0
+        do_put = fire & (mode == 0)
+        do_get = fire & (mode == 1) & have_known
+        do_mod = fire & (mode == 2) & have_known
+        ev.count("dht_put_attempts", do_put | do_mod)
         ev.count("dht_get_attempts", do_get)
-        # fresh value id: unique per (node, seq) — 24 bits of rng + seq mix
+        # fresh value id: unique per (node, seq) — 30 bits of rng
         val = jnp.abs(jax.random.randint(r_v, (), 0, 2**30, dtype=I32))
-        key = glob.keys[g]
+        key = jnp.where(do_put, keys_mod.random_keys(r_k, (), self.spec),
+                        glob.keys[g])
+        put_like = do_put | do_mod
+        any_op = put_like | do_get
         app = dataclasses.replace(
             app,
-            t_test=jnp.where(fire | (en & (app.t_test < ctx.t_end)),
+            t_test=jnp.where(due,
                              jnp.maximum(app.t_test, now) + jnp.int64(
-                                 int(p.test_interval * NS)),
+                                 int(p.test_interval / n_modes * NS)),
                              app.t_test),
-            seq=app.seq + fire.astype(I32),
-            op=jnp.where(do_put, OP_PUT, jnp.where(do_get, OP_GET, app.op)),
-            op_seq=jnp.where(fire, app.seq, app.op_seq),
-            op_g=jnp.where(fire, g, app.op_g),
-            op_val=jnp.where(do_put, val, app.op_val),
-            op_expect=jnp.where(do_get, glob.val[g], app.op_expect),
-            op_pending=jnp.where(fire, 0, app.op_pending),
-            op_acks=jnp.where(fire, 0, app.op_acks),
-            op_to=jnp.where(fire, now + jnp.int64(int(p.op_timeout * NS)),
+            seq=app.seq + due.astype(I32),
+            op=jnp.where(put_like, OP_PUT,
+                         jnp.where(do_get, OP_GET, app.op)),
+            op_seq=jnp.where(any_op, app.seq, app.op_seq),
+            op_g=jnp.where(do_put, G_APPEND, jnp.where(any_op, g, app.op_g)),
+            op_key=jnp.where(any_op, key, app.op_key),
+            op_val=jnp.where(put_like, val, app.op_val),
+            op_pending=jnp.where(any_op, 0, app.op_pending),
+            op_acks=jnp.where(any_op, 0, app.op_acks),
+            op_to=jnp.where(any_op, now + jnp.int64(int(p.op_timeout * NS)),
                             app.op_to),
-            op_t0=jnp.where(fire, now, app.op_t0))
-        return app, base.LookupReq(want=do_put | do_get, key=key,
-                                   tag=app.op_seq)
+            op_t0=jnp.where(any_op, now, app.op_t0))
+        return app, base.LookupReq(want=any_op, key=key, tag=app.op_seq)
 
     # -- lookup completion → replica fan-out ---------------------------------
 
@@ -392,6 +494,11 @@ class DhtApp:
         en = done.en & (app.op != OP_NONE) & (done.tag == app.op_seq)
         suc = done.success & (done.results[0] != NO_NODE)
         ev.count("dht_lookup_failed", en & ~suc)
+        # a PUT whose sibling lookup failed still inserts its value into
+        # the truth map — the reference's isSuccess=false CAPI response
+        # path (DHTTestApp::handlePutResponse inserts BEFORE the success
+        # check, DHTTestApp.cc:151-153)
+        app = self._stage_commit(app, en & ~suc & (app.op == OP_PUT))
         app = dataclasses.replace(
             app,
             op=jnp.where(en & ~suc, OP_NONE, app.op),
@@ -451,11 +558,16 @@ class DhtApp:
             app.s_val != NO_VAL)
         same = en & jnp.any(same_mask)
         col_same = jnp.argmax(same_mask).astype(I32)
+        free = app.s_val == NO_VAL
         if maintenance is not None:
             stale = maintenance & same & (app.s_expire[col_same] >= expire)
             en = en & ~stale
+            # a replication copy never EVICTS a legitimately stored
+            # record (the reference's DHTDataStorage is unbounded —
+            # maintenance bursts cannot destroy owned data there, so a
+            # bounded store must drop the copy instead)
+            en = en & (same | jnp.any(free) | ~maintenance)
         did = en
-        free = app.s_val == NO_VAL
         col_free = jnp.argmax(free).astype(I32)
         col_evict = jnp.argmin(app.s_expire).astype(I32)
         col = jnp.where(same, col_same,
@@ -518,17 +630,12 @@ class DhtApp:
         ev.count("dht_put_success", complete)
         ev.value("dht_put_latency_s",
                  (now - app.op_t0).astype(jnp.float32) / NS, complete)
+        app = self._stage_commit(app, complete)   # truth commit
         app = dataclasses.replace(
             app,
             op_acks=acks,
             op=jnp.where(complete, OP_NONE, app.op),
-            op_to=jnp.where(complete, T_INF, app.op_to),
-            # stage the truth commit for post_step
-            commit_g=jnp.where(complete, app.op_g, app.commit_g),
-            commit_val=jnp.where(complete, app.op_val, app.commit_val),
-            commit_expire=jnp.where(
-                complete, app.op_t0 + jnp.int64(int(p.test_ttl * NS)),
-                app.commit_expire))
+            op_to=jnp.where(complete, T_INF, app.op_to))
 
         # DHTGetCall → storage probe + reply (DHT::handleGetRequest)
         en = m.valid & (m.kind == wire.DHT_GET_CALL)
@@ -546,10 +653,8 @@ class DhtApp:
         # Nonce + key match guard against stale responses completing a
         # newer GET with a mismatched value
         q = p.num_get_requests
-        op_key = ctx.glob.keys[jnp.clip(app.op_g, 0,
-                                        ctx.glob.val.shape[0] - 1)]
         en = (m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
-              & (m.b == app.op_seq) & jnp.all(m.key == op_key))
+              & (m.b == app.op_seq) & jnp.all(m.key == app.op_key))
         slot = jnp.where(en, jnp.clip(app.op_acks, 0, q - 1), q)
         votes = app.op_votes.at[slot].set(m.a, mode="drop")
         n_acks = app.op_acks + en.astype(I32)
@@ -564,17 +669,29 @@ class DhtApp:
         winner = votes[jnp.argmax(counts)]
         exhausted = en & ~win & (n_acks >= app.op_pending)
         complete = win | exhausted
-        expect = ctx.glob.val[jnp.clip(app.op_g, 0,
-                                       ctx.glob.val.shape[0] - 1)]
-        good = win & (winner == expect) & (winner != NO_VAL)
+        # truth-map validation (DHTTestApp::handleGetResponse,
+        # DHTTestApp.cc:173-232): slot recycled (ring wrap) maps to the
+        # reference's entry==NULL error; expired truth means an empty
+        # result is SUCCESS ("deleted key gone") and a value is an error
+        # ("deleted key still available"); live truth compares values
+        g_n = ctx.glob.val.shape[0]
+        gslot = jnp.clip(app.op_g, 0, g_n - 1)
+        slot_ok = jnp.all(ctx.glob.keys[gslot] == app.op_key) & (
+            app.op_g >= 0)
+        expired = now > ctx.glob.expire[gslot]
+        expect = ctx.glob.val[gslot]
+        has_val = winner != NO_VAL
+        good = win & slot_ok & jnp.where(expired, ~has_val,
+                                         has_val & (winner == expect))
+        wrong = win & slot_ok & has_val & (expired | (winner != expect))
         ev.count("dht_get_success", good)
         # wrong-data = a QUORUM winner that mismatches the truth; an
         # exhausted vote (responses in, no ratioIdentical majority) is a
         # plain failure in the reference (DHT.cc:635-668 isSuccess
         # false), not wrong data
-        ev.count("dht_get_wrong",
-                 win & (winner != expect) & (winner != NO_VAL))
-        ev.count("dht_get_notfound", win & (winner == NO_VAL))
+        ev.count("dht_get_wrong", wrong)
+        ev.count("dht_get_notfound",
+                 win & ((slot_ok & ~expired & ~has_val) | ~slot_ok))
         ev.value("dht_get_latency_s",
                  (now - app.op_t0).astype(jnp.float32) / NS, good)
         app = dataclasses.replace(

@@ -60,6 +60,7 @@ class KbrTestParams:
     rpc_test: bool = False          # kbrRpcTest
     lookup_test: bool = False       # kbrLookupTest
     rpc_timeout: float = 10.0       # rpcKeyTimeout, default.ini:485
+    msg_handle_buf: int = 8         # msgHandleBufSize, default.ini:39
 
     @property
     def modes(self) -> tuple:
@@ -82,6 +83,13 @@ class KbrTestState:
     rpc_to: jnp.ndarray   # [N] i64 — its timeout
     rpc_t0: jnp.ndarray   # [N] i64 — its start (RTT base)
     rpc_nonce: jnp.ndarray  # [N] i32 — call nonce (stale-response guard)
+    # circular (src, seqTag) duplicate filter (KBRTestApp::checkSeen,
+    # KBRTestApp.cc:458-476, msgHandleBufSize ring).  Width 0 when the
+    # overlay routes iteratively — the pool delivers exactly once there,
+    # duplicates only arise from the recursive ACK/reroute path.
+    seen_src: jnp.ndarray   # [N, B] i32
+    seen_seq: jnp.ndarray   # [N, B] i32
+    seen_ptr: jnp.ndarray   # [N] i32
 
 
 class KbrTestApp:
@@ -96,12 +104,21 @@ class KbrTestApp:
         self.p = params
         self.rcfg = rcfg
 
+    @property
+    def buf(self) -> int:
+        """Dedup-ring width: active only under recursive routing (the
+        iterative pool delivers exactly once).  A property, not frozen at
+        construction — overlays patch ``app.rcfg`` after constructing the
+        default app (chord.py/kademlia.py ``self.app.rcfg = rcfg``),
+        before ``init`` sizes the state arrays."""
+        return self.p.msg_handle_buf if self.rcfg is not None else 0
+
     def route_policy(self, tag):
         """Which of this app's lookup requests a recursive overlay may
         route as data instead (returns (routable, inner_kind, is_rpc)).
         One-way and routed-RPC test payloads route; the lookup test needs
         a sibling resolution and stays on the lookup engine."""
-        mode = tag % 4
+        mode = (tag // 2) % 4
         routable = (mode == M_ONEWAY) | (mode == M_RPC)
         inner = jnp.where(mode == M_ONEWAY, jnp.int32(wire.APP_ONEWAY),
                           jnp.int32(wire.APP_RPC_CALL))
@@ -136,7 +153,32 @@ class KbrTestApp:
                             rpc_dst=jnp.full((n,), NO_NODE, I32),
                             rpc_to=jnp.full((n,), T_INF, I64),
                             rpc_t0=jnp.zeros((n,), I64),
-                            rpc_nonce=jnp.full((n,), -1, I32))
+                            rpc_nonce=jnp.full((n,), -1, I32),
+                            seen_src=jnp.full((n, self.buf), NO_NODE, I32),
+                            seen_seq=jnp.zeros((n, self.buf), I32),
+                            seen_ptr=jnp.zeros((n,), I32))
+
+    def _check_seen(self, app, src, seq, cand):
+        """Circular (src, seqTag) duplicate filter — KBRTestApp::checkSeen
+        (KBRTestApp.cc:458-476).  ``cand`` [R] marks lanes to screen;
+        returns (app', dup [R]).  Fresh lanes are inserted into the ring
+        (oldest-overwritten), duplicates-within-the-batch also flagged."""
+        b = self.buf
+        dup_buf = ((app.seen_src[None, :] == src[:, None])
+                   & (app.seen_seq[None, :] == seq[:, None])).any(-1)
+        same = (src[:, None] == src[None, :]) & (seq[:, None] == seq[None, :])
+        earlier = (jnp.tril(same, k=-1) & cand[None, :]).any(-1)
+        dup = cand & (dup_buf | earlier)
+        fresh = cand & ~dup
+        rank = jnp.cumsum(fresh.astype(I32)) - fresh.astype(I32)
+        pos = jnp.where(fresh, (app.seen_ptr + rank) % b, b)
+        app = dataclasses.replace(
+            app,
+            seen_src=app.seen_src.at[pos].set(src, mode="drop"),
+            seen_seq=app.seen_seq.at[pos].set(seq, mode="drop"),
+            seen_ptr=(app.seen_ptr
+                      + jnp.sum(fresh.astype(I32), dtype=I32)) % b)
+        return app, dup
 
     def glob_init(self, rng):
         return None
@@ -169,7 +211,9 @@ class KbrTestApp:
         # outstanding routed RPC timed out → failed (KBRTestApp counts
         # RPC timeouts as failures, handleRpcTimeout)
         rpc_dead = en & (app.rpc_to < ctx.t_end)
-        ev.count("kbr_rpc_failed", rpc_dead)
+        # gate on the call's send-time measurement bit (tag low bit), like
+        # handleRpcTimeout's getMeasurementPhase() check
+        ev.count("kbr_rpc_failed", rpc_dead & ((app.rpc_nonce % 2) != 0))
         app = dataclasses.replace(
             app,
             rpc_dst=jnp.where(rpc_dead, NO_NODE, app.rpc_dst),
@@ -190,13 +234,22 @@ class KbrTestApp:
             app,
             t_test=jnp.where(en, now + interval_ns, app.t_test),
             seq=app.seq + en.astype(I32))
-        return app2, base.LookupReq(want=want, key=dest_key,
-                                    tag=app.seq * 4 + mode)
+        # tag layout: (seq*4 + mode)*2 + measuring-at-SEND-time.  The low
+        # bit rides through the lookup/route so delivery stats gate on the
+        # send-time measurement phase exactly like the reference's
+        # setMeasurementPhase-at-creation (KBRTestApp.cc:165-202) — a
+        # lookup straddling measurement start can then never count as
+        # delivered-but-not-sent (delivered <= sent is a reference
+        # invariant, KBRTestApp::evaluateData numSent < numDelivered check)
+        return app2, base.LookupReq(
+            want=want, key=dest_key,
+            tag=(app.seq * 4 + mode) * 2 + ctx.measuring.astype(I32))
 
     def on_lookup_done(self, app, done: base.LookupDone, ctx, ob, ev, now,
                        node_idx):
         en = done.en
-        mode = done.tag % 4
+        mode = (done.tag // 2) % 4
+        meas = (done.tag % 2) != 0      # measuring at SEND time (tag bit)
         suc = done.success & (done.results[0] != NO_NODE)
         res = done.results[0]
 
@@ -205,28 +258,29 @@ class KbrTestApp:
         ev.count("kbr_lookup_failed", en_1 & ~suc)
         # hops on the wire = total overlay hops including this final one,
         # so iterative (lookup hops + final hop) and recursive (per-hop
-        # increments) deliveries record identically.
+        # increments) deliveries record identically.  ``c`` carries the
+        # send-time measurement flag; ``a`` the seq tag for receiver dedup.
         ob.send(en_1 & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
-                key=done.target, hops=done.hops + 1,
-                c=ctx.measuring.astype(I32), stamp=done.t0,
+                key=done.target, hops=done.hops + 1, a=done.tag,
+                c=meas.astype(I32), stamp=done.t0,
                 size_b=self.p.test_msg_bytes)
         # lookup ended on ourselves → local delivery
         self_del = en_1 & suc & (res == node_idx)
-        ev.count("kbr_delivered", self_del & ctx.measuring)
-        ev.value("kbr_hopcount", done.hops, self_del & ctx.measuring)
+        ev.count("kbr_delivered", self_del & meas)
+        ev.value("kbr_hopcount", done.hops, self_del & meas)
         ev.value("kbr_latency_s",
                  (now - done.t0).astype(jnp.float32) / NS,
-                 self_del & ctx.measuring)
+                 self_del & meas)
 
         # ---- routed RPC: KbrTestCall to the responsible node -----------
         en_r = en & (mode == M_RPC)
-        ev.count("kbr_rpc_failed", en_r & ~suc)
+        ev.count("kbr_rpc_failed", en_r & ~suc & meas)
         fire_r = en_r & suc & (res != node_idx)
         ob.send(fire_r, now, res, wire.APP_RPC_CALL, key=done.target,
                 a=done.tag, stamp=done.t0, size_b=self.p.test_msg_bytes)
         # resolved to ourselves → trivially successful zero-RTT call
         self_r = en_r & suc & (res == node_idx)
-        ev.count("kbr_rpc_success", self_r & ctx.measuring)
+        ev.count("kbr_rpc_success", self_r & meas)
         app = dataclasses.replace(
             app,
             rpc_dst=jnp.where(fire_r, res, app.rpc_dst),
@@ -242,12 +296,12 @@ class KbrTestApp:
         resk = ctx.keys[jnp.maximum(res, 0)]
         target_alive = ctx.alive[jnp.maximum(res, 0)]
         right = suc & jnp.all(resk == done.target) & target_alive
-        ev.count("kbr_lookup_success", en_l & right & ctx.measuring)
-        ev.count("kbr_lookup_wrong", en_l & suc & ~right & ctx.measuring)
-        ev.count("kbr_lookup_failed", en_l & ~suc)
+        ev.count("kbr_lookup_success", en_l & right & meas)
+        ev.count("kbr_lookup_wrong", en_l & suc & ~right & meas)
+        ev.count("kbr_lookup_failed", en_l & ~suc & meas)
         ev.value("kbr_lookup_latency_s",
                  (now - done.t0).astype(jnp.float32) / NS,
-                 en_l & right & ctx.measuring)
+                 en_l & right & meas)
         return app
 
     def on_lookup_done_batch(self, app, done: base.LookupDone, ctx, ob, ev,
@@ -257,7 +311,8 @@ class KbrTestApp:
         over the L lanes; the at-most-one outstanding routed RPC keeps
         last-fired-wins semantics like the fold did."""
         en = done.en                                   # [L]
-        mode = done.tag % 4
+        mode = (done.tag // 2) % 4
+        meas = (done.tag % 2) != 0      # measuring at SEND time (tag bit)
         suc = done.success & (done.results[:, 0] != NO_NODE)
         res = done.results[:, 0]
 
@@ -265,24 +320,24 @@ class KbrTestApp:
         en_1 = en & (mode == M_ONEWAY)
         ev.count("kbr_lookup_failed", en_1 & ~suc)
         ob.send(en_1 & suc & (res != node_idx), now, res, wire.APP_ONEWAY,
-                key=done.target, hops=done.hops + 1,
-                c=ctx.measuring.astype(I32), stamp=done.t0,
+                key=done.target, hops=done.hops + 1, a=done.tag,
+                c=meas.astype(I32), stamp=done.t0,
                 size_b=self.p.test_msg_bytes)
         self_del = en_1 & suc & (res == node_idx)
-        ev.count("kbr_delivered", self_del & ctx.measuring)
-        ev.value("kbr_hopcount", done.hops, self_del & ctx.measuring)
+        ev.count("kbr_delivered", self_del & meas)
+        ev.value("kbr_hopcount", done.hops, self_del & meas)
         ev.value("kbr_latency_s",
                  (now - done.t0).astype(jnp.float32) / NS,
-                 self_del & ctx.measuring)
+                 self_del & meas)
 
         # ---- routed RPC: KbrTestCall to the responsible node -----------
         en_r = en & (mode == M_RPC)
-        ev.count("kbr_rpc_failed", en_r & ~suc)
+        ev.count("kbr_rpc_failed", en_r & ~suc & meas)
         fire_r = en_r & suc & (res != node_idx)
         ob.send(fire_r, now, res, wire.APP_RPC_CALL, key=done.target,
                 a=done.tag, stamp=done.t0, size_b=self.p.test_msg_bytes)
         self_r = en_r & suc & (res == node_idx)
-        ev.count("kbr_rpc_success", self_r & ctx.measuring)
+        ev.count("kbr_rpc_success", self_r & meas)
         # one outstanding call per node: the LAST fired lane wins (the
         # sequential fold's later where() overwrote earlier ones)
         l_dim = en.shape[0]
@@ -302,12 +357,12 @@ class KbrTestApp:
         resk = ctx.keys[jnp.maximum(res, 0)]
         target_alive = ctx.alive[jnp.maximum(res, 0)]
         right = suc & jnp.all(resk == done.target, axis=-1) & target_alive
-        ev.count("kbr_lookup_success", en_l & right & ctx.measuring)
-        ev.count("kbr_lookup_wrong", en_l & suc & ~right & ctx.measuring)
-        ev.count("kbr_lookup_failed", en_l & ~suc)
+        ev.count("kbr_lookup_success", en_l & right & meas)
+        ev.count("kbr_lookup_wrong", en_l & suc & ~right & meas)
+        ev.count("kbr_lookup_failed", en_l & ~suc & meas)
         ev.value("kbr_lookup_latency_s",
                  (now - done.t0).astype(jnp.float32) / NS,
-                 en_l & right & ctx.measuring)
+                 en_l & right & meas)
         return app
 
     def on_msgs(self, app, msgs, ctx, ob, ev, is_sib, node_idx=None):
@@ -318,6 +373,12 @@ class KbrTestApp:
         response check)."""
         v = msgs.valid
         en = v & (msgs.kind == wire.APP_ONEWAY)
+        if self.buf:
+            # duplicate screen BEFORE any accounting (checkSeen early
+            # return, KBRTestApp.cc:390-399) — the recursive ACK/reroute
+            # path can deliver the same payload twice
+            app, dup = self._check_seen(app, msgs.src, msgs.a, en)
+            en = en & ~dup
         good = en & is_sib & (msgs.c != 0)
         ev.count("kbr_delivered", good)
         ev.count("kbr_wrong_node", en & ~is_sib & (msgs.c != 0))
@@ -346,11 +407,16 @@ class KbrTestApp:
         en = v & (msgs.kind == wire.APP_RPC_RES) & (
             (msgs.src == app.rpc_dst) | (app.rpc_dst == ANY_NODE)) & (
             msgs.a == app.rpc_nonce)
+        # one success per call even if the reroute path duplicated the
+        # request and both responses land in this batch (nonce matching
+        # in the reference consumes the RPC state on the first response)
+        en = en & (jnp.cumsum(en.astype(I32)) == 1)
         hit = jnp.any(en)
-        ev.count("kbr_rpc_success", en & ctx.measuring)
+        meas_r = (app.rpc_nonce % 2) != 0   # call's send-time phase bit
+        ev.count("kbr_rpc_success", en & meas_r)
         ev.value("kbr_rpc_rtt_s",
                  (msgs.t_deliver - msgs.stamp).astype(jnp.float32) / NS,
-                 en & ctx.measuring)
+                 en & meas_r)
         app = dataclasses.replace(
             app,
             rpc_dst=jnp.where(hit, NO_NODE, app.rpc_dst),
@@ -363,9 +429,13 @@ class KbrTestApp:
         return app
 
     def on_msg(self, app, m, ctx, ob, ev, is_sib):
-        """KBRTestApp::deliver — seqnum dedup is subsumed by exactly-once
-        pool delivery; wrong-node check mirrors KBRTestApp.cc:252-286."""
+        """KBRTestApp::deliver — (src, seq) dedup under recursive routing
+        (checkSeen ring); wrong-node check mirrors KBRTestApp.cc:252-286."""
         en = m.valid & (m.kind == wire.APP_ONEWAY)
+        if self.buf:
+            app, dup = self._check_seen(app, m.src[None], m.a[None],
+                                        en[None])
+            en = en & ~dup[0]
         good = en & is_sib & (m.c != 0)
         ev.count("kbr_delivered", good)
         ev.count("kbr_wrong_node", en & ~is_sib & (m.c != 0))
@@ -383,10 +453,11 @@ class KbrTestApp:
         # same responder (BaseRpc nonce matching, BaseRpc.cc:293)
         en = m.valid & (m.kind == wire.APP_RPC_RES) & (
             m.src == app.rpc_dst) & (m.a == app.rpc_nonce)
-        ev.count("kbr_rpc_success", en & ctx.measuring)
+        meas_r = (app.rpc_nonce % 2) != 0   # call's send-time phase bit
+        ev.count("kbr_rpc_success", en & meas_r)
         ev.value("kbr_rpc_rtt_s",
                  (m.t_deliver - m.stamp).astype(jnp.float32) / NS,
-                 en & ctx.measuring)
+                 en & meas_r)
         app = dataclasses.replace(
             app,
             rpc_dst=jnp.where(en, NO_NODE, app.rpc_dst),

@@ -167,6 +167,12 @@ class ChordLogic:
         # response transport follows the call's routingType)
         if rcfg is not None and getattr(self.app, "rcfg", "no") is None:
             self.app.rcfg = rcfg
+        # overlay->distance for the DHT maintenance responsibility
+        # filter: Chord responsibility is CLOCKWISE distance key→node
+        # (successor-of-key holds it; Chord::distance, Chord.cc:1403)
+        if getattr(self.app, "dist_fn", "no") is None:
+            self.app.dist_fn = (
+                lambda nk, rk: K.ring_distance(rk, nk, spec))
         self.mp = mparams
         self.ncs = ncs_params
         self.ncp = nc_params

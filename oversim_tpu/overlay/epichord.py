@@ -133,6 +133,11 @@ class EpiChordLogic:
         self.p = params
         self.lcfg = lcfg or lk_mod.LookupConfig(merge=True)
         self.app = app or KbrTestApp()
+        # EpiChord responsibility: clockwise successor-of-key holds it
+        # (chord-family; see chord.py dist_fn note)
+        if getattr(self.app, "dist_fn", "no") is None:
+            self.app.dist_fn = (
+                lambda nk, rk: K.ring_distance(rk, nk, spec))
         # static table: max_key >> o for the slice bounds
         self._shifted_max = jnp.stack(
             [K.shr_const(K.max_key(spec), o, spec)

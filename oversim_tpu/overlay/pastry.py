@@ -138,6 +138,16 @@ class PastryLogic:
         self.lcfg = lcfg or lk_mod.LookupConfig()
         self.rcfg = rt_mod.RouteConfig(route_acks=params.route_acks)
         self.app = app or KbrTestApp()
+        if getattr(self.app, "rcfg", None) is None:
+            # Pastry routes semi-recursively by default: the app must
+            # know (for reply transport + the deliver dedup ring,
+            # apps/kbrtest.py KbrTestApp.buf)
+            self.app.rcfg = self.rcfg
+        # Pastry responsibility = numeric closeness on the ring
+        # (BasePastry::distance, KeyDiffMetric)
+        if getattr(self.app, "dist_fn", "no") is None:
+            self.app.dist_fn = (
+                lambda nk, rk: K.bidir_ring_distance(nk, rk, spec))
 
     # -- engine interface ---------------------------------------------------
 

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it answers; leave a flag file when up.
+# Each probe is bounded; the loop runs until success or 6h.
+FLAG=/tmp/tpu_up.flag
+rm -f "$FLAG"
+for i in $(seq 1 240); do
+  if timeout 90 python -c "
+import sys
+sys.modules['zstandard'] = None
+import jax
+d = jax.devices()[0]
+import jax.numpy as jnp
+jnp.zeros(()).block_until_ready()
+print(d.platform, d)
+" > /tmp/tpu_probe_out.txt 2>&1; then
+    date > "$FLAG"
+    cat /tmp/tpu_probe_out.txt >> "$FLAG"
+    echo "tpu up at attempt $i"
+    exit 0
+  fi
+  sleep 60
+done
+echo "tpu never came up"
+exit 1

@@ -20,9 +20,15 @@ sys.modules["zstandard"] = None
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in flags:
+    # XLA-CPU at -O0 both COMPILES ~40% faster and RUNS ~30% faster on
+    # this suite's tiny-N graphs (measured: chord N=16 compile 86->49s,
+    # 64 ticks 78->54s on the 1-core box) — the suite is compile-bound
+    # (SURVEY §4 strategy; VERDICT r3 weak #3)
+    flags += (" --xla_backend_optimization_level=0"
+              " --xla_llvm_disable_expensive_passes=true")
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402  (import after env setup)
 
