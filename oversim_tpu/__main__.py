@@ -69,7 +69,15 @@ def main(argv=None) -> int:
                          "OMNeT++-format .sca file")
     ap.add_argument("--vector-interval", type=float, default=10.0,
                     help="sampling period for --output-vectors (sim s)")
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (e.g. cpu); default keeps "
+                         "the ambient backend (the TPU tunnel when present)")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        sys.modules.setdefault("zstandard", None)
+        import jax
+        jax.config.update("jax_platforms", args.platform)
 
     from oversim_tpu.config.ini import IniFile
     from oversim_tpu.config.scenario import build_simulation

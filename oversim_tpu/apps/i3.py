@@ -266,8 +266,14 @@ class I3App:
         # delivery at the trigger owner
         en = m.valid & (m.kind == wire.I3_DELIVER)
         glob: I3Global = ctx.glob
-        # truly ours? (misdelivery = anycast matched a foreign trigger)
-        mine = m.a == wire_id(glob, m.dst)
+        # truly ours? an anycast delivery is legitimate when the packet
+        # id shares >= min_prefix_bits with OUR trigger id (longest-
+        # prefix semantics, I3.h findClosestMatch) — an exact-match test
+        # would count every anycast completion as misdelivered
+        xor_o = jnp.bitwise_xor(m.a, wire_id(glob, m.dst)).astype(
+            jnp.uint32)
+        plo = jnp.where(xor_o == 0, 32, jax.lax.clz(xor_o).astype(I32))
+        mine = plo >= p.min_prefix_bits
         ev.count("i3_misdelivered", en & ~mine & ctx.measuring)
         en = en & mine
         ev.count("i3_delivered", en & ctx.measuring)

@@ -1,0 +1,241 @@
+"""TierStack — generic multi-tier app composition (the ITier equivalent).
+
+The reference stacks up to three tier applications over any overlay via
+string config (``tier1Type/tier2Type/tier3Type``,
+SimpleOverlayHost.ned:14-100, default.ini:622-628); each tier speaks the
+Common API downward.  Here a :class:`TierStack` is itself an app object
+(apps/base.py interface) delegating to an ordered tuple of member apps,
+so ANY overlay logic hosts any combination without per-combo wiring
+(config/scenario.py's former special cases).
+
+Mechanics:
+
+  * state/glob are tuples of the members' states/globs (pytrees);
+  * inbound messages go to every member in order — apps already filter
+    by their own wire kinds;
+  * lookups multiplex on the tag: ``tag' = tag * T + tier`` — each
+    completion dispatches back to its owning tier; route_policy /
+    on_route_fired follow the same encoding;
+  * one lookup request per node per window (the engine app contract):
+    when several tiers' timers are due in one window, the earliest-due
+    tier fires and the others keep their timers — the engine's event
+    horizon re-fires them next tick (delay ≤ one window);
+  * optional hooks (forward/on_update/on_tick/on_msgs/route_policy)
+    exist on the stack only if some member has them, preserving the
+    overlays' hasattr-probing zero-cost-when-absent convention.
+
+Stat names must be disjoint across members (they are for the shipped
+apps; stacking two instances of the same app needs distinct prefixes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+
+I32 = jnp.int32
+T_INF = jnp.int64(2**62)
+NO_NODE = jnp.int32(-1)
+
+
+class TierStack:
+    """Composite tier app (interface: apps/base.py docstring)."""
+
+    def __init__(self, apps):
+        if not apps:
+            raise ValueError("TierStack needs at least one app")
+        self.apps = tuple(apps)
+        # optional hooks mirror the members (hasattr probing)
+        if any(hasattr(a, "on_msgs") for a in self.apps):
+            self.on_msgs = self._on_msgs
+        if any(hasattr(a, "forward") for a in self.apps):
+            self.forward = self._forward
+        if any(hasattr(a, "on_update") for a in self.apps):
+            self.on_update = self._on_update
+        if any(hasattr(a, "on_tick") for a in self.apps):
+            self.on_tick = self._on_tick
+        if any(hasattr(a, "route_policy") for a in self.apps):
+            self.route_policy = self._route_policy
+            self.on_route_fired = self._on_route_fired
+        names = [n for a in self.apps for n in a.stat_spec()["counters"]]
+        if len(names) != len(set(names)):
+            raise ValueError("stacked apps must have disjoint stat names")
+
+    # rcfg pass-through: overlays patch ``app.rcfg`` — fan out
+    @property
+    def rcfg(self):
+        return getattr(self.apps[0], "rcfg", None)
+
+    @rcfg.setter
+    def rcfg(self, value):
+        for a in self.apps:
+            if hasattr(a, "rcfg"):
+                a.rcfg = value
+
+    def stat_spec(self):
+        out = dict(scalars=(), hists=(), counters=())
+        for a in self.apps:
+            s = a.stat_spec()
+            out["scalars"] += tuple(s["scalars"])
+            out["hists"] += tuple(s["hists"])
+            out["counters"] += tuple(s["counters"])
+        return out
+
+    @property
+    def hist_map(self):
+        out = {}
+        for a in self.apps:
+            out.update(a.hist_map)
+        return out
+
+    def _ctx(self, ctx, i):
+        """Member view of the tick context: its own glob slice."""
+        if isinstance(ctx.glob, tuple):
+            return dataclasses.replace(ctx, glob=ctx.glob[i])
+        return ctx
+
+    def init(self, n: int):
+        return tuple(a.init(n) for a in self.apps)
+
+    def glob_init(self, rng):
+        rngs = jax.random.split(rng, len(self.apps))
+        return tuple(a.glob_init(r) for a, r in zip(self.apps, rngs))
+
+    def post_step(self, ctx, states, globs, events):
+        outs = [a.post_step(self._ctx(ctx, i), s, g, events)
+                for i, (a, s, g) in enumerate(zip(self.apps, states,
+                                                  globs))]
+        return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+    def on_ready(self, states, en, now, rng):
+        rngs = jax.random.split(rng, len(self.apps))
+        return tuple(a.on_ready(s, en, now, r)
+                     for a, s, r in zip(self.apps, states, rngs))
+
+    def on_stop(self, states, en):
+        return tuple(a.on_stop(s, en)
+                     for a, s in zip(self.apps, states))
+
+    def on_leave(self, states, en, ctx, ob, ev, now, node_idx, handover):
+        return tuple(a.on_leave(s, en, self._ctx(ctx, i), ob, ev, now,
+                                node_idx, handover)
+                     for i, (a, s) in enumerate(zip(self.apps, states)))
+
+    def next_event(self, states):
+        t = self.apps[0].next_event(states[0])
+        for a, s in zip(self.apps[1:], states[1:]):
+            t = jnp.minimum(t, a.next_event(s))
+        return t
+
+    # -- timers: earliest-due tier fires this window ---------------------
+
+    def on_timer(self, states, en, ctx, now, rng, ev, node_idx):
+        T = len(self.apps)
+        rngs = jax.random.split(rng, T)
+        nevs = jnp.stack([a.next_event(s)
+                          for a, s in zip(self.apps, states)])
+        pick = jnp.argmin(nevs).astype(I32)
+        new_states = []
+        want = jnp.bool_(False)
+        key = None
+        tag = jnp.int32(0)
+        for i, (a, s, r) in enumerate(zip(self.apps, states, rngs)):
+            en_i = en & (pick == i)
+            s2, req = a.on_timer(s, en_i, self._ctx(ctx, i), now, r, ev,
+                                 node_idx)
+            new_states.append(s2)
+            fire_i = req.want & en_i
+            key = req.key if key is None else jnp.where(fire_i, req.key,
+                                                        key)
+            tag = jnp.where(fire_i, req.tag * T + i, tag)
+            want = want | fire_i
+        return tuple(new_states), base.LookupReq(want=want, key=key,
+                                                 tag=tag)
+
+    def on_lookup_done(self, states, done, ctx, ob, ev, now, node_idx):
+        T = len(self.apps)
+        tier = done.tag % T
+        inner = dataclasses.replace(done, tag=done.tag // T)
+        return tuple(
+            a.on_lookup_done(
+                s, dataclasses.replace(inner, en=done.en & (tier == i)),
+                self._ctx(ctx, i), ob, ev, now, node_idx)
+            for i, (a, s) in enumerate(zip(self.apps, states)))
+
+    # -- messages ---------------------------------------------------------
+
+    def on_msg(self, states, m, ctx, ob, ev, is_sib):
+        return tuple(a.on_msg(s, m, self._ctx(ctx, i), ob, ev, is_sib)
+                     for i, (a, s) in enumerate(zip(self.apps, states)))
+
+    def _on_msgs(self, states, msgs, ctx, ob, ev, is_sib, node_idx=None):
+        import inspect
+        out = []
+        for i, (a, s) in enumerate(zip(self.apps, states)):
+            ctx_i = self._ctx(ctx, i)
+            if hasattr(a, "on_msgs"):
+                # signature-probe for the optional node_idx kwarg (a
+                # try/except around the CALL would swallow genuine
+                # TypeErrors and replay the handler's Outbox sends)
+                params = inspect.signature(a.on_msgs).parameters
+                if "node_idx" in params:
+                    s = a.on_msgs(s, msgs, ctx_i, ob, ev, is_sib,
+                                  node_idx=node_idx)
+                else:
+                    s = a.on_msgs(s, msgs, ctx_i, ob, ev, is_sib)
+            else:
+                for r in range(msgs.valid.shape[0]):
+                    s = a.on_msg(s, msgs.slot(r), ctx_i, ob, ev,
+                                 is_sib[r])
+            out.append(s)
+        return tuple(out)
+
+    # -- optional hooks (installed in __init__ when any member has them) --
+
+    def _forward(self, states, msgs, ctx):
+        veto = jnp.zeros_like(msgs.valid)
+        for i, (a, s) in enumerate(zip(self.apps, states)):
+            if hasattr(a, "forward"):
+                veto = veto | a.forward(s, msgs, self._ctx(ctx, i))
+        return veto
+
+    def _on_update(self, states, en, ctx, ob, ev, now, node_idx, added):
+        return tuple(
+            a.on_update(s, en, self._ctx(ctx, i), ob, ev, now, node_idx,
+                        added)
+            if hasattr(a, "on_update") else s
+            for i, (a, s) in enumerate(zip(self.apps, states)))
+
+    def _on_tick(self, states, ctx, ob, ev, node_idx):
+        return tuple(
+            a.on_tick(s, self._ctx(ctx, i), ob, ev, node_idx)
+            if hasattr(a, "on_tick") else s
+            for i, (a, s) in enumerate(zip(self.apps, states)))
+
+    def _route_policy(self, tag):
+        T = len(self.apps)
+        tier = tag % T
+        routable = jnp.bool_(False)
+        inner = jnp.int32(0)
+        is_rpc = jnp.bool_(False)
+        for i, a in enumerate(self.apps):
+            if not hasattr(a, "route_policy"):
+                continue
+            r_i, k_i, rpc_i = a.route_policy(tag // T)
+            hit = tier == i
+            routable = jnp.where(hit, r_i, routable)
+            inner = jnp.where(hit, k_i, inner)
+            is_rpc = jnp.where(hit, rpc_i, is_rpc)
+        return routable, inner, is_rpc
+
+    def _on_route_fired(self, states, fired, now, tag):
+        T = len(self.apps)
+        tier = tag % T
+        return tuple(
+            a.on_route_fired(s, fired & (tier == i), now, tag // T)
+            if hasattr(a, "on_route_fired") else s
+            for i, (a, s) in enumerate(zip(self.apps, states)))

@@ -97,6 +97,16 @@ class LookupConfig:
     verify_siblings: bool = False
     rpc_timeout_ns: int = RPC_TIMEOUT_NS
     deadline_ns: int = LOOKUP_TIMEOUT_NS
+    # PROX_AWARE_ITERATIVE_ROUTING (CommonMessages.msg:140 — declared
+    # but never implemented in the reference; this is the rebuild's
+    # implementation): among the ``prox_window`` closest unqueried
+    # frontier candidates, the next FindNode RPC goes to the one with
+    # the best NeighborCache RTT estimate (getProx semantics,
+    # NeighborCache.cc) instead of strictly the closest — trading a few
+    # extra hops for lower per-hop latency.  Requires the overlay to
+    # pass ``prox_fn`` to pump().
+    prox_aware: bool = False
+    prox_window: int = 3
     # opaque per-lookup extension words threaded through every FindNode
     # round trip (reference: message-attached state like Koorde's
     # KoordeFindNodeExtMessage routeKey/step, Koorde.cc findDeBruijnHop).
@@ -629,7 +639,7 @@ def on_pongs(lk: LookupState, msgs, cfg: LookupConfig):
 
 def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
          cfg: LookupConfig, *, num_siblings: int = 1,
-         num_redundant: int = 1, timeout_fn=None):
+         num_redundant: int = 1, timeout_fn=None, prox_fn=None):
     """Fire FindNodeCalls for every active slot with free RPC capacity
     (up to R in flight); re-send timed-out RPCs with retries left;
     exhausted slots complete (as failed, or — exhaustive mode — with
@@ -696,7 +706,23 @@ def pump(lk: LookupState, outbox, ctx, node_idx, now, rng,
         cand_ok = cand_ok & ~_visited_mask(visited, frontier) & (
             frontier != node_idx)
         has_cand = jnp.any(cand_ok, axis=1)
-        first = jnp.argmax(cand_ok, axis=1).astype(I32)
+        if cfg.prox_aware and prox_fn is not None:
+            # PROX_AWARE_ITERATIVE: within the prox_window closest
+            # eligible candidates, query the lowest-RTT one (unknown
+            # RTTs rank behind known ones but ahead of out-of-window)
+            rank = jnp.cumsum(cand_ok.astype(I32), axis=1) - 1
+            in_win = cand_ok & (rank < cfg.prox_window)
+            rtt = prox_fn(frontier)                       # [L, F] f32 s
+            # unknown RTTs rank behind EVERY measured one (sentinel far
+            # above any achievable RTT, not a mid-range placeholder)
+            rtt = jnp.where(rtt > 0, rtt, 1e3)
+            # stable tiny distance-order bias so equal RTTs keep the
+            # closest-first order
+            rtt = rtt + jnp.arange(f, dtype=jnp.float32) * 1e-6
+            first = jnp.argmin(
+                jnp.where(in_win, rtt, jnp.inf), axis=1).astype(I32)
+        else:
+            first = jnp.argmax(cand_ok, axis=1).astype(I32)
         cand = jnp.take_along_axis(frontier, first[:, None], axis=1)[:, 0]
         prov = jnp.take_along_axis(lk.fr_src, first[:, None], axis=1)[:, 0]
 

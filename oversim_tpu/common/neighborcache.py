@@ -187,6 +187,22 @@ def feed_response_rtts(nc: NcState, rtt_src, rtt_s, now, ok) -> NcState:
     return NcState(**row)
 
 
+def prox_fn(nc: NcState):
+    """Per-candidate RTT-estimate callback for lookup.pump's
+    PROX_AWARE_ITERATIVE candidate pick (NeighborCache::getProx,
+    NeighborCache.cc:577 semantics: last-known mean RTT, -1 unknown).
+    ``nc`` is this node's slice; input [L, F] slots → [L, F] f32 s."""
+    def fn(cands):
+        row = dict(peer=nc.peer, rtt_mean=nc.rtt_mean,
+                   rtt_var=nc.rtt_var, last=nc.last, live=nc.live)
+
+        def one(cnd):
+            rtt, _alive = get_prox(row, cnd)
+            return rtt
+        return jax.vmap(jax.vmap(one))(cands)
+    return fn
+
+
 def adaptive_timeout_fn(nc: NcState, default_ns: int):
     """Per-destination RPC timeout callback for lookup.pump
     (optimizeTimeouts, BaseRpc.cc:197-205 → getNodeTimeout,

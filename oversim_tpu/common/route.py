@@ -68,6 +68,13 @@ class RouteConfig:
     #            path (BaseOverlay.cc:888-908 visited recording)
     mode: str = "semi"
     record_route: bool = False  # recordRoute param (BaseOverlay.cc:137)
+    # head words of the wire ``nodes`` field reserved for an overlay
+    # routing extension that travels WITH the routed message (the
+    # reference attaches overlay ext messages to BaseRouteMessage, e.g.
+    # KoordeFindNodeExtMessage routeKey/step — Koorde.cc:293-358); the
+    # visited list occupies nodes[ext_words:].  0 for stateless-per-hop
+    # overlays (Chord, Kademlia, Pastry, EpiChord).
+    ext_words: int = 0
 
     @property
     def records_visited(self) -> bool:
@@ -328,15 +335,22 @@ def reply(ob, cfg: RouteConfig, en, now, msgs, ctx, node_idx, inner_kind,
     """
     if key is None:
         key = msgs.key
+    ew = cfg.ext_words
     if cfg.mode == "full":
-        vis0 = jnp.full(msgs.nodes.shape, NO_NODE, I32).at[:, 0].set(
+        # fresh route back to the originator's nodeId: the ext head (if
+        # any) starts zeroed so the first hop lazily initializes it, and
+        # the visited list starts at [self]
+        vis0 = jnp.full(msgs.nodes.shape, NO_NODE, I32).at[:, ew].set(
             node_idx)
+        if ew:
+            vis0 = vis0.at[:, :ew].set(0)
         ob.send(en, now, node_idx, wire.KBR_ROUTE,
                 key=ctx.keys[jnp.maximum(msgs.src, 0)], nonce=0,
                 hops=0, a=a, d=inner_kind, nodes=vis0, stamp=stamp,
                 size_b=size_b + cfg.overhead_b)
     elif cfg.mode == "source":
-        sroute_send(ob, en, now, path=msgs.nodes, responder=node_idx,
+        sroute_send(ob, en, now, path=msgs.nodes[:, ew:],
+                    responder=node_idx,
                     inner=inner_kind, key=key, a=a, hops=0, stamp=stamp,
                     size_b=size_b, overhead_b=cfg.overhead_b)
     else:
@@ -443,3 +457,132 @@ def drop_slot(rt: RouteState, slot: int, en):
 
 def next_event(rt: RouteState):
     return jnp.min(jnp.where(rt.active, rt.t_to, T_INF))
+
+
+# ---------------------------------------------------------------------------
+# shared wiring helpers: the three blocks every recursive overlay needs.
+# Chord (and via inheritance Koorde) carries an inline copy of the same
+# logic grown before these helpers existed (chord.py step); new overlays
+# (EpiChord, Broose) wire these directly — the overlay only supplies its
+# own findNode results.
+# ---------------------------------------------------------------------------
+
+
+def prepass(rt: RouteState, ob, msgs, res_b, sib_b, ready, node_idx,
+            cfg: RouteConfig, forward_veto=None):
+    """Inbound recursive-route pre-pass over an [R] inbox batch
+    (BaseOverlay.cc:1441-1581): consume ACKs, pop source-routed replies,
+    ACK + forward-or-decapsulate KBR_ROUTE messages using the overlay's
+    batched findNode results ``res_b`` [R, RMAX] / ``sib_b`` [R].
+
+    Returns (rt', msgs', drop_count) — ``msgs'`` has routed payloads
+    decapsulated (kind := d, src := originator) and consumed wrapper
+    lanes invalidated, ready for the overlay's normal dispatch.  With
+    ``cfg.ext_words`` set, nodes is partitioned [ext | visited] and the
+    responder's updated ext is taken from res_b's tail (the packing
+    _respond_find-style responders use)."""
+    v_r = msgs.valid
+    now_r = msgs.t_deliver
+    rmax = msgs.nodes.shape[-1]
+    ew = cfg.ext_words
+
+    rt = on_acks(rt, dataclasses.replace(
+        msgs, valid=v_r & (msgs.kind == wire.KBR_ROUTE_ACK)))
+
+    en_sro = v_r & (msgs.kind == wire.KBR_SROUTE)
+    deliver_sr = sroute_step(ob, msgs)
+    msgs = dataclasses.replace(
+        msgs,
+        kind=jnp.where(deliver_sr, msgs.d, msgs.kind),
+        src=jnp.where(deliver_sr, msgs.c, msgs.src),
+        valid=v_r & (~en_sro | deliver_sr))
+    v_r = msgs.valid
+
+    en_rt = v_r & (msgs.kind == wire.KBR_ROUTE) & ready
+    ob.send(en_rt & (msgs.nonce > 0), now_r, msgs.src,
+            wire.KBR_ROUTE_ACK, nonce=msgs.nonce,
+            size_b=wire.BASE_CALL_B)
+    deliver_rt = en_rt & sib_b
+    if ew:
+        vis_in = msgs.nodes[:, ew:]
+        cands = res_b.at[:, rmax - ew:].set(NO_NODE)
+    else:
+        vis_in = msgs.nodes
+        cands = res_b
+    nxt_v, found_v = jax.vmap(
+        pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
+        cands, vis_in, msgs.src, vis_in[:, 0], node_idx, sib_b)
+    fwd = en_rt & ~sib_b & found_v & (msgs.hops < cfg.hop_max)
+    if forward_veto is not None:
+        fwd = fwd & ~forward_veto(msgs)
+    visited2 = append_visited(vis_in, node_idx, fwd)
+    if ew:
+        nodes_out = jnp.concatenate(
+            [res_b[:, rmax - ew:], visited2], axis=1)
+    else:
+        nodes_out = visited2
+    rt = forward_batch(
+        rt, ob, fwd, now_r, nxt_v, key=msgs.key, inner=msgs.d,
+        a=msgs.a, b=msgs.b, c=msgs.c, hops=msgs.hops + 1,
+        stamp=msgs.stamp, size_b=msgs.size_b - cfg.overhead_b,
+        visited=nodes_out, cfg=cfg)
+    drop = jnp.sum((en_rt & ~sib_b & ~fwd).astype(jnp.int32))
+    msgs = dataclasses.replace(
+        msgs,
+        kind=jnp.where(deliver_rt, msgs.d, msgs.kind),
+        src=jnp.where(deliver_rt, msgs.nodes[:, ew], msgs.src),
+        valid=v_r & (~en_rt | deliver_rt))
+    return rt, msgs, drop
+
+
+def originate(rt: RouteState, ob, app_obj, app_state, req, next_hop,
+              is_sib, have_slot, now, node_idx, rmax: int,
+              cfg: RouteConfig, measuring, ext0=None):
+    """Originator-side recursive data path for an app LookupReq (the
+    sendToKey recursive branch at the source): payloads the app declares
+    routable leave as KBR_ROUTE via ``next_hop``; everything else stays
+    with the caller (the iterative engine).  ``ext0`` optionally seeds
+    the routing-ext head with the originator's initialized ext (zeroed
+    otherwise → the first hop lazily initializes).
+
+    Returns (rt', app_state', route_fire, start_iterative)."""
+    routable, inner_a, is_rpc = app_obj.route_policy(req.tag)
+    route_fire = req.want & ~is_sib & routable & (next_hop != NO_NODE)
+    ew = cfg.ext_words
+    vis0 = jnp.full((rmax,), NO_NODE, jnp.int32).at[ew].set(node_idx)
+    if ew:
+        vis0 = vis0.at[:ew].set(0 if ext0 is None
+                                else jnp.asarray(ext0, jnp.int32))
+    rt = forward(rt, ob, route_fire, now, next_hop, key=req.key,
+                 inner=inner_a, a=req.tag, b=jnp.int32(0),
+                 c=measuring.astype(jnp.int32), hops=jnp.int32(1),
+                 stamp=now, size_b=jnp.int32(100), visited=vis0,
+                 cfg=cfg)
+    if hasattr(app_obj, "on_route_fired"):
+        app_state = app_obj.on_route_fired(
+            app_state, route_fire & is_rpc, now, req.tag)
+    start_iter = (req.want & ~is_sib & ~routable & have_slot
+                  & (next_hop != NO_NODE))
+    return rt, app_state, route_fire, start_iter
+
+
+def reroute(rt: RouteState, ob, res_q, sib_q, rt_failed, rt_retry, now,
+            node_idx, cfg: RouteConfig):
+    """Timeout reroute pass: re-send parked messages around failed hops
+    using fresh findNode results ``res_q`` [Q, C] / ``sib_q`` [Q] over
+    the parked keys (internalHandleRpcTimeout, BaseOverlay.cc:1697-1729).
+    A node that became responsible meanwhile self-forwards.  Returns
+    (rt', give_up_count)."""
+    ew = cfg.ext_words
+    if res_q.ndim == 1:
+        res_q = res_q[:, None]
+    nxt_q, found_q = jax.vmap(
+        pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
+        res_q, rt.visited[:, ew:], rt_failed,
+        rt.visited[:, ew], node_idx, sib_q)
+    nxt_fin = jnp.where(sib_q, node_idx, nxt_q)
+    ok_q = rt_retry & (sib_q | found_q)
+    rt = reforward_batch(rt, ob, ok_q, now, nxt_fin, cfg)
+    give_up = rt_retry & ~ok_q
+    rt = drop_slots(rt, give_up)
+    return rt, jnp.sum(give_up.astype(jnp.int32))

@@ -99,25 +99,22 @@ def build_underlay(ini: IniFile, config: str):
     return params, underlay_mod
 
 
-def build_app(ini: IniFile, config: str, spec: K.KeySpec, trace=None):
-    """tier1Type/tier2Type string → app object (reference default.ini:622-628
-    module-type plugin selection).  ``trace`` is an optional
-    trace.TraceWorkload for trace-driven DHT runs."""
-    t1 = str(_value(ini.get("**.tier1Type", config), ""))
-    t2 = str(_value(ini.get("**.tier2Type", config), ""))
-    if "DHT" in t1 or "DHTTestApp" in t2 or trace is not None:
-        from oversim_tpu.apps.dht import DhtApp, DhtParams
-        return DhtApp(DhtParams(
-            num_replica=int(_get(ini, config, "tier1.dht.numReplica", 4)),
-            num_get_requests=int(_get(
-                ini, config, "tier1.dht.numGetRequests", 4)),
-            ratio_identical=float(_get(
-                ini, config, "tier1.dht.ratioIdentical", 0.5)),
-            test_interval=float(_get(
-                ini, config, "tier2.dhtTestApp.testInterval", 60.0)),
-            test_ttl=float(_get(
-                ini, config, "tier2.dhtTestApp.testTtl", 300.0)),
-        ), spec, trace=trace)
+def _build_dht(ini, config, spec, trace):
+    from oversim_tpu.apps.dht import DhtApp, DhtParams
+    return DhtApp(DhtParams(
+        num_replica=int(_get(ini, config, "tier1.dht.numReplica", 4)),
+        num_get_requests=int(_get(
+            ini, config, "tier1.dht.numGetRequests", 4)),
+        ratio_identical=float(_get(
+            ini, config, "tier1.dht.ratioIdentical", 0.5)),
+        test_interval=float(_get(
+            ini, config, "tier2.dhtTestApp.testInterval", 60.0)),
+        test_ttl=float(_get(
+            ini, config, "tier2.dhtTestApp.testTtl", 300.0)),
+    ), spec, trace=trace)
+
+
+def _build_kbrtest(ini, config, spec, trace):
     from oversim_tpu.apps.kbrtest import KbrTestApp
     return KbrTestApp(kbrtest.KbrTestParams(
         test_interval=float(_get(
@@ -131,6 +128,109 @@ def build_app(ini: IniFile, config: str, spec: K.KeySpec, trace=None):
         lookup_test=bool(_get(
             ini, config, "tier1.kbrTestApp.kbrLookupTest", False)),
     ))
+
+
+def _build_scribe(ini, config, spec, trace):
+    from oversim_tpu.apps.scribe import ScribeApp, ScribeParams
+    return ScribeApp(ScribeParams(
+        num_groups=int(_get(ini, config, "tier2.almTest.groupNum", 4)),
+    ), spec)
+
+
+def _build_simmud(ini, config, spec, trace):
+    from oversim_tpu.apps.simmud import SimMudApp, SimMudParams
+    return SimMudApp(SimMudParams(), spec)
+
+
+def _build_i3(ini, config, spec, trace):
+    from oversim_tpu.apps.i3 import I3App
+    return I3App(spec=spec)
+
+
+def _build_p2pns(ini, config, spec, trace):
+    from oversim_tpu.apps.p2pns import P2pnsApp
+    return P2pnsApp(spec=spec)
+
+
+def _build_ntree_app(ini, config, spec, trace):
+    from oversim_tpu.apps.ntree import NTreeApp
+    return NTreeApp(spec=spec)
+
+
+def _build_broadcast(ini, config, spec, trace):
+    from oversim_tpu.apps.broadcast import BroadcastTestApp
+    return BroadcastTestApp()
+
+
+def _build_dummy(ini, config, spec, trace):
+    from oversim_tpu.apps.dummy import TierDummyApp
+    return TierDummyApp()
+
+
+# substring → factory; ordered (first match wins); entries absorbing a
+# second tier list the partner substrings to consume
+_TIER_FACTORIES = (
+    ("KBRTestApp", _build_kbrtest, ()),
+    ("DHTTestApp", _build_dht, ("DHT",)),      # tier2 naming the pair
+    ("DHT", _build_dht, ("DHTTestApp",)),      # tier1 DHT + tier2 tester
+    ("SimMud", _build_simmud, ("Scribe",)),
+    ("Scribe", _build_scribe, ("ALMTest",)),
+    ("ALMTest", _build_scribe, ("Scribe",)),
+    ("I3", _build_i3, ()),
+    ("P2pns", _build_p2pns, ()),
+    ("P2PNS", _build_p2pns, ()),
+    ("NTree", _build_ntree_app, ()),
+    ("Broadcast", _build_broadcast, ()),
+    ("TierDummy", _build_dummy, ()),
+    ("MyApplication", _build_dummy, ()),
+)
+
+
+def build_app(ini: IniFile, config: str, spec: K.KeySpec, trace=None):
+    """tier1Type/tier2Type/tier3Type strings → app object (reference
+    default.ini:622-628 ITier plugin selection, SimpleOverlayHost.ned:
+    14-100).  Multiple distinct tier apps compose into a generic
+    :class:`~oversim_tpu.apps.stack.TierStack`; pairs the rebuild fuses
+    into one object (DHT+DHTTestApp, Scribe+ALMTest) count as one tier.
+    ``trace`` is an optional trace.TraceWorkload for trace-driven DHT
+    runs (forces a DHT tier like the reference's trace manager)."""
+    tiers = [str(_value(ini.get(f"**.tier{i}Type", config), ""))
+             for i in (1, 2, 3)]
+    # pre-scan ALL tiers before absorbing: the reference orders fused
+    # pairs both ways (tier1 DHT + tier2 DHTTestApp, but tier1 Scribe +
+    # tier2 SimMud), so first-match-wins in tier order would build both
+    # halves of a pair
+    matched = []
+    for tname in tiers:
+        if not tname or tname in ("\"\"",):
+            continue
+        for sub, factory, absorbs in _TIER_FACTORIES:
+            if sub in tname:
+                matched.append((sub, factory, absorbs))
+                break
+        # XmlRpcInterface (tier3) is the host-side gateway surface
+        # (xmlrpcif.py over gateway.py), not an in-sim tier — ignored
+        # here like the reference's GUI-only modules
+    # fused pairs hitting the same factory collapse to one instance
+    uniq, seen_fac = [], set()
+    for sub, factory, absorbs in matched:
+        if factory not in seen_fac:
+            uniq.append((sub, factory, absorbs))
+            seen_fac.add(factory)
+    # an entry another surviving entry absorbs is that entry's lower
+    # half (Scribe under SimMud) — drop it
+    apps = [factory(ini, config, spec, trace)
+            for sub, factory, absorbs in uniq
+            if not any(sub in o[2] for o in uniq if o[1] is not factory)]
+    if trace is not None and not any(
+            type(a).__name__ == "DhtApp" for a in apps):
+        apps.insert(0, _build_dht(ini, config, spec, trace))
+    if not apps:
+        return _build_kbrtest(ini, config, spec, trace)
+    if len(apps) == 1:
+        return apps[0]
+    from oversim_tpu.apps.stack import TierStack
+    return TierStack(apps)
 
 
 def build_malicious(ini: IniFile, config: str):
@@ -154,6 +254,8 @@ def build_lookup_config(ini: IniFile, config: str, proto: str,
     ns = f"overlay.{proto}"
     paths = int(_get(ini, config, f"{ns}.lookupParallelPaths", 1))
     rpcs = int(_get(ini, config, f"{ns}.lookupParallelRpcs", 1))
+    rt = str(_value(ini.get("**.routingType", config),
+                    "iterative")).strip('"')
     return lk_mod.LookupConfig(
         merge=bool(_get(ini, config, f"{ns}.lookupMerge", merge_default)),
         # reference tracks paths as separate objects sharing one visited
@@ -165,9 +267,10 @@ def build_lookup_config(ini: IniFile, config: str, proto: str,
         # (lookupFailedNodeRpcs is the unrelated failed-node-notice
         # bool) — `lookupRetries` is this framework's ini extension
         retries=int(_get(ini, config, f"{ns}.lookupRetries", 0)),
-        exhaustive=str(_value(
-            ini.get(f"**.routingType", config), "iterative")
-            ).strip('"') == "exhaustive-iterative",
+        exhaustive=rt == "exhaustive-iterative",
+        # PROX_AWARE_ITERATIVE_ROUTING (CommonMessages.msg:140; enum-only
+        # in the reference — implemented here, lookup.py prox_aware)
+        prox_aware=rt == "prox-aware-iterative",
         rpc_timeout_ns=int(float(_value(
             ini.get("**.rpcUdpTimeout", config), 1.5)) * 1e9),
     )
@@ -353,6 +456,28 @@ def build_simulation(ini: IniFile, config: str = "General",
                 ini, config, "overlay.nice.peerTimeoutHeartbeats", 3.0)),
         )
         logic = NiceLogic(spec, params)
+    elif "quon" in overlay_type.lower():
+        from oversim_tpu.overlay.quon import QuonLogic, QuonParams
+        params = QuonParams(
+            aoi=float(_get(ini, config, "overlay.quon.AOIWidth", 100.0)),
+        )
+        logic = QuonLogic(spec, params)
+    elif "vast" in overlay_type.lower():
+        from oversim_tpu.overlay.vast import VastLogic, VastParams
+        params = VastParams(
+            aoi=float(_get(ini, config, "overlay.vast.AOIWidth", 100.0)),
+        )
+        logic = VastLogic(spec, params)
+    elif "ntree" in overlay_type.lower():
+        # NTree runs as a tier app over a KBR overlay here (rendezvous-
+        # hashed cell leadership; apps/ntree.py docstring) — the
+        # reference's NTreeModules overlay maps to Chord + NTreeApp
+        from oversim_tpu.apps.ntree import NTreeApp, NTreeParams
+        from oversim_tpu.overlay.chord import ChordLogic
+        ap = NTreeApp(NTreeParams(
+            max_children=int(_value(
+                ini.get("**.maxChildren", config), 5))), spec=spec)
+        logic = ChordLogic(spec, app=ap)
     elif "pubsub" in overlay_type.lower():
         from oversim_tpu.overlay.pubsubmmog import (PubSubMMOGLogic,
                                                     PubSubParams)

@@ -424,6 +424,13 @@ def sort_by_distance(dist, payload, num_keys: int | None = None):
     sorted_dist carries only the comparator lanes (no caller consumes
     it — every call site takes ``[1]``); pass num_keys for the exact
     full-width sort with all lanes returned.
+
+    GUARD for future call sites: the compressed default is only exact
+    for high-entropy distances (uniform random keys).  A caller sorting
+    STRUCTURED or low-entropy distances — e.g. keys sharing long
+    prefixes by construction, or distances clamped to a small range —
+    must pass ``num_keys=dist.shape[-1]`` explicitly or ordering ties
+    in the top 64 bits resolve arbitrarily with no warning.
     """
     kl = dist.shape[-1]
     if num_keys is None:

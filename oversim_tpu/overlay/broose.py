@@ -63,6 +63,7 @@ from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import route as rt_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -145,6 +146,7 @@ class BrooseState:
     ping_dst: jnp.ndarray   # [N, PP] i32
     ping_to: jnp.ndarray    # [N, PP] i64
     lk: lk_mod.LookupState
+    rr: object              # rt_mod.RouteState — recursive-routing hook
     app: object
     app_glob: object
 
@@ -155,7 +157,12 @@ class BrooseLogic:
     def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
                  params: BrooseParams = BrooseParams(),
                  lcfg: lk_mod.LookupConfig | None = None,
-                 app=None):
+                 app=None,
+                 rcfg: rt_mod.RouteConfig | None = None):
+        """``rcfg`` switches the app data path to the recursive family
+        like chord.py; the shift-routing ext (routeKey/step/flags/last,
+        Broose.cc:622-668) rides the head of the routed message's nodes
+        field (rcfg.ext_words is forced to match the lookup ext)."""
         self.key_spec = spec
         self.p = params
         ew = spec.lanes + 3
@@ -164,7 +171,16 @@ class BrooseLogic:
             raise ValueError("Broose needs ext_words == key lanes + 3")
         if params.shifting_bits > spec.top_lane_bits:
             raise ValueError("shiftingBits must fit in the top key lane")
+        if rcfg is not None and rcfg.ext_words != ew:
+            rcfg = dataclasses.replace(rcfg, ext_words=ew)
+        self.rcfg = rcfg
         self.app = app or KbrTestApp()
+        if rcfg is not None:
+            app_rcfg = getattr(self.app, "rcfg", "no")
+            if app_rcfg is None or (app_rcfg not in ("no",)
+                                    and app_rcfg.ext_words != ew):
+                # hand the ext-corrected config to the app's reply path
+                self.app.rcfg = rcfg
         # static: keyLength rounded down to a shifting_bits multiple
         self.max_dist = spec.bits - spec.bits % params.shifting_bits
 
@@ -177,7 +193,7 @@ class BrooseLogic:
             hists=tuple(app["hists"]),
             counters=tuple(app["counters"]) + (
                 "broose_joins", "broose_join_retries", "lookup_success",
-                "lookup_failed"),
+                "lookup_failed", "route_dropped"),
         )
 
     def split(self, st: BrooseState):
@@ -215,6 +231,9 @@ class BrooseLogic:
             ping_to=jnp.full((n, p.ping_slots), T_INF, I64),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
+            rr=jax.vmap(lambda _: rt_mod.init(
+                self.rcfg or rt_mod.RouteConfig(), self.key_spec.lanes,
+                16))(jnp.arange(n)),
             app=self.app.init(n),
             app_glob=self.app.glob_init(rng),
         )
@@ -245,6 +264,8 @@ class BrooseLogic:
         t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
                                      T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        if self.rcfg is not None:
+            t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
 
     # -- bucket machinery ---------------------------------------------------
@@ -559,6 +580,27 @@ class BrooseLogic:
         retries_cnt = jnp.int32(0)
         anyfail_cnt = jnp.int32(0)
         lksucc_cnt = jnp.int32(0)
+        routedrop_cnt = jnp.int32(0)
+
+        me_key_pre = ctx.keys[node_idx]
+        # recursive-route pre-pass (shared helpers, common/route.py):
+        # each hop runs the shift-routing evaluation with the ext carried
+        # in the head of the routed message's nodes field
+        if self.rcfg is not None:
+            def _route_find(mm_key, mm_nodes):
+                res, sib, ext_out, ok, _ = self._eval_find(
+                    ctx, st, me_key_pre, node_idx, mm_key,
+                    mm_nodes[:ew], rmax)
+                return jnp.where(sib, res,
+                                 res.at[rmax - ew:].set(ext_out)), sib
+            res_rt, sib_rt = jax.vmap(_route_find)(msgs.key, msgs.nodes)
+            veto = ((lambda mm: self.app.forward(st.app, mm, ctx))
+                    if hasattr(self.app, "forward") else None)
+            new_rr, msgs, drop = rt_mod.prepass(
+                st.rr, ob, msgs, res_rt, sib_rt,
+                st.state >= BSET, node_idx, self.rcfg, forward_veto=veto)
+            st = dataclasses.replace(st, rr=new_rr)
+            routedrop_cnt += drop
 
         # ------------------------------------------------------- inbox -----
         for r in range(msgs.valid.shape[0]):
@@ -816,8 +858,18 @@ class BrooseLogic:
         local = req.want & sib_a
         res_local = seed_a[:lcfg.frontier]
         slot, have = lk_mod.free_slot(st.lk)
-        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
-        insta_fail = req.want & ~sib_a & ~start_app
+        if self.rcfg is not None and hasattr(self.app, "route_policy"):
+            # routable payloads leave recursively, seeded with the
+            # origination eval's initialized ext
+            new_rr, new_app, route_fire, start_app = rt_mod.originate(
+                st.rr, ob, self.app, st.app, req, seed_a[0], sib_a, have,
+                now_a, node_idx, rmax, self.rcfg, ctx.measuring,
+                ext0=ext_a)
+            st = dataclasses.replace(st, rr=new_rr, app=new_app)
+        else:
+            route_fire = jnp.bool_(False)
+            start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app & ~route_fire
         st = dataclasses.replace(st, app=self.app.on_lookup_done(
             st.app, app_base.LookupDone(
                 en=local | insta_fail, success=local, tag=req.tag,
@@ -833,6 +885,29 @@ class BrooseLogic:
         new_lk, failed_nodes, _ = lk_mod.on_timeouts(st.lk, t_end, t0, lcfg)
         st = dataclasses.replace(st, lk=new_lk)
         st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes)
+
+        # route-hop ACK timeouts → bucket removal + reroute.  The new
+        # next hop comes from re-running the shift-routing eval over the
+        # parked key + parked ext; the RE-SENT message still carries the
+        # PARKED ext (reforward_batch resends rt.visited verbatim) — the
+        # receiving hop advances it as usual
+        if self.rcfg is not None:
+            new_rr, rt_failed, rt_retry = rt_mod.on_timeouts(
+                st.rr, t_end, self.rcfg)
+            st = dataclasses.replace(st, rr=new_rr)
+            st = self._handle_failed(ctx, st, me_key, node_idx, rt_failed)
+
+            def _reroute_find(kk, vv):
+                res, sib, _ext, ok, _ = self._eval_find(
+                    ctx, st, me_key, node_idx, kk, vv[:ew], rmax)
+                return res, sib
+            res_q, sib_q = jax.vmap(_reroute_find)(st.rr.key,
+                                                   st.rr.visited)
+            new_rr, drop_q = rt_mod.reroute(
+                st.rr, ob, res_q, sib_q, rt_failed, rt_retry, t0,
+                node_idx, self.rcfg)
+            st = dataclasses.replace(st, rr=new_rr)
+            routedrop_cnt += drop_q
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
@@ -877,6 +952,7 @@ class BrooseLogic:
             "c:broose_join_retries": retries_cnt,
             "c:lookup_success": lksucc_cnt,
             "c:lookup_failed": anyfail_cnt,
+            "c:route_dropped": routedrop_cnt,
             "s:lookup_hops": comp_hops_ev,
         }
         ev.finish(events, self.app.hist_map)

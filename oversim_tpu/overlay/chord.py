@@ -499,9 +499,21 @@ class ChordLogic:
                     wire.KBR_ROUTE_ACK, nonce=msgs.nonce,
                     size_b=wire.BASE_CALL_B)
             deliver_rt = en_rt & sib_b
+            # overlay routing ext (Koorde routeKey/step) rides the head
+            # of msgs.nodes; the visited list occupies the tail.  The
+            # responder writes its updated ext into res_b's tail (the
+            # same packing _respond_find uses for FINDNODE_RES), which
+            # must be masked out of the next-hop candidate scan.
+            ew = rcfg.ext_words
+            if ew:
+                vis_in = msgs.nodes[:, ew:]
+                cands = res_b.at[:, rmax - ew:].set(NO_NODE)
+            else:
+                vis_in = msgs.nodes
+                cands = res_b
             nxt_v, found_v = jax.vmap(
                 rt_mod.pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
-                res_b, msgs.nodes, msgs.src, msgs.nodes[:, 0], node_idx,
+                cands, vis_in, msgs.src, vis_in[:, 0], node_idx,
                 sib_b)
             fwd = en_rt & ~sib_b & found_v & (msgs.hops < rcfg.hop_max)
             if hasattr(self.app, "forward"):
@@ -515,12 +527,17 @@ class ChordLogic:
             # back to last-hop-only loop detection in semi/full —
             # recording always makes pick_next_hop's visited check real
             # in every mode for a few wire bytes; pastry.py does the same)
-            visited2 = rt_mod.append_visited(msgs.nodes, node_idx, fwd)
+            visited2 = rt_mod.append_visited(vis_in, node_idx, fwd)
+            if ew:
+                nodes_out = jnp.concatenate(
+                    [res_b[:, rmax - ew:], visited2], axis=1)
+            else:
+                nodes_out = visited2
             st = dataclasses.replace(st, rr=rt_mod.forward_batch(
                 st.rr, ob, fwd, now_r, nxt_v, key=msgs.key, inner=msgs.d,
                 a=msgs.a, b=msgs.b, c=msgs.c, hops=msgs.hops + 1,
                 stamp=msgs.stamp, size_b=msgs.size_b - rcfg.overhead_b,
-                visited=visited2, cfg=rcfg))
+                visited=nodes_out, cfg=rcfg))
             routedrop_cnt += jnp.sum((en_rt & ~sib_b & ~fwd).astype(I32))
             # decapsulate at the responsible node: the payload kind takes
             # over and src becomes the originator; handlers below (incl.
@@ -529,7 +546,7 @@ class ChordLogic:
             msgs = dataclasses.replace(
                 msgs,
                 kind=jnp.where(deliver_rt, msgs.d, msgs.kind),
-                src=jnp.where(deliver_rt, msgs.nodes[:, 0], msgs.src),
+                src=jnp.where(deliver_rt, msgs.nodes[:, ew], msgs.src),
                 valid=v_r & (~en_rt | deliver_rt))
             v_r = msgs.valid
 
@@ -1044,7 +1061,12 @@ class ChordLogic:
             # has its lookups diverted.
             routable, inner_a, is_rpc = self.app.route_policy(req.tag)
             route_fire = req.want & ~sib_a & routable & (nxt_a != NO_NODE)
-            vis0 = jnp.full((rmax,), NO_NODE, I32).at[0].set(node_idx)
+            ew0 = self.rcfg.ext_words
+            vis0 = jnp.full((rmax,), NO_NODE, I32).at[ew0].set(node_idx)
+            if ew0:
+                # zeroed ext head → the first hop lazily initializes the
+                # overlay routing ext (Koorde findDeBruijnHop init path)
+                vis0 = vis0.at[:ew0].set(0)
             st = dataclasses.replace(st, rr=rt_mod.forward(
                 st.rr, ob, route_fire, now_a, nxt_a, key=req.key,
                 inner=inner_a, a=req.tag, b=jnp.int32(0),
@@ -1114,13 +1136,14 @@ class ChordLogic:
         # responsible for a parked key meanwhile self-forwards so the
         # message still delivers (pastry.py does the same).
         if self.rcfg is not None:
+            ew_q = self.rcfg.ext_words
             nxt_q, sib_q = jax.vmap(
                 lambda kk: self._find_node(ctx, st, me_key, node_idx, kk))(
                 st.rr.key)
             nxt_q2, found_q = jax.vmap(
                 rt_mod.pick_next_hop, in_axes=(0, 0, 0, 0, None, 0))(
-                nxt_q[:, None], st.rr.visited, rt_failed,
-                st.rr.visited[:, 0], node_idx, sib_q)
+                nxt_q[:, None], st.rr.visited[:, ew_q:], rt_failed,
+                st.rr.visited[:, ew_q], node_idx, sib_q)
             nxt_fin = jnp.where(sib_q, node_idx, nxt_q2)
             ok_q = rt_retry & (sib_q | found_q)
             st = dataclasses.replace(st, rr=rt_mod.reforward_batch(
@@ -1233,7 +1256,8 @@ class ChordLogic:
         new_lk, _ = lk_mod.pump(
             st.lk, ob, ctx, node_idx, t0, rngs[4], lcfg,
             timeout_fn=nc_mod.adaptive_timeout_fn(st.nc,
-                                                  lcfg.rpc_timeout_ns))
+                                                  lcfg.rpc_timeout_ns),
+            prox_fn=(nc_mod.prox_fn(st.nc) if lcfg.prox_aware else None))
         st = dataclasses.replace(st, lk=new_lk)
 
         # Common API update() (BaseOverlay::callUpdate → BaseApp::update,

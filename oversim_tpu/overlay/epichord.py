@@ -64,6 +64,7 @@ from oversim_tpu import stats as stats_mod
 from oversim_tpu.apps import base as app_base
 from oversim_tpu.apps.kbrtest import KbrTestApp
 from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.common import route as rt_mod
 from oversim_tpu.common import wire
 from oversim_tpu.core import keys as K
 from oversim_tpu.engine.logic import Outbox, select_tree
@@ -118,6 +119,7 @@ class EpiChordState:
     check_ctr: jnp.ndarray    # [N] i32
     slice_cursor: jnp.ndarray  # [N] i32 — round-robin deficient slice
     lk: lk_mod.LookupState
+    rr: object                # rt_mod.RouteState — recursive-routing hook
     app: object
     app_glob: object
 
@@ -128,11 +130,20 @@ class EpiChordLogic:
     def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
                  params: EpiChordParams = EpiChordParams(),
                  lcfg: lk_mod.LookupConfig | None = None,
-                 app=None):
+                 app=None,
+                 rcfg: rt_mod.RouteConfig | None = None):
+        """``rcfg`` switches the app data path to the recursive family
+        (semi/full/source), exactly like chord.py — the generic
+        sendToKey machinery serves every overlay in the reference
+        (BaseOverlay.cc:1367-1581); wired via common/route.py's shared
+        prepass/originate/reroute helpers."""
         self.key_spec = spec
         self.p = params
         self.lcfg = lcfg or lk_mod.LookupConfig(merge=True)
         self.app = app or KbrTestApp()
+        self.rcfg = rcfg
+        if rcfg is not None and getattr(self.app, "rcfg", "no") is None:
+            self.app.rcfg = rcfg
         # EpiChord responsibility: clockwise successor-of-key holds it
         # (chord-family; see chord.py dist_fn note)
         if getattr(self.app, "dist_fn", "no") is None:
@@ -152,7 +163,7 @@ class EpiChordLogic:
             hists=tuple(app["hists"]),
             counters=tuple(app["counters"]) + (
                 "epi_joins", "epi_slice_lookups", "lookup_success",
-                "lookup_failed"),
+                "lookup_failed", "route_dropped"),
         )
 
     def split(self, st: EpiChordState):
@@ -181,6 +192,9 @@ class EpiChordLogic:
             slice_cursor=jnp.zeros((n,), I32),
             lk=jax.vmap(lambda _: lk_mod.init(self.lcfg, self.key_spec.lanes))(
                 jnp.arange(n)),
+            rr=jax.vmap(lambda _: rt_mod.init(
+                self.rcfg or rt_mod.RouteConfig(), self.key_spec.lanes,
+                16))(jnp.arange(n)),
             app=self.app.init(n),
             app_glob=self.app.glob_init(rng),
         )
@@ -210,6 +224,8 @@ class EpiChordLogic:
         t = jnp.minimum(t, jnp.where(ready, self.app.next_event(st.app),
                                      T_INF))
         t = jnp.minimum(t, jax.vmap(lk_mod.next_event)(st.lk))
+        if self.rcfg is not None:
+            t = jnp.minimum(t, jax.vmap(rt_mod.next_event)(st.rr))
         return t
 
     # -- neighbor lists + cache ---------------------------------------------
@@ -411,6 +427,23 @@ class EpiChordLogic:
             out = jnp.full((rmax,), NO_NODE, I32)
             k = min(vec.shape[0], rmax)
             return out.at[:k].set(vec[:k])
+
+        routedrop_cnt = jnp.int32(0)
+        # recursive-route pre-pass (shared helpers, common/route.py):
+        # forward-or-decapsulate KBR_ROUTE wrappers BEFORE the per-slot
+        # dispatch below, driven by this overlay's own findNode
+        if self.rcfg is not None:
+            res_rt, sib_rt = jax.vmap(
+                lambda kk, ss: self._find_node(ctx, st, me_key, node_idx,
+                                               kk, rmax, ss))(
+                msgs.key, msgs.src)
+            veto = ((lambda mm: self.app.forward(st.app, mm, ctx))
+                    if hasattr(self.app, "forward") else None)
+            new_rr, msgs, drop = rt_mod.prepass(
+                st.rr, ob, msgs, res_rt, sib_rt, st.state == READY,
+                node_idx, self.rcfg, forward_veto=veto)
+            st = dataclasses.replace(st, rr=new_rr)
+            routedrop_cnt += drop
 
         # ------------------------------------------------------- inbox -----
         for r in range(msgs.valid.shape[0]):
@@ -641,8 +674,15 @@ class EpiChordLogic:
         local = req.want & sib_a
         res_local = seed_a[:lcfg.frontier]
         slot, have = lk_mod.free_slot(st.lk)
-        start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
-        insta_fail = req.want & ~sib_a & ~start_app
+        if self.rcfg is not None and hasattr(self.app, "route_policy"):
+            new_rr, new_app, route_fire, start_app = rt_mod.originate(
+                st.rr, ob, self.app, st.app, req, seed_a[0], sib_a, have,
+                now_a, node_idx, rmax, self.rcfg, ctx.measuring)
+            st = dataclasses.replace(st, rr=new_rr, app=new_app)
+        else:
+            route_fire = jnp.bool_(False)
+            start_app = req.want & ~sib_a & have & (seed_a[0] != NO_NODE)
+        insta_fail = req.want & ~sib_a & ~start_app & ~route_fire
         st = dataclasses.replace(st, app=self.app.on_lookup_done(
             st.app, app_base.LookupDone(
                 en=local | insta_fail, success=local, tag=req.tag,
@@ -659,6 +699,23 @@ class EpiChordLogic:
         st = dataclasses.replace(st, lk=new_lk)
         st = self._handle_failed(ctx, st, me_key, node_idx, failed_nodes,
                                  t0)
+
+        # route-hop ACK timeouts → handleFailedNode + reroute parked
+        # messages around the failed hop (shared helper)
+        if self.rcfg is not None:
+            new_rr, rt_failed, rt_retry = rt_mod.on_timeouts(
+                st.rr, t_end, self.rcfg)
+            st = dataclasses.replace(st, rr=new_rr)
+            st = self._handle_failed(ctx, st, me_key, node_idx, rt_failed,
+                                     t0)
+            res_q, sib_q = jax.vmap(
+                lambda kk: self._find_node(ctx, st, me_key, node_idx, kk,
+                                           rmax, NO_NODE))(st.rr.key)
+            new_rr, drop_q = rt_mod.reroute(
+                st.rr, ob, res_q, sib_q, rt_failed, rt_retry, t0,
+                node_idx, self.rcfg)
+            st = dataclasses.replace(st, rr=new_rr)
+            routedrop_cnt += drop_q
 
         # ------------------------------------------------- completions -----
         new_lk, comp = lk_mod.take_completions(st.lk, t_end)
@@ -699,6 +756,7 @@ class EpiChordLogic:
             "c:epi_slice_lookups": slice_cnt,
             "c:lookup_success": lksucc_cnt,
             "c:lookup_failed": anyfail_cnt,
+            "c:route_dropped": routedrop_cnt,
             "s:lookup_hops": comp_hops_ev,
         }
         ev.finish(events, self.app.hist_map)

@@ -1054,7 +1054,9 @@ class KademliaLogic:
                       if p.adaptive_timeouts else None)
         new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[6], lcfg,
                                 num_redundant=p.redundant_nodes,
-                                timeout_fn=timeout_fn)
+                                timeout_fn=timeout_fn,
+                                prox_fn=(nc_mod.prox_fn(st.nc)
+                                         if lcfg.prox_aware else None))
         st = dataclasses.replace(st, lk=new_lk)
 
         # Common API update() (BaseOverlay::callUpdate, BaseOverlay.cc:640

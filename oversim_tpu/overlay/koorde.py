@@ -86,11 +86,24 @@ class KoordeLogic(ChordLogic):
     def __init__(self, spec: K.KeySpec = K.DEFAULT_SPEC,
                  params: KoordeParams = KoordeParams(),
                  lcfg: lk_mod.LookupConfig | None = None,
-                 app=None):
+                 app=None, rcfg=None):
         lcfg = lcfg or lk_mod.LookupConfig(ext_words=spec.lanes + 1)
         if lcfg.ext_words != spec.lanes + 1:
             raise ValueError("Koorde needs ext_words == key lanes + 1")
-        super().__init__(spec, params, lcfg, app)
+        if rcfg is not None:
+            # the de Bruijn routeKey/step ext rides the head of the
+            # routed message's nodes field (KoordeFindNodeExtMessage
+            # attached to BaseRouteMessage in the reference); chord.py's
+            # recursive pre-pass partitions nodes as [ext | visited]
+            import dataclasses as _dc
+            if rcfg.ext_words != lcfg.ext_words:
+                rcfg = _dc.replace(rcfg, ext_words=lcfg.ext_words)
+        super().__init__(spec, params, lcfg, app, rcfg=rcfg)
+        if (rcfg is not None and getattr(self.app, "rcfg", None) is not None
+                and self.app.rcfg.ext_words != rcfg.ext_words):
+            # keep the app's reply-transport config in sync with the
+            # ext-words rewrite above
+            self.app.rcfg = rcfg
 
     def init(self, rng, n: int) -> KoordeState:
         base = super().init(rng, n)

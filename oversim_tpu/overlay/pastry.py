@@ -747,7 +747,9 @@ class PastryLogic:
         timeout_fn = (nc_mod.adaptive_timeout_fn(st.nc, lcfg.rpc_timeout_ns)
                       if p.adaptive_timeouts else None)
         new_lk, _ = lk_mod.pump(st.lk, ob, ctx, node_idx, t0, rngs[0], lcfg,
-                                timeout_fn=timeout_fn)
+                                timeout_fn=timeout_fn,
+                                prox_fn=(nc_mod.prox_fn(st.nc)
+                                         if lcfg.prox_aware else None))
         st = dataclasses.replace(st, lk=new_lk)
 
         # ------------------------------------------------------ events -----
