@@ -83,6 +83,22 @@ class DhtParams:
     num_test_keys: int = 1024
     op_timeout: float = 10.0      # CAPI timeout (lookup+put round)
     mod_test: bool = True         # dhttest_mod_timer (re-put known key)
+    # DHT variants (src/applications/dht/{RepeatedHashing,Symmetric}DHT
+    # .cc — key-derivation wrappers over the base DHT):
+    #   "plain"     — one replica team at the key itself;
+    #   "symmetric" — team t stores at key + t*(max/teams)
+    #                 (SymmetricDHT.cc:44 overlayKeyOffset);
+    #   "repeated"  — team t at an iterated rehash chain of the key
+    #                 (RepeatedHashingDHT.cc:96; the in-graph chain uses
+    #                 a bijective odd-multiplier mix instead of sha1 —
+    #                 same uniform independent placement, documented
+    #                 deviation).
+    # numReplica splits across teams (initializeDHT); teams run
+    # SEQUENTIALLY per op here (one outstanding lookup per node — the
+    # reference fires them in parallel; latency scales by the team
+    # count, placement and durability semantics identical).
+    variant: str = "plain"
+    num_replica_teams: int = 1
 
 
 @jax.tree_util.register_dataclass
@@ -108,7 +124,9 @@ class DhtState:
     op: jnp.ndarray        # [N] i32 OP_*
     op_seq: jnp.ndarray    # [N] i32 — op nonce (stale-completion guard)
     op_g: jnp.ndarray      # [N] i32 oracle slot (G_APPEND = fresh key)
-    op_key: jnp.ndarray    # [N, KL] u32 — the op's key
+    op_key: jnp.ndarray    # [N, KL] u32 — the op's BASE key
+    op_team: jnp.ndarray   # [N] i32 — replica-team cursor (variants)
+    op_cont: jnp.ndarray   # [N] bool — next team's lookup pending
     op_val: jnp.ndarray    # [N] i32 value being put
     op_pending: jnp.ndarray  # [N] i32 replica responses awaited
     op_acks: jnp.ndarray   # [N] i32
@@ -166,6 +184,40 @@ class DhtApp:
         # constructors (chord.py/pastry.py ``app.dist_fn = ...``), the
         # same late-binding convention as ``app.rcfg``.
         self.dist_fn = dist_fn
+        # replica-team machinery (DhtParams.variant docstring)
+        t = max(1, params.num_replica_teams)
+        if params.variant != "plain" and params.num_replica % t:
+            raise ValueError("numReplica must be a multiple of "
+                             "numReplicaTeams (initializeDHT)")
+        if params.variant != "plain" and trace is not None:
+            raise ValueError("trace workloads drive the plain DHT")
+        self.teams = t if params.variant != "plain" else 1
+        self.per_team = params.num_replica // self.teams
+        if params.variant == "symmetric":
+            step = (2 ** spec.bits) // self.teams
+            self._team_off = jnp.stack(
+                [keys_mod.from_int((step * i) % (2 ** spec.bits), spec)
+                 for i in range(self.teams)])
+        elif params.variant == "repeated":
+            import numpy as _np
+            r = _np.random.RandomState(0xD47)
+            consts = r.randint(0, 2 ** 32, size=(self.teams, spec.lanes),
+                               dtype=_np.uint32)
+            consts[0] = 0          # team 0 = the base key itself
+            self._team_mix = jnp.asarray(consts)
+
+    def _team_key(self, base, t):
+        """Team t's wire key for a base key (SymmetricDHT additive
+        offsets / RepeatedHashingDHT rehash chain — the chain here is a
+        bijective lane-rotation + xor mix, see DhtParams.variant)."""
+        p = self.p
+        if self.teams == 1:
+            return base
+        if p.variant == "symmetric":
+            return keys_mod.add(base, self._team_off[t], self.spec)
+        kl = base.shape[-1]
+        rot = base[(jnp.arange(kl) + t) % kl]
+        return jnp.where(t == 0, base, rot ^ self._team_mix[t])
 
     @property
     def dist(self):
@@ -212,6 +264,8 @@ class DhtApp:
             op_seq=jnp.zeros((n,), I32),
             op_g=jnp.zeros((n,), I32),
             op_key=jnp.zeros((n, kl), U32),
+            op_team=jnp.zeros((n,), I32),
+            op_cont=jnp.zeros((n,), bool),
             op_val=jnp.full((n,), NO_VAL, I32),
             op_pending=jnp.zeros((n,), I32),
             op_acks=jnp.zeros((n,), I32),
@@ -293,11 +347,14 @@ class DhtApp:
             app,
             t_test=jnp.where(en, T_INF, app.t_test),
             op=jnp.where(en, OP_NONE, app.op),
+            op_cont=app.op_cont & ~en,
             op_to=jnp.where(en, T_INF, app.op_to),
             mnt_dst=jnp.where(en, NO_NODE, app.mnt_dst))
 
     def next_event(self, app):
         t = jnp.minimum(app.t_test, app.op_to)
+        # a pending next-team lookup fires on the next tick (variants)
+        t = jnp.where(app.op_cont, jnp.int64(0), t)
         # an active maintenance replication pumps every tick until done
         return jnp.where(app.mnt_dst != NO_NODE, jnp.int64(0), t)
 
@@ -386,6 +443,7 @@ class DhtApp:
         app = dataclasses.replace(
             app,
             op=jnp.where(to, OP_NONE, app.op),
+            op_cont=app.op_cont & ~to,
             op_to=jnp.where(to, T_INF, app.op_to))
 
 
@@ -476,13 +534,23 @@ class DhtApp:
             op_seq=jnp.where(any_op, app.seq, app.op_seq),
             op_g=jnp.where(do_put, G_APPEND, jnp.where(any_op, g, app.op_g)),
             op_key=jnp.where(any_op, key, app.op_key),
+            op_team=jnp.where(any_op, 0, app.op_team),
             op_val=jnp.where(put_like, val, app.op_val),
             op_pending=jnp.where(any_op, 0, app.op_pending),
             op_acks=jnp.where(any_op, 0, app.op_acks),
             op_to=jnp.where(any_op, now + jnp.int64(int(p.op_timeout * NS)),
                             app.op_to),
             op_t0=jnp.where(any_op, now, app.op_t0))
-        return app, base.LookupReq(want=any_op, key=key, tag=app.op_seq)
+        # next-team continuation (variants): an active multi-team op
+        # with op_cont set issues its NEXT team's sibling lookup —
+        # mutually exclusive with a fresh op (op != NONE blocks `fire`)
+        cont = en & app.op_cont & (app.op != OP_NONE)
+        if self.teams > 1:
+            ckey = self._team_key(app.op_key, app.op_team)
+            key = jnp.where(cont, ckey, key)
+        app = dataclasses.replace(app, op_cont=app.op_cont & ~cont)
+        return app, base.LookupReq(want=any_op | cont, key=key,
+                                   tag=app.op_seq)
 
     # -- lookup completion → replica fan-out ---------------------------------
 
@@ -504,10 +572,12 @@ class DhtApp:
             op=jnp.where(en & ~suc, OP_NONE, app.op),
             op_to=jnp.where(en & ~suc, T_INF, app.op_to))
 
-        # PUT: DHTPutCall to up to numReplica siblings (DHT.cc:210-237)
+        # PUT: DHTPutCall to up to numReplica siblings (DHT.cc:210-237);
+        # with replica teams, numReplica/numReplicaTeams per team
+        # (initializeDHT)
         is_put = en & suc & (app.op == OP_PUT)
         nrep = jnp.int32(0)
-        for i in range(min(p.num_replica, done.results.shape[0])):
+        for i in range(min(self.per_team, done.results.shape[0])):
             tgt = done.results[i]
             send = is_put & (tgt != NO_NODE)
             # self-replica: store locally via on_msg loopback (send to self
@@ -618,24 +688,37 @@ class DhtApp:
 
         # DHTPutResponse → ack counting; majority = success.  The op
         # nonce echoed in b rejects straggler acks from a timed-out op
-        # (the reference ties CAPI responses to RPC nonces)
+        # (the reference ties CAPI responses to RPC nonces); the key
+        # match rejects a previous TEAM's stragglers (variants)
+        cur_key = (self._team_key(app.op_key, app.op_team)
+                   if self.teams > 1 else app.op_key)
         en = (m.valid & (m.kind == wire.DHT_PUT_RES) & (app.op == OP_PUT)
-              & (m.b == app.op_seq))
+              & (m.b == app.op_seq) & jnp.all(m.key == cur_key))
         acks = app.op_acks + en.astype(I32)
         # a MAJORITY of replica acks completes the put (DHT.cc
         # handlePutResponse: numResponses/numSent > 0.5) — requiring all
         # acks makes every stale replica-set entry a guaranteed failure
         # under churn
-        complete = en & (2 * acks > app.op_pending) & (app.op_pending > 0)
+        team_done = en & (2 * acks > app.op_pending) & (app.op_pending > 0)
+        more = app.op_team + 1 < self.teams
+        complete = team_done & ~more
+        next_team = team_done & more
         ev.count("dht_put_success", complete)
         ev.value("dht_put_latency_s",
                  (now - app.op_t0).astype(jnp.float32) / NS, complete)
         app = self._stage_commit(app, complete)   # truth commit
         app = dataclasses.replace(
             app,
-            op_acks=acks,
+            op_acks=jnp.where(next_team, 0, acks),
+            op_pending=jnp.where(next_team, 0, app.op_pending),
+            op_team=app.op_team + next_team.astype(I32),
+            op_cont=app.op_cont | next_team,
             op=jnp.where(complete, OP_NONE, app.op),
-            op_to=jnp.where(complete, T_INF, app.op_to))
+            # each team round gets a fresh timeout budget (the parallel
+            # reference teams each carry their own CAPI timeout)
+            op_to=jnp.where(complete, T_INF,
+                            jnp.where(next_team, now + jnp.int64(
+                                int(p.op_timeout * NS)), app.op_to)))
 
         # DHTGetCall → storage probe + reply (DHT::handleGetRequest)
         en = m.valid & (m.kind == wire.DHT_GET_CALL)
@@ -653,8 +736,10 @@ class DhtApp:
         # Nonce + key match guard against stale responses completing a
         # newer GET with a mismatched value
         q = p.num_get_requests
+        cur_key = (self._team_key(app.op_key, app.op_team)
+                   if self.teams > 1 else app.op_key)
         en = (m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
-              & (m.b == app.op_seq) & jnp.all(m.key == app.op_key))
+              & (m.b == app.op_seq) & jnp.all(m.key == cur_key))
         slot = jnp.where(en, jnp.clip(app.op_acks, 0, q - 1), q)
         votes = app.op_votes.at[slot].set(m.a, mode="drop")
         n_acks = app.op_acks + en.astype(I32)
@@ -668,7 +753,6 @@ class DhtApp:
         win = en & jnp.any(counts >= need)
         winner = votes[jnp.argmax(counts)]
         exhausted = en & ~win & (n_acks >= app.op_pending)
-        complete = win | exhausted
         # truth-map validation (DHTTestApp::handleGetResponse,
         # DHTTestApp.cc:173-232): slot recycled (ring wrap) maps to the
         # reference's entry==NULL error; expired truth means an empty
@@ -681,9 +765,16 @@ class DhtApp:
         expired = now > ctx.glob.expire[gslot]
         expect = ctx.glob.val[gslot]
         has_val = winner != NO_VAL
-        good = win & slot_ok & jnp.where(expired, ~has_val,
-                                         has_val & (winner == expect))
-        wrong = win & slot_ok & has_val & (expired | (winner != expect))
+        # a live-truth team miss tries the NEXT replica team (variants;
+        # the reference queries all teams in parallel and takes any hit)
+        want_retry = (((win & ~has_val) | exhausted) & slot_ok
+                      & ~expired)
+        retry_team = want_retry & (app.op_team + 1 < self.teams)
+        final = (win | exhausted) & ~retry_team
+        good = final & win & slot_ok & jnp.where(
+            expired, ~has_val, has_val & (winner == expect))
+        wrong = final & win & slot_ok & has_val & (
+            expired | (winner != expect))
         ev.count("dht_get_success", good)
         # wrong-data = a QUORUM winner that mismatches the truth; an
         # exhausted vote (responses in, no ratioIdentical majority) is a
@@ -691,15 +782,21 @@ class DhtApp:
         # false), not wrong data
         ev.count("dht_get_wrong", wrong)
         ev.count("dht_get_notfound",
-                 win & ((slot_ok & ~expired & ~has_val) | ~slot_ok))
+                 final & win & ((slot_ok & ~expired & ~has_val)
+                                | ~slot_ok))
         ev.value("dht_get_latency_s",
                  (now - app.op_t0).astype(jnp.float32) / NS, good)
         app = dataclasses.replace(
             app,
-            op_votes=votes,
-            op_acks=n_acks,
-            op=jnp.where(complete, OP_NONE, app.op),
-            op_to=jnp.where(complete, T_INF, app.op_to))
+            op_votes=jnp.where(retry_team, NO_VAL - 1, votes),
+            op_acks=jnp.where(retry_team, 0, n_acks),
+            op_pending=jnp.where(retry_team, 0, app.op_pending),
+            op_team=app.op_team + retry_team.astype(I32),
+            op_cont=app.op_cont | retry_team,
+            op=jnp.where(final, OP_NONE, app.op),
+            op_to=jnp.where(final, T_INF,
+                            jnp.where(retry_team, now + jnp.int64(
+                                int(p.op_timeout * NS)), app.op_to)))
         return app
 
     @property

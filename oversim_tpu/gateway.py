@@ -173,10 +173,16 @@ class RealtimeGateway:
         a = np.asarray(pool.a)
         b = np.asarray(pool.b)
         c = np.asarray(pool.c)
+        done = []
         for i in hits:
             sid = int(a[i])
             payload = _HDR.pack(EXT_OUT, sid, int(b[i]), int(c[i]))
             sess = self._sessions.get(sid)
+            if sess is not None and sess[0] == "tun":
+                # raw-packet sessions drain via TunBridge.collect_raw —
+                # freeing them here would lose the reply
+                continue
+            done.append(int(i))
             if sess is None:
                 continue
             if sess[0] == "udp":
@@ -192,9 +198,11 @@ class RealtimeGateway:
                             len(payload).to_bytes(4, "big") + payload)
                     except OSError:
                         pass
-        # free the transmitted slots
+        if not done:
+            return
+        # free only the slots actually handled here
         mask = jnp.zeros(pool.valid.shape, bool).at[
-            jnp.asarray(hits, I32)].set(True)
+            jnp.asarray(done, I32)].set(True)
         self.state = dataclasses.replace(
             self.state, pool=pool_mod.free(pool, mask))
 

@@ -1,0 +1,315 @@
+"""SingleHost interop surface: TUN raw-packet path + Zeroconf bootstrap.
+
+Completes the singlehostunderlay depth the gateway's socket bridge
+(gateway.py) leaves out (reference src/underlay/singlehostunderlay/):
+
+  * **TUN packet parsers** (tunoutscheduler.{h,cc} + the
+    *messageparser* family): the reference attaches a TUN device and
+    converts raw IPv4/UDP packets to overlay messages and back.  Here
+    :func:`parse_ipv4_udp` / :func:`build_ipv4_udp` implement the
+    header codec (with real checksums), :class:`TunBridge` couples it
+    to a RealtimeGateway (raw packet in → EXT_IN, EXT_OUT → raw packet
+    out), and :func:`open_tun` attaches a real ``/dev/net/tun`` device
+    when the host allows it (gracefully absent in sandboxes);
+  * **Zeroconf bootstrap** (ZeroconfConnector.h:38-44: the reference
+    publishes the overlay via Avahi mDNS/DNS-SD and browses for
+    bootstrap peers): :class:`ZeroconfDiscovery` speaks actual
+    mDNS-framed DNS-SD — a PTR answer for ``_oversim._udp.local`` with
+    an SRV additional carrying host:port — over the 224.0.0.251:5353
+    multicast group (falling back to loopback when multicast is
+    unavailable), interoperable with standard mDNS browsers for the
+    announce direction.
+
+All host-side Python: this is the real-network interop layer, not the
+TPU compute path (SURVEY.md §2.2 SingleHostUnderlay row).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+
+from oversim_tpu.gateway import EXT_IN, _HDR
+
+# ---------------------------------------------------------------------------
+# IPv4/UDP codec (the TUN message-parser path)
+# ---------------------------------------------------------------------------
+
+_IP_HDR = struct.Struct("!BBHHHBBH4s4s")
+_UDP_HDR = struct.Struct("!HHHH")
+
+
+def _ip_checksum(data: bytes) -> int:
+    if len(data) % 2:
+        data += b"\x00"
+    s = sum(struct.unpack("!%dH" % (len(data) // 2), data))
+    while s >> 16:
+        s = (s & 0xFFFF) + (s >> 16)
+    return ~s & 0xFFFF
+
+
+def parse_ipv4_udp(packet: bytes):
+    """Raw IPv4 packet → (src_ip, src_port, dst_ip, dst_port, payload),
+    or None if not a well-formed IPv4/UDP datagram (the reference's
+    packet parser drops non-UDP traffic the same way)."""
+    if len(packet) < _IP_HDR.size:
+        return None
+    (vihl, _tos, tot_len, _ident, _frag, _ttl, proto, hdr_ck,
+     src, dst) = _IP_HDR.unpack_from(packet)
+    if vihl >> 4 != 4 or proto != 17:      # IPv4, UDP
+        return None
+    ihl = (vihl & 0xF) * 4
+    if ihl < 20 or len(packet) < ihl + _UDP_HDR.size:
+        return None
+    if _ip_checksum(packet[:ihl]) != 0:
+        return None
+    sport, dport, ulen, _uck = _UDP_HDR.unpack_from(packet, ihl)
+    payload = packet[ihl + _UDP_HDR.size: ihl + max(ulen, 8)]
+    return (socket.inet_ntoa(src), sport, socket.inet_ntoa(dst), dport,
+            payload)
+
+
+def build_ipv4_udp(src_ip: str, src_port: int, dst_ip: str,
+                   dst_port: int, payload: bytes, ttl: int = 64) -> bytes:
+    """(addresses, payload) → raw IPv4/UDP packet with valid header
+    checksum (UDP checksum 0 = disabled, RFC 768 legal)."""
+    udp = _UDP_HDR.pack(src_port, dst_port, _UDP_HDR.size + len(payload),
+                        0) + payload
+    tot = _IP_HDR.size + len(udp)
+    hdr = _IP_HDR.pack(0x45, 0, tot, 0, 0, ttl, 17, 0,
+                       socket.inet_aton(src_ip), socket.inet_aton(dst_ip))
+    ck = _ip_checksum(hdr)
+    hdr = hdr[:10] + struct.pack("!H", ck) + hdr[12:]
+    return hdr + udp
+
+
+def open_tun(name: str = "oversim0"):
+    """Attach a real TUN device (TUNSETIFF) — returns the fd, or None
+    when the host forbids it (no /dev/net/tun, no CAP_NET_ADMIN)."""
+    import fcntl
+    import os
+    TUNSETIFF = 0x400454CA
+    IFF_TUN, IFF_NO_PI = 0x0001, 0x1000
+    try:
+        fd = os.open("/dev/net/tun", os.O_RDWR)
+        ifr = struct.pack("16sH", name.encode()[:15], IFF_TUN | IFF_NO_PI)
+        fcntl.ioctl(fd, TUNSETIFF, ifr)
+        return fd
+    except OSError:
+        return None
+
+
+class TunBridge:
+    """Couples the raw-packet codec to a RealtimeGateway: feed raw
+    IPv4/UDP packets in (as a TUN device would deliver them), collect
+    raw reply packets out.  The session table maps overlay replies back
+    to the originating (ip, port) exactly like the gateway's socket
+    sessions."""
+
+    def __init__(self, gateway, local_ip: str = "10.0.0.1",
+                 local_port: int = 4000):
+        self.gw = gateway
+        self.local_ip = local_ip
+        self.local_port = local_port
+        self._tun_sessions: dict = {}    # sid -> (src_ip, src_port)
+
+    def feed_raw(self, packet: bytes) -> bool:
+        """One inbound raw packet → EXT_IN message (True if parsed and
+        addressed to the bridge's ip:port)."""
+        parsed = parse_ipv4_udp(packet)
+        if parsed is None:
+            return False
+        src_ip, src_port, dst_ip, dst_port, payload = parsed
+        if (dst_ip, dst_port) != (self.local_ip, self.local_port):
+            return False
+        if len(payload) < _HDR.size:
+            return False
+        _kind, _a, b, c = _HDR.unpack_from(payload)
+        sid = self.gw._next_session
+        self.gw._next_session += 1
+        self.gw._sessions[sid] = ("tun", (src_ip, src_port))
+        self._tun_sessions[sid] = (src_ip, src_port)
+        self.gw.inject(EXT_IN, a=sid, b=b, c=c)
+        return True
+
+    def collect_raw(self) -> list:
+        """Drain EXT_OUT messages with tun sessions → raw reply packets
+        (the TUN write direction)."""
+        import dataclasses
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from oversim_tpu.engine import pool as pool_mod
+
+        pool = self.gw.state.pool
+        valid = np.asarray(pool.valid)
+        kind = np.asarray(pool.kind)
+        dst = np.asarray(pool.dst)
+        from oversim_tpu.gateway import EXT_OUT
+        hits = np.nonzero(valid & (kind == EXT_OUT)
+                          & (dst == self.gw.gw))[0]
+        a = np.asarray(pool.a)
+        b = np.asarray(pool.b)
+        c = np.asarray(pool.c)
+        out, consumed = [], []
+        for i in hits:
+            sid = int(a[i])
+            sess = self._tun_sessions.get(sid)
+            if sess is None:
+                continue      # a socket session — the gateway drains it
+            payload = _HDR.pack(EXT_OUT, sid, int(b[i]), int(c[i]))
+            out.append(build_ipv4_udp(self.local_ip, self.local_port,
+                                      sess[0], sess[1], payload))
+            consumed.append(int(i))
+        if consumed:
+            mask = jnp.zeros(pool.valid.shape, bool).at[
+                jnp.asarray(consumed, jnp.int32)].set(True)
+            self.gw.state = dataclasses.replace(
+                self.gw.state, pool=pool_mod.free(pool, mask))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Zeroconf / mDNS DNS-SD bootstrap (ZeroconfConnector)
+# ---------------------------------------------------------------------------
+
+MDNS_GROUP = "224.0.0.251"
+MDNS_PORT = 5353
+SERVICE = b"_oversim._udp.local"
+
+
+def _dns_name(labels: bytes) -> bytes:
+    out = b""
+    for part in labels.split(b"."):
+        out += bytes([len(part)]) + part
+    return out + b"\x00"
+
+
+def _skip_name(buf: bytes, off: int) -> int:
+    while off < len(buf):
+        ln = buf[off]
+        if ln == 0:
+            return off + 1
+        if ln & 0xC0:         # compression pointer
+            return off + 2
+        off += 1 + ln
+    return off
+
+
+def build_announce(instance: str, host: str, port: int) -> bytes:
+    """mDNS response frame: PTR answer for the service type plus an SRV
+    additional with the bootstrap endpoint (DNS-SD announce shape)."""
+    inst = _dns_name(instance.encode() + b"." + SERVICE)
+    svc = _dns_name(SERVICE)
+    hdr = struct.pack("!HHHHHH", 0, 0x8400, 0, 1, 0, 1)  # response, 1 an, 1 ar
+    ptr = svc + struct.pack("!HHIH", 12, 0x8001, 120, len(inst)) + inst
+    target = _dns_name(host.encode() + b".local")
+    srv_rd = struct.pack("!HHH", 0, 0, port) + target
+    srv = inst + struct.pack("!HHIH", 33, 0x8001, 120, len(srv_rd)) + srv_rd
+    return hdr + ptr + srv
+
+
+def parse_announce(frame: bytes):
+    """mDNS frame → (instance, host, port) if it announces our service
+    type; None otherwise."""
+    if len(frame) < 12:
+        return None
+    _tid, flags, qd, an, _ns, ar = struct.unpack_from("!HHHHHH", frame)
+    if not flags & 0x8000:
+        return None
+    off = 12
+    for _ in range(qd):
+        off = _skip_name(frame, off) + 4
+    found = None
+    for _ in range(an + ar):
+        name_start = off
+        off = _skip_name(frame, off)
+        if off + 10 > len(frame):
+            return None
+        rtype, _rclass, _ttl, rdlen = struct.unpack_from("!HHIH", frame,
+                                                         off)
+        off += 10
+        rdata = frame[off:off + rdlen]
+        # record names travel label-encoded on the wire — match the
+        # encoded service name, not the dotted string
+        if rtype == 33 and _dns_name(SERVICE) in frame[name_start:off]:
+            if len(rdata) < 7:
+                return None
+            port = struct.unpack_from("!H", rdata, 4)[0]
+            # target name labels up to ".local"
+            labels, p = [], 6
+            while p < len(rdata) and rdata[p]:
+                ln = rdata[p]
+                labels.append(rdata[p + 1:p + 1 + ln].decode(
+                    "ascii", "replace"))
+                p += 1 + ln
+            host = ".".join(labels[:-1]) if len(labels) > 1 else (
+                labels[0] if labels else "")
+            inst_len = frame[name_start]
+            inst = frame[name_start + 1:name_start + 1 + inst_len].decode(
+                "ascii", "replace")
+            found = (inst, host, port)
+        off += rdlen
+    return found
+
+
+class ZeroconfDiscovery:
+    """Announce this node's bootstrap endpoint and browse for peers
+    (ZeroconfConnector.h:38-44 — the reference publishes via Avahi and
+    enqueues discovered peers as bootstrap candidates)."""
+
+    def __init__(self, group: str = MDNS_GROUP, port: int = MDNS_PORT,
+                 iface_ip: str = "127.0.0.1"):
+        self.group, self.port = group, port
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.multicast = True
+        try:
+            self.sock.bind(("", port))
+            mreq = socket.inet_aton(group) + socket.inet_aton(iface_ip)
+            self.sock.setsockopt(socket.IPPROTO_IP,
+                                 socket.IP_ADD_MEMBERSHIP, mreq)
+            self.sock.setsockopt(socket.IPPROTO_IP,
+                                 socket.IP_MULTICAST_LOOP, 1)
+        except OSError:
+            # multicast unavailable (restricted sandbox): plain loopback
+            self.multicast = False
+            self.sock.close()
+            self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self.sock.bind(("127.0.0.1", port))
+        self.sock.setblocking(False)
+
+    def announce(self, instance: str, host: str, port: int):
+        frame = build_announce(instance, host, port)
+        dests = [("127.0.0.1", self.port)]
+        if self.multicast:
+            # group first; the loopback copy covers sandboxes whose
+            # multicast membership binds but never routes
+            dests.insert(0, (self.group, self.port))
+        for dest in dests:
+            try:
+                self.sock.sendto(frame, dest)
+            except OSError:
+                pass
+
+    def browse(self, wait_s: float = 0.2) -> list:
+        """Collect announcements seen within ``wait_s`` →
+        [(instance, host, port)] bootstrap candidates."""
+        deadline = time.time() + wait_s
+        seen = []
+        while time.time() < deadline:
+            try:
+                frame, _addr = self.sock.recvfrom(9000)
+            except (BlockingIOError, OSError):
+                time.sleep(0.01)
+                continue
+            rec = parse_announce(frame)
+            if rec is not None and rec not in seen:
+                seen.append(rec)
+        return seen
+
+    def close(self):
+        self.sock.close()

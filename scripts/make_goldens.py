@@ -20,6 +20,14 @@ sys.modules["zstandard"] = None
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA-CPU -O0: compiles AND runs faster at these tiny-N graph shapes
+# (tests/conftest.py measurements)
+# KEEP IN SYNC: the same -O0 bootstrap lives in tests/conftest.py, __graft_entry__.py and scripts/make_goldens.py
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_backend_optimization_level" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_backend_optimization_level=0"
+        " --xla_llvm_disable_expensive_passes=true").strip()
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-4 sequential work chain: new-module tests → goldens regen →
+# full suite (resumable, per-file isolation).  Designed to run for
+# hours in the background on the single CPU core without contention.
+set -u
+cd /root/repo
+LOG=/tmp/round4_chain.log
+STATE=${SUITE_STATE:-/tmp/suite_logs_r4}
+mkdir -p "$STATE"
+
+echo "=== chain start $(date)" >> "$LOG"
+
+# 0. wait for any running pytest to exit (avoid CPU contention)
+while pgrep -f "python -m pytest" > /dev/null; do sleep 30; done
+
+# 1. new modules first (fail-fast visibility)
+for f in test_dht_variants test_singlehost test_stack test_quon \
+         test_ntree test_simmud test_mesh test_reference_ini; do
+  if [ -f "$STATE/$f.ok" ]; then continue; fi
+  echo "--- $f $(date)" >> "$LOG"
+  if python -m pytest "tests/$f.py" -q > "$STATE/$f.log" 2>&1; then
+    touch "$STATE/$f.ok"
+    echo "PASS $f: $(tail -1 $STATE/$f.log)" >> "$LOG"
+  else
+    echo "FAIL $f: $(tail -3 $STATE/$f.log | head -1)" >> "$LOG"
+  fi
+done
+
+# 2. regenerate parity goldens with the fixed KBR/DHT accounting
+if [ ! -f "$STATE/goldens.ok" ]; then
+  echo "--- goldens $(date)" >> "$LOG"
+  if python scripts/make_goldens.py > "$STATE/goldens.log" 2>&1; then
+    touch "$STATE/goldens.ok"
+    echo "PASS goldens" >> "$LOG"
+  else
+    echo "FAIL goldens: $(tail -2 $STATE/goldens.log | head -1)" >> "$LOG"
+  fi
+fi
+
+# 3. full suite, resumable
+echo "--- full suite $(date)" >> "$LOG"
+SUITE_STATE="$STATE" bash scripts/run_suite.sh >> "$LOG" 2>&1
+echo "=== chain done $(date)" >> "$LOG"

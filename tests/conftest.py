@@ -21,6 +21,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# KEEP IN SYNC: the same -O0 bootstrap lives in tests/conftest.py, __graft_entry__.py and scripts/make_goldens.py
 if "xla_backend_optimization_level" not in flags:
     # XLA-CPU at -O0 both COMPILES ~40% faster and RUNS ~30% faster on
     # this suite's tiny-N graphs (measured: chord N=16 compile 86->49s,

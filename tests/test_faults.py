@@ -27,7 +27,7 @@ def test_partition_and_heal():
                                init_interval=0.5)
     logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=15.0)))
     s = sim_mod.Simulation(logic, cp, up,
-                           sim_mod.EngineParams(window=0.02,
+                           sim_mod.EngineParams(window=0.05,
                                                 transition_time=60.0))
     st = s.init(seed=9)
     # stop well short of the split: run_until overshoots by up to a chunk
@@ -85,7 +85,7 @@ def test_dht_handover_under_churn():
                                             test_ttl=600.0)))
     s = sim_mod.Simulation(logic, cp,
                            engine_params=sim_mod.EngineParams(
-                               window=0.02, transition_time=60.0))
+                               window=0.05, transition_time=60.0))
     st = s.init(seed=4)
     st = s.run_until(st, 700.0, chunk=256)
     out = s.summary(st)
@@ -104,7 +104,7 @@ def test_malicious_sibling_attack_degrades_lookups():
                                init_interval=0.5)
     s = sim_mod.Simulation(logic, cp,
                            engine_params=sim_mod.EngineParams(
-                               window=0.02, transition_time=60.0,
+                               window=0.05, transition_time=60.0,
                                malicious=mp))
     st = s.init(seed=8)
     st = s.run_until(st, 300.0, chunk=256)
@@ -141,7 +141,7 @@ def test_overlay_partition_merge():
                        params=ChordParams(merge_partitions=True,
                                           merge_interval=15.0))
     s = sim_mod.Simulation(logic, cp, up,
-                           sim_mod.EngineParams(window=0.02,
+                           sim_mod.EngineParams(window=0.05,
                                                 transition_time=60.0))
     st = s.init(seed=17)
     st = s.run_until(st, 190.0, chunk=128)

@@ -18,7 +18,7 @@ def _run(n, t_sim, seed=3, **pkw):
     logic = NiceLogic(params=NiceParams(**pkw))
     cp = churn_mod.ChurnParams(model="none", target_num=n,
                                init_interval=0.5)
-    ep = sim_mod.EngineParams(window=0.020, outbox_slots=64,
+    ep = sim_mod.EngineParams(window=0.05, outbox_slots=64,
                               transition_time=40.0, rmax=16)
     s = sim_mod.Simulation(logic, cp, engine_params=ep)
     state = s.init(seed=seed)
@@ -80,7 +80,7 @@ def test_survives_churn():
     logic = NiceLogic(params=NiceParams())
     cp = churn_mod.ChurnParams(model="lifetime", target_num=16,
                                lifetime_mean=120.0, init_interval=0.5)
-    ep = sim_mod.EngineParams(window=0.020, outbox_slots=64,
+    ep = sim_mod.EngineParams(window=0.05, outbox_slots=64,
                               transition_time=40.0, rmax=16)
     s = sim_mod.Simulation(logic, cp, engine_params=ep)
     state = s.init(seed=5)
