@@ -53,6 +53,31 @@ EXT_OUT = 151   # gateway node → real network (same fields echoed)
 _HDR = struct.Struct("!IIII")
 
 
+def drain_ext_out(state, gw_slot: int, handler):
+    """Scan the pool for EXT_OUT messages addressed to ``gw_slot`` and
+    offer each to ``handler(sid, b, c) -> consumed``; free exactly the
+    consumed slots.  The ONE drain implementation shared by the socket
+    gateway and the TUN bridge (their session kinds partition the sid
+    space via the handler predicate)."""
+    pool = state.pool
+    valid = np.asarray(pool.valid)
+    kind = np.asarray(pool.kind)
+    dst = np.asarray(pool.dst)
+    hits = np.nonzero(valid & (kind == EXT_OUT) & (dst == gw_slot))[0]
+    if len(hits) == 0:
+        return state
+    a = np.asarray(pool.a)
+    b = np.asarray(pool.b)
+    c = np.asarray(pool.c)
+    done = [int(i) for i in hits
+            if handler(int(a[i]), int(b[i]), int(c[i]))]
+    if not done:
+        return state
+    mask = jnp.zeros(pool.valid.shape, bool).at[
+        jnp.asarray(done, I32)].set(True)
+    return dataclasses.replace(state, pool=pool_mod.free(pool, mask))
+
+
 class RealtimeGateway:
     """Bridges one simulation node slot to real UDP/TCP sockets."""
 
@@ -162,29 +187,17 @@ class RealtimeGateway:
             self._sessions.pop(sid, None)
 
     def _drain_ext_out(self):
-        """Transmit EXT_OUT messages addressed to the gateway slot."""
-        pool = self.state.pool
-        valid = np.asarray(pool.valid)
-        kind = np.asarray(pool.kind)
-        dst = np.asarray(pool.dst)
-        hits = np.nonzero(valid & (kind == EXT_OUT) & (dst == self.gw))[0]
-        if len(hits) == 0:
-            return
-        a = np.asarray(pool.a)
-        b = np.asarray(pool.b)
-        c = np.asarray(pool.c)
-        done = []
-        for i in hits:
-            sid = int(a[i])
-            payload = _HDR.pack(EXT_OUT, sid, int(b[i]), int(c[i]))
+        """Transmit socket-session EXT_OUT messages (raw-packet/tun
+        sessions drain via TunBridge.collect_raw — the shared
+        :func:`drain_ext_out` frees only what its handler consumed)."""
+
+        def handler(sid, b, c):
+            payload = _HDR.pack(EXT_OUT, sid, b, c)
             sess = self._sessions.get(sid)
             if sess is not None and sess[0] == "tun":
-                # raw-packet sessions drain via TunBridge.collect_raw —
-                # freeing them here would lose the reply
-                continue
-            done.append(int(i))
+                return False          # not ours — leave for the bridge
             if sess is None:
-                continue
+                return True           # orphan: free, nothing to send
             if sess[0] == "udp":
                 try:
                     self.udp.sendto(payload, sess[1])
@@ -198,13 +211,9 @@ class RealtimeGateway:
                             len(payload).to_bytes(4, "big") + payload)
                     except OSError:
                         pass
-        if not done:
-            return
-        # free only the slots actually handled here
-        mask = jnp.zeros(pool.valid.shape, bool).at[
-            jnp.asarray(done, I32)].set(True)
-        self.state = dataclasses.replace(
-            self.state, pool=pool_mod.free(pool, mask))
+            return True
+
+        self.state = drain_ext_out(self.state, self.gw, handler)
 
     # ------------------------------------------------ the loop ---------
     def pump(self, sim_seconds: float = 0.1):

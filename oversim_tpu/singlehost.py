@@ -55,9 +55,14 @@ def parse_ipv4_udp(packet: bytes):
     packet parser drops non-UDP traffic the same way)."""
     if len(packet) < _IP_HDR.size:
         return None
-    (vihl, _tos, tot_len, _ident, _frag, _ttl, proto, hdr_ck,
+    (vihl, _tos, tot_len, _ident, frag, _ttl, proto, hdr_ck,
      src, dst) = _IP_HDR.unpack_from(packet)
     if vihl >> 4 != 4 or proto != 17:      # IPv4, UDP
+        return None
+    if frag & 0x3FFF:
+        # fragmented datagram (MF set or nonzero offset): a non-first
+        # fragment has no UDP header at all — drop like any standard
+        # parser rather than misreading payload bytes as ports
         return None
     ihl = (vihl & 0xF) * 4
     if ihl < 20 or len(packet) < ihl + _UDP_HDR.size:
@@ -113,6 +118,7 @@ class TunBridge:
         self.local_ip = local_ip
         self.local_port = local_port
         self._tun_sessions: dict = {}    # sid -> (src_ip, src_port)
+        self._by_addr: dict = {}         # (src_ip, src_port) -> sid
 
     def feed_raw(self, packet: bytes) -> bool:
         """One inbound raw packet → EXT_IN message (True if parsed and
@@ -126,48 +132,36 @@ class TunBridge:
         if len(payload) < _HDR.size:
             return False
         _kind, _a, b, c = _HDR.unpack_from(payload)
-        sid = self.gw._next_session
-        self.gw._next_session += 1
-        self.gw._sessions[sid] = ("tun", (src_ip, src_port))
-        self._tun_sessions[sid] = (src_ip, src_port)
+        # one session per remote endpoint (reused across packets — an
+        # id per packet would grow the tables without bound)
+        addr = (src_ip, src_port)
+        sid = self._by_addr.get(addr)
+        if sid is None:
+            sid = self.gw._next_session
+            self.gw._next_session += 1
+            self._by_addr[addr] = sid
+            self.gw._sessions[sid] = ("tun", addr)
+            self._tun_sessions[sid] = addr
         self.gw.inject(EXT_IN, a=sid, b=b, c=c)
         return True
 
     def collect_raw(self) -> list:
         """Drain EXT_OUT messages with tun sessions → raw reply packets
-        (the TUN write direction)."""
-        import dataclasses
+        (the TUN write direction; shared drain, gateway.drain_ext_out)."""
+        from oversim_tpu.gateway import EXT_OUT, drain_ext_out
 
-        import jax.numpy as jnp
-        import numpy as np
+        out = []
 
-        from oversim_tpu.engine import pool as pool_mod
-
-        pool = self.gw.state.pool
-        valid = np.asarray(pool.valid)
-        kind = np.asarray(pool.kind)
-        dst = np.asarray(pool.dst)
-        from oversim_tpu.gateway import EXT_OUT
-        hits = np.nonzero(valid & (kind == EXT_OUT)
-                          & (dst == self.gw.gw))[0]
-        a = np.asarray(pool.a)
-        b = np.asarray(pool.b)
-        c = np.asarray(pool.c)
-        out, consumed = [], []
-        for i in hits:
-            sid = int(a[i])
+        def handler(sid, b, c):
             sess = self._tun_sessions.get(sid)
             if sess is None:
-                continue      # a socket session — the gateway drains it
-            payload = _HDR.pack(EXT_OUT, sid, int(b[i]), int(c[i]))
+                return False  # a socket session — the gateway drains it
+            payload = _HDR.pack(EXT_OUT, sid, b, c)
             out.append(build_ipv4_udp(self.local_ip, self.local_port,
                                       sess[0], sess[1], payload))
-            consumed.append(int(i))
-        if consumed:
-            mask = jnp.zeros(pool.valid.shape, bool).at[
-                jnp.asarray(consumed, jnp.int32)].set(True)
-            self.gw.state = dataclasses.replace(
-                self.gw.state, pool=pool_mod.free(pool, mask))
+            return True
+
+        self.gw.state = drain_ext_out(self.gw.state, self.gw.gw, handler)
         return out
 
 
@@ -198,6 +192,27 @@ def _skip_name(buf: bytes, off: int) -> int:
     return off
 
 
+def _read_name(buf: bytes, off: int, depth: int = 0) -> list:
+    """Decode a (possibly compressed, RFC 1035 §4.1.4) DNS name into
+    its label list — real mDNS responders (Avahi, the reference's
+    Zeroconf backend) compress aggressively."""
+    labels = []
+    hops = 0
+    while off < len(buf) and hops < 16:
+        ln = buf[off]
+        if ln == 0:
+            break
+        if ln & 0xC0:
+            if off + 1 >= len(buf):
+                break
+            off = ((ln & 0x3F) << 8) | buf[off + 1]
+            hops += 1
+            continue
+        labels.append(buf[off + 1:off + 1 + ln])
+        off += 1 + ln
+    return labels
+
+
 def build_announce(instance: str, host: str, port: int) -> bytes:
     """mDNS response frame: PTR answer for the service type plus an SRV
     additional with the bootstrap endpoint (DNS-SD announce shape)."""
@@ -222,6 +237,7 @@ def parse_announce(frame: bytes):
     off = 12
     for _ in range(qd):
         off = _skip_name(frame, off) + 4
+    svc_labels = SERVICE.split(b".")
     found = None
     for _ in range(an + ar):
         name_start = off
@@ -231,26 +247,18 @@ def parse_announce(frame: bytes):
         rtype, _rclass, _ttl, rdlen = struct.unpack_from("!HHIH", frame,
                                                          off)
         off += 10
-        rdata = frame[off:off + rdlen]
-        # record names travel label-encoded on the wire — match the
-        # encoded service name, not the dotted string
-        if rtype == 33 and _dns_name(SERVICE) in frame[name_start:off]:
-            if len(rdata) < 7:
-                return None
-            port = struct.unpack_from("!H", rdata, 4)[0]
-            # target name labels up to ".local"
-            labels, p = [], 6
-            while p < len(rdata) and rdata[p]:
-                ln = rdata[p]
-                labels.append(rdata[p + 1:p + 1 + ln].decode(
-                    "ascii", "replace"))
-                p += 1 + ln
-            host = ".".join(labels[:-1]) if len(labels) > 1 else (
-                labels[0] if labels else "")
-            inst_len = frame[name_start]
-            inst = frame[name_start + 1:name_start + 1 + inst_len].decode(
-                "ascii", "replace")
-            found = (inst, host, port)
+        # names may be compressed (pointer into earlier records) —
+        # decode them properly instead of substring-matching raw bytes
+        if rtype == 33:
+            owner = _read_name(frame, name_start)
+            if owner[-len(svc_labels):] == svc_labels and len(owner) > \
+                    len(svc_labels) and off + 6 <= len(frame):
+                port = struct.unpack_from("!H", frame, off + 4)[0]
+                target = _read_name(frame, off + 6)
+                host = b".".join(target[:-1] if len(target) > 1
+                                 else target).decode("ascii", "replace")
+                inst = owner[0].decode("ascii", "replace")
+                found = (inst, host, port)
         off += rdlen
     return found
 

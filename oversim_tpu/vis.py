@@ -1,0 +1,120 @@
+"""Topology visualization dump — the TopologyVis equivalent.
+
+The reference's TopologyVis (src/common/TopologyVis.h:37-70) draws
+overlay neighbor arrows in the OMNeT++ GUI (showOverlayNeighborArrow /
+deleteOverlayNeighborArrow).  The engine has no GUI; the equivalent
+debug surface is a SNAPSHOT extractor: pull every node's neighbor
+arrows out of a live SimState and emit Graphviz DOT or JSON, so a run
+can be inspected (or rendered with standard tooling) at any tick.
+
+Arrow sources mirror what the reference draws: each overlay's
+characteristic neighbor pointers —
+
+  * Chord/Koorde: successor (ring edge) + finger arrows;
+  * Kademlia: sibling-table arrows;
+  * Pastry/Bamboo: leafset arrows;
+  * EpiChord: successor/predecessor lists;
+  * Broose: brother bucket;
+  * GIA / spatial overlays (Vast/Quon): neighbor sets;
+  * generic fallback: any [N, D]-shaped ``succ``/``nbr``/``sib`` field.
+
+Usage::
+
+    from oversim_tpu import vis
+    dot = vis.to_dot(sim, state)          # Graphviz text
+    data = vis.snapshot(sim, state)       # {"nodes": [...], "edges": [...]}
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+# state-field name → edge kind, in priority order (first hit per field)
+_EDGE_FIELDS = (
+    ("succ", "successor"),
+    ("pred", "predecessor"),
+    ("finger", "finger"),
+    ("sib", "sibling"),
+    ("leaf", "leafset"),
+    ("nbr", "neighbor"),
+    ("bb", "brother"),
+    ("db_list", "debruijn"),
+)
+
+
+def snapshot(sim, state) -> dict:
+    """Extract the overlay topology from a live SimState.
+
+    Returns {"t_sim": s, "nodes": [{"id", "alive", "key"}...],
+    "edges": [{"src", "dst", "kind"}...]} — the engine-side equivalent
+    of the reference's per-node arrow set."""
+    alive = np.asarray(state.alive)
+    n = alive.shape[0]
+    keys = np.asarray(state.node_keys)
+    nodes = [{"id": int(i), "alive": bool(alive[i]),
+              "key": "".join(f"{int(w):08x}" for w in keys[i])}
+             for i in range(n)]
+    edges = []
+    logic = state.logic
+    seen_pairs = set()
+    for field, kind in _EDGE_FIELDS:
+        arr = getattr(logic, field, None)
+        if arr is None:
+            continue
+        a = np.asarray(arr)
+        if a.ndim == 1:
+            a = a[:, None]
+        if a.ndim != 2 or a.dtype.kind not in "iu":
+            continue
+        for i in range(n):
+            if not alive[i]:
+                continue
+            for j in a[i]:
+                j = int(j)
+                if j < 0 or j >= n or j == i:
+                    continue
+                pair = (i, j, kind)
+                if pair in seen_pairs:
+                    continue
+                seen_pairs.add(pair)
+                edges.append({"src": int(i), "dst": j, "kind": kind})
+    return {"t_sim": float(np.asarray(state.t_now)) / 1e9,
+            "nodes": nodes, "edges": edges}
+
+
+_STYLE = {
+    "successor": "color=black",
+    "predecessor": "color=gray,style=dashed",
+    "finger": "color=blue,style=dotted",
+    "sibling": "color=forestgreen",
+    "leafset": "color=forestgreen",
+    "neighbor": "color=purple",
+    "brother": "color=forestgreen",
+    "debruijn": "color=red,style=dotted",
+}
+
+
+def to_dot(sim, state) -> str:
+    """Graphviz DOT of the current overlay topology (render with any
+    standard dot/neato; the showOverlayNeighborArrow styles map to edge
+    colors)."""
+    snap = snapshot(sim, state)
+    lines = ["digraph overlay {", "  node [shape=circle,fontsize=8];",
+             f'  label="t={snap["t_sim"]:.1f}s";']
+    for nd in snap["nodes"]:
+        if nd["alive"]:
+            lines.append(
+                f'  n{nd["id"]} [label="{nd["id"]}\\n'
+                f'{nd["key"][:8]}"];')
+    for e in snap["edges"]:
+        style = _STYLE.get(e["kind"], "color=black")
+        lines.append(f'  n{e["src"]} -> n{e["dst"]} '
+                     f'[{style},tooltip="{e["kind"]}"];')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def to_json(sim, state) -> str:
+    return json.dumps(snapshot(sim, state), indent=1)

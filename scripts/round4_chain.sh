@@ -10,8 +10,10 @@ mkdir -p "$STATE"
 
 echo "=== chain start $(date)" >> "$LOG"
 
-# 0. wait for any running pytest to exit (avoid CPU contention)
-while pgrep -f "python -m pytest" > /dev/null; do sleep 30; done
+# 0. wait for any running pytest to exit (avoid CPU contention).
+# Anchored: an unanchored pattern also matches ambient processes that
+# merely QUOTE the string in their argv
+while pgrep -f "^[^ ]*python -m pytest" > /dev/null; do sleep 30; done
 
 # 1. new modules first (fail-fast visibility)
 for f in test_dht_variants test_singlehost test_stack test_quon \

@@ -45,6 +45,28 @@ def test_mdns_ignores_foreign_frames():
     assert parse_announce(b"nonsense") is None
 
 
+def test_mdns_parses_compressed_frames():
+    """Real responders (Avahi — the reference ZeroconfConnector's
+    backend) compress names with RFC 1035 pointers; parse_announce must
+    decode them, not substring-match raw bytes."""
+    import struct
+    from oversim_tpu.singlehost import SERVICE, _dns_name
+
+    svc = _dns_name(SERVICE)                      # at offset 12
+    hdr = struct.pack("!HHHHHH", 0, 0x8400, 0, 1, 0, 1)
+    inst_off = 12 + len(svc) + 10                 # PTR rdata start
+    inst = b"\x05peerX" + struct.pack("!H", 0xC000 | 12)  # ptr -> svc
+    ptr = svc + struct.pack("!HHIH", 12, 1, 120, len(inst)) + inst
+    # "local" label offset inside svc: 1+8 ("_oversim") + 1+4 ("_udp")
+    local_off = 12 + 14
+    target = b"\x04host" + struct.pack("!H", 0xC000 | local_off)
+    srv_rd = struct.pack("!HHH", 0, 0, 4242) + target
+    owner = struct.pack("!H", 0xC000 | inst_off)  # ptr -> instance name
+    srv = owner + struct.pack("!HHIH", 33, 1, 120, len(srv_rd)) + srv_rd
+    frame = hdr + ptr + srv
+    assert parse_announce(frame) == ("peerX", "host", 4242)
+
+
 def test_tun_bridge_packet_roundtrip():
     """A raw IPv4/UDP packet (as a TUN device would deliver) traverses
     the simulated gateway node's echo app and comes back as a raw
