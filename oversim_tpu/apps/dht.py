@@ -596,10 +596,14 @@ class DhtApp:
 
         # GET: DHTGetCall to numGetRequests siblings — the responses are
         # quorum-voted with ratioIdentical (DHT.cc:262,636; default.ini:
-        # numGetRequests=4, ratioIdentical=0.5)
+        # numGetRequests=4, ratioIdentical=0.5).  With replica teams the
+        # fan-out caps at the team's replica count: querying past the
+        # team's replica set only stacks notfound votes against it
         is_get = en & suc & (app.op == OP_GET)
         nget = jnp.int32(0)
-        for i in range(min(p.num_get_requests, done.results.shape[0])):
+        get_w = (min(p.num_get_requests, self.per_team)
+                 if self.teams > 1 else p.num_get_requests)
+        for i in range(min(get_w, done.results.shape[0])):
             tgt = done.results[i]
             send = is_get & (tgt != NO_NODE)
             ob.send(send, now, tgt, wire.DHT_GET_CALL,
@@ -751,7 +755,12 @@ class DhtApp:
                         * app.op_pending.astype(jnp.float32)).astype(I32)
         need = jnp.maximum(need, 1)
         win = en & jnp.any(counts >= need)
-        winner = votes[jnp.argmax(counts)]
+        # tie-break: a value vote beats an equal count of notfound votes
+        # (the reference's hash-map iteration order breaks such ties
+        # arbitrarily; preferring data over absence is the sane engine
+        # behavior and keeps a partially-covered replica set readable)
+        counts_adj = counts * 2 + (votes != NO_VAL).astype(I32)
+        winner = votes[jnp.argmax(counts_adj)]
         exhausted = en & ~win & (n_acks >= app.op_pending)
         # truth-map validation (DHTTestApp::handleGetResponse,
         # DHTTestApp.cc:173-232): slot recycled (ring wrap) maps to the

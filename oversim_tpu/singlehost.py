@@ -251,8 +251,13 @@ def parse_announce(frame: bytes):
         # decode them properly instead of substring-matching raw bytes
         if rtype == 33:
             owner = _read_name(frame, name_start)
+            # rdata must actually HOLD prio/weight/port + >=1 target
+            # byte — decoding past a short rdlen would read the next
+            # record (or attacker-controlled trailing bytes) as a
+            # bootstrap endpoint
             if owner[-len(svc_labels):] == svc_labels and len(owner) > \
-                    len(svc_labels) and off + 6 <= len(frame):
+                    len(svc_labels) and rdlen >= 7 \
+                    and off + rdlen <= len(frame):
                 port = struct.unpack_from("!H", frame, off + 4)[0]
                 target = _read_name(frame, off + 6)
                 host = b".".join(target[:-1] if len(target) > 1

@@ -21,8 +21,8 @@ characteristic neighbor pointers —
 Usage::
 
     from oversim_tpu import vis
-    dot = vis.to_dot(sim, state)          # Graphviz text
-    data = vis.snapshot(sim, state)       # {"nodes": [...], "edges": [...]}
+    dot = vis.to_dot(state)               # Graphviz text
+    data = vis.snapshot(state)            # {"nodes": [...], "edges": [...]}
 """
 
 from __future__ import annotations
@@ -44,7 +44,7 @@ _EDGE_FIELDS = (
 )
 
 
-def snapshot(sim, state) -> dict:
+def snapshot(state) -> dict:
     """Extract the overlay topology from a live SimState.
 
     Returns {"t_sim": s, "nodes": [{"id", "alive", "key"}...],
@@ -96,11 +96,11 @@ _STYLE = {
 }
 
 
-def to_dot(sim, state) -> str:
+def to_dot(state) -> str:
     """Graphviz DOT of the current overlay topology (render with any
     standard dot/neato; the showOverlayNeighborArrow styles map to edge
     colors)."""
-    snap = snapshot(sim, state)
+    snap = snapshot(state)
     lines = ["digraph overlay {", "  node [shape=circle,fontsize=8];",
              f'  label="t={snap["t_sim"]:.1f}s";']
     for nd in snap["nodes"]:
@@ -116,5 +116,5 @@ def to_dot(sim, state) -> str:
     return "\n".join(lines)
 
 
-def to_json(sim, state) -> str:
-    return json.dumps(snapshot(sim, state), indent=1)
+def to_json(state) -> str:
+    return json.dumps(snapshot(state), indent=1)

@@ -27,7 +27,7 @@ def chord_state():
 
 def test_snapshot_has_ring_edges(chord_state):
     s, st = chord_state
-    snap = vis.snapshot(s, st)
+    snap = vis.snapshot(st)
     assert len(snap["nodes"]) == N
     succ = [(e["src"], e["dst"]) for e in snap["edges"]
             if e["kind"] == "successor"]
@@ -46,7 +46,7 @@ def test_snapshot_has_ring_edges(chord_state):
 
 def test_dot_renders(chord_state):
     s, st = chord_state
-    dot = vis.to_dot(s, st)
+    dot = vis.to_dot(st)
     assert dot.startswith("digraph overlay {")
     assert "->" in dot and dot.rstrip().endswith("}")
 
@@ -54,5 +54,5 @@ def test_dot_renders(chord_state):
 def test_json_roundtrips(chord_state):
     import json
     s, st = chord_state
-    data = json.loads(vis.to_json(s, st))
+    data = json.loads(vis.to_json(st))
     assert data["nodes"] and data["edges"]
