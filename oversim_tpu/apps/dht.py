@@ -795,11 +795,16 @@ class DhtApp:
                                 | ~slot_ok))
         ev.value("dht_get_latency_s",
                  (now - app.op_t0).astype(jnp.float32) / NS, good)
+        # NOTE: no votes/acks/pending reset here on retry_team — the
+        # continuation lookup's completion resets them (on_lookup_done
+        # is_get), stale-team responses are key-guarded out by cur_key,
+        # AND the extra where-resets sent this box's XLA-CPU compile
+        # into a >10-minute stall (bisected empirically; the slim form
+        # compiles in ~50 s)
         app = dataclasses.replace(
             app,
-            op_votes=jnp.where(retry_team, NO_VAL - 1, votes),
-            op_acks=jnp.where(retry_team, 0, n_acks),
-            op_pending=jnp.where(retry_team, 0, app.op_pending),
+            op_votes=votes,
+            op_acks=n_acks,
             op_team=app.op_team + retry_team.astype(I32),
             op_cont=app.op_cont | retry_team,
             op=jnp.where(final, OP_NONE, app.op),

@@ -87,6 +87,10 @@ class RealtimeGateway:
         self.sim = sim
         self.state = state
         self.gw = gw_slot
+        # extra between-tick drains (TunBridge registers here): EXT_OUT
+        # messages a drain does not consume would be DELIVERED back into
+        # the gateway node's inbox on the next tick and lost
+        self.ext_drains: list = []
         self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.udp.bind((host, udp_port))
         self.udp.setblocking(False)
@@ -229,6 +233,8 @@ class RealtimeGateway:
             prev = int(self.state.t_now)
             self.state = self.sim.step(self.state)
             self._drain_ext_out()
+            for fn in self.ext_drains:
+                fn()
             if int(self.state.t_now) == prev and not bool(
                     np.asarray(self.state.pool.valid).any()):
                 break   # nothing scheduled anywhere: idle sim

@@ -119,6 +119,10 @@ class TunBridge:
         self.local_port = local_port
         self._tun_sessions: dict = {}    # sid -> (src_ip, src_port)
         self._by_addr: dict = {}         # (src_ip, src_port) -> sid
+        self._outq: list = []            # raw packets drained mid-pump
+        # drain between pump ticks — an unconsumed EXT_OUT would be
+        # delivered back into the sim on the next tick and lost
+        gateway.ext_drains.append(self._drain_to_queue)
 
     def feed_raw(self, packet: bytes) -> bool:
         """One inbound raw packet → EXT_IN message (True if parsed and
@@ -145,23 +149,29 @@ class TunBridge:
         self.gw.inject(EXT_IN, a=sid, b=b, c=c)
         return True
 
-    def collect_raw(self) -> list:
-        """Drain EXT_OUT messages with tun sessions → raw reply packets
-        (the TUN write direction; shared drain, gateway.drain_ext_out)."""
+    def _drain_to_queue(self):
+        """Drain EXT_OUT messages with tun sessions into the outbound
+        packet queue (shared drain, gateway.drain_ext_out; runs between
+        pump ticks via gateway.ext_drains)."""
         from oversim_tpu.gateway import EXT_OUT, drain_ext_out
-
-        out = []
 
         def handler(sid, b, c):
             sess = self._tun_sessions.get(sid)
             if sess is None:
                 return False  # a socket session — the gateway drains it
             payload = _HDR.pack(EXT_OUT, sid, b, c)
-            out.append(build_ipv4_udp(self.local_ip, self.local_port,
-                                      sess[0], sess[1], payload))
+            self._outq.append(
+                build_ipv4_udp(self.local_ip, self.local_port,
+                               sess[0], sess[1], payload))
             return True
 
         self.gw.state = drain_ext_out(self.gw.state, self.gw.gw, handler)
+
+    def collect_raw(self) -> list:
+        """Raw reply packets accumulated since the last call (the TUN
+        write direction)."""
+        self._drain_to_queue()
+        out, self._outq = self._outq, []
         return out
 
 

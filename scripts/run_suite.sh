@@ -15,7 +15,10 @@ for f in tests/test_*.py; do
     echo "skip  $name (done)"
     continue
   fi
-  if python -m pytest "$f" -q > "$STATE/$name.log" 2>&1; then
+  # hard per-module ceiling: one runaway module must not eat the
+  # whole suite budget (round-3 lost a third of the suite that way)
+  if timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+      python -m pytest "$f" -q > "$STATE/$name.log" 2>&1; then
     touch "$marker"
     echo "PASS  $name  $(tail -1 "$STATE/$name.log")"
   else

@@ -23,10 +23,10 @@ def run_variant(variant: str):
     logic = ChordLogic(app=app)
     cp = churn_mod.ChurnParams(model="none", target_num=8,
                                init_interval=1.0)
-    ep = sim_mod.EngineParams(window=0.030, transition_time=20.0)
+    ep = sim_mod.EngineParams(window=0.05, transition_time=20.0)
     s = sim_mod.Simulation(logic, cp, engine_params=ep)
     st = s.init(seed=29)
-    st = s.run_until(st, 300.0, chunk=512)
+    st = s.run_until(st, 240.0, chunk=512)
     return s, st, s.summary(st)
 
 

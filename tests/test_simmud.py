@@ -23,7 +23,11 @@ def simmud_run():
                                  move=MoveParams(field=400.0, speed=20.0)))
     logic = ChordLogic(app=app)
     cp = churn_mod.ChurnParams(model="none", target_num=N, init_interval=0.5)
-    ep = sim_mod.EngineParams(window=0.020, transition_time=80.0)
+    # MMOG multicast is bursty: each publish fans out through the region
+    # tree in one tick — size the pool for the burst (counted, never
+    # silent; engine/pool.py docstring)
+    ep = sim_mod.EngineParams(window=0.05, transition_time=80.0,
+                              pool_factor=16)
     s = sim_mod.Simulation(logic, cp, engine_params=ep)
     st = s.init(seed=43)
     st = s.run_until(st, 400.0, chunk=512)

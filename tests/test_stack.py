@@ -28,10 +28,10 @@ def run_stack(overlay: str):
         logic = KademliaLogic(app=stack)
     cp = churn_mod.ChurnParams(model="none", target_num=N,
                                init_interval=1.0)
-    ep = sim_mod.EngineParams(window=0.020, transition_time=30.0)
+    ep = sim_mod.EngineParams(window=0.05, transition_time=30.0)
     s = sim_mod.Simulation(logic, cp, engine_params=ep)
     st = s.init(seed=17)
-    st = s.run_until(st, 300.0, chunk=512)
+    st = s.run_until(st, 260.0, chunk=512)
     return s, st, s.summary(st)
 
 
