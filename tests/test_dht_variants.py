@@ -17,9 +17,14 @@ from oversim_tpu.overlay.chord import ChordLogic
 
 
 def run_variant(variant: str):
+    # mod_test off: a mod re-put racing a get of the same key counts
+    # as wrong-by-truth (legitimate concurrent-write ambiguity, also in
+    # the reference) — this fixture isolates the TEAM machinery, so
+    # gets must only read stable keys
     app = DhtApp(DhtParams(test_interval=20.0, num_test_keys=32,
                            test_ttl=600.0, num_replica=4,
-                           variant=variant, num_replica_teams=2))
+                           variant=variant, num_replica_teams=2,
+                           mod_test=False))
     logic = ChordLogic(app=app)
     cp = churn_mod.ChurnParams(model="none", target_num=8,
                                init_interval=1.0)

@@ -27,7 +27,7 @@ def simmud_run():
     # tree in one tick — size the pool for the burst (counted, never
     # silent; engine/pool.py docstring)
     ep = sim_mod.EngineParams(window=0.05, transition_time=80.0,
-                              pool_factor=16)
+                              pool_factor=16, outbox_slots=64)
     s = sim_mod.Simulation(logic, cp, engine_params=ep)
     st = s.init(seed=43)
     st = s.run_until(st, 400.0, chunk=512)
