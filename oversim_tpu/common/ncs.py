@@ -178,22 +178,21 @@ def slice_of(st: NcsState, idx):
 # vectorized gradient descent — identical fixed points, jit-friendly.
 # ---------------------------------------------------------------------------
 
-NPS_MAX_LAYER = 8   # layer ceiling (reference Nps.h:86 maxLayer)
+NPS_MAX_LAYER = 8   # npsMaxLayer (reference Nps.cc:46; Nps.h:86)
 
 
 def nps_accepts(p: NcsParams, my_layer, peer_layer):
     """May a sample from ``peer_layer`` serve as my reference point?
-    GNP: landmarks only (layer 0).  NPS: positioned nodes of a STRICTLY
-    lower layer once this node is positioned itself (Nps.h layer
-    semantics — without the restriction two mutually-referencing peers
-    ratchet each other's layer upward without bound), any positioned
-    node below the ceiling while still unpositioned; landmarks only use
-    fellow landmarks (Landmark coordinate bootstrap)."""
+    GNP: landmarks only (layer 0).  NPS: any positioned node BELOW the
+    layer ceiling (Nps::setLandmarkSet accepts refs with layer <
+    maxLayer, Nps.cc:401-402; computeOwnLayer then ratchets own layer
+    to max(ref)+1, Nps.cc:449-457 — the ceiling, not a strictly-lower
+    rule, is what bounds the ratchet in the reference).  Landmarks only
+    use fellow landmarks (Landmark coordinate bootstrap)."""
     if p.ncs_type == "gnp":
         ok = peer_layer == 0
     else:
-        ok = (peer_layer >= 0) & (peer_layer < NPS_MAX_LAYER) & jnp.where(
-            my_layer > 0, peer_layer < my_layer, True)
+        ok = (peer_layer >= 0) & (peer_layer < NPS_MAX_LAYER)
     return ok & jnp.where(my_layer == 0, peer_layer == 0, True)
 
 
