@@ -48,13 +48,25 @@ _T0 = time.time()
 BASELINE_LOOKUPS_PER_SEC = 2.0e4
 
 
-def _json_line(rate: float, unit: str) -> str:
-    return json.dumps({
+def _json_line(rate: float, unit: str, *, healthy: bool = False,
+               extra: dict | None = None) -> str:
+    """One emitted measurement record.  ``healthy`` is the hard delivery
+    gate (VERDICT r4 weak #5): True only for windows with ≥95% delivery
+    AND zero engine overflow counters — only those may become the
+    record or the cached fallback.  ``cached`` marks re-emitted old
+    measurements so numeric consumers can tell them from fresh ones
+    (ADVICE r4)."""
+    rec = {
         "metric": "kbr_lookups_per_sec",
         "value": round(rate, 2),
         "unit": unit,
         "vs_baseline": round(rate / BASELINE_LOOKUPS_PER_SEC, 4),
-    })
+        "healthy": bool(healthy),
+        "cached": False,
+    }
+    if extra:
+        rec.update(extra)
+    return json.dumps(rec)
 
 
 # ---------------------------------------------------------------------------
@@ -67,11 +79,14 @@ CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def _load_cached_tpu() -> dict | None:
     """Last committed on-chip measurement (written by the child whenever
-    a TPU window completes; survives rounds in git)."""
+    a HEALTHY TPU window completes; survives rounds in git).  Entries
+    without ``healthy: true`` are ignored — the round-3 cache line was
+    measured at 64% delivery, which is not a valid perf claim (VERDICT
+    r4 weak #5)."""
     try:
         with open(CACHE_PATH) as f:
             entry = json.load(f)
-        if "cpu" not in entry.get("unit", "cpu"):
+        if "cpu" not in entry.get("unit", "cpu") and entry.get("healthy"):
             return entry
     except (OSError, ValueError):
         pass
@@ -110,6 +125,8 @@ def orchestrate() -> int:
 
     threading.Thread(target=_watchdog, daemon=True).start()
     saw_tpu = False
+    last_healthy_tpu = None     # most recent gate-passing chip line
+    last_line_healthy = False
     for line in child.stdout:
         line = line.rstrip("\n")
         if not line:
@@ -125,11 +142,31 @@ def orchestrate() -> int:
             sys.stderr.write("bench: suppressing cpu line (have tpu)\n")
             continue
         saw_tpu = saw_tpu or not on_cpu
+        last_line_healthy = bool(parsed.get("healthy"))
+        if not on_cpu and last_line_healthy:
+            last_healthy_tpu = line
         print(line, flush=True)  # the driver parses the LAST line
     child.wait()
+    if saw_tpu and not last_line_healthy:
+        # the FINAL printed window failed the delivery gate — it must
+        # not stand as the record when anything gate-passing exists.
+        # Re-print the most recent healthy chip line (fresh beats
+        # cached).  If NOTHING healthy exists, the unhealthy line
+        # stays last — machine-readably flagged healthy:false, which
+        # is the honest record of a run with no valid measurement.
+        if last_healthy_tpu is not None:
+            print(last_healthy_tpu, flush=True)
+        elif fallback is not None:
+            fallback = dict(fallback)
+            fallback["cached"] = True
+            fallback["unit"] += (" [cached: fresh windows failed "
+                                 "delivery gate]")
+            print(json.dumps(fallback), flush=True)
     if not saw_tpu and fallback is not None:
-        # re-emit so the LAST line the driver parses is the chip number
+        # re-emit so the LAST line the driver parses is the chip number —
+        # machine-readably marked as a cache replay (ADVICE r4)
         fallback = dict(fallback)
+        fallback["cached"] = True
         if "cached" not in fallback["unit"]:
             fallback["unit"] += " [cached measurement; tunnel down this run]"
         print(json.dumps(fallback), flush=True)
@@ -292,12 +329,23 @@ def child_main():
         delivered = out["kbr_delivered"] - base["kbr_delivered"]
         sent = out["kbr_sent"] - base["kbr_sent"]
         rate = delivered / wall if wall > 0 else 0.0
+        # HARD health gate (VERDICT r4 next-step #3): a window may only
+        # become the record/cache at ≥95% delivery with zero overflow
+        # counters — lost lookups are cheap, so a lossy config could
+        # otherwise post a big number legitimately per the old rules
+        overflow = {k: v for k, v in out["_engine"].items()
+                    if ("overflow" in k or "deferred" in k) and v}
+        delivery = delivered / sent if sent else 0.0
+        healthy = sent > 0 and delivery >= 0.95 and not overflow
         unit = (f"lookups/s ({overlay} {n} nodes, {dev.platform}, "
                 f"delivery {delivered}/{sent}, {out['_ticks']} ticks, "
                 f"{wall:.1f}s wall)")
-        line = _json_line(rate, unit)
+        line = _json_line(rate, unit, healthy=healthy,
+                          extra={"delivery": round(delivery, 4),
+                                 "measured_utc": time.strftime(
+                                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
         print(line, flush=True)
-        if not on_cpu and delivered > 0:
+        if not on_cpu and delivered > 0 and healthy:
             # persist the chip measurement for the cached-fallback path
             try:
                 with open(CACHE_PATH + ".tmp", "w") as f:
@@ -306,8 +354,9 @@ def child_main():
             except OSError:
                 pass
         sys.stderr.write("bench: %.0f lookups/s after %.1fs (%d/%d) "
-                         "counters=%r\n"
-                         % (rate, wall, delivered, sent, out["_engine"]))
+                         "healthy=%s counters=%r\n"
+                         % (rate, wall, delivered, sent, healthy,
+                            out["_engine"]))
 
 
 def main():

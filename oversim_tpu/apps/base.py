@@ -34,11 +34,16 @@ Optional hooks (overlays probe with hasattr; absent = zero graph cost):
       # :523): inspect messages being recursively routed THROUGH this
       # node; True vetoes the hop (the message is dropped — the
       # reference's forwardResponse without a next hop)
-  on_update(state_n, en, ctx, ob, ev, now, node_idx, added) -> state_n
+  on_update(state_n, en, ctx, ob, ev, now, node_idx, added,
+            sib_keys=None, sib_valid=None) -> state_n
       # Common API update() (BaseApp.h:223, BaseOverlay::callUpdate
       # :640): ``added`` lists nodes that ENTERED this node's
       # sibling/replica set this tick (NO_NODE padded); the DHT uses it
-      # for update()-driven maintenance re-replication
+      # for update()-driven maintenance re-replication.  ``sib_keys``
+      # [S, KL] / ``sib_valid`` [S] carry the overlay's CURRENT local
+      # sibling view (succ list / sibling table / leafset) so the app
+      # can evaluate the reference's isSiblingFor responsibility test
+      # (DHT.cc:746-747) per stored record
   on_tick(state_n, ctx, ob, ev, node_idx) -> state_n
       # every-tick outbox access (paced pumps); called by
       # ``leave_protocol`` from every overlay step

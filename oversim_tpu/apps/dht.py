@@ -64,6 +64,18 @@ OP_NONE, OP_PUT, OP_GET = 0, 1, 2
 G_APPEND = jnp.int32(-2)
 
 
+def _dist64(d):
+    """Compress a key-shaped distance [..., KL] to its top 64 bits (the
+    two most-significant u32 lanes).  Exact for comparisons between
+    distances of uniform-random node keys (the same argument as
+    keys.sort_by_distance's compressed comparator — ties below 2^-64
+    probability); the maintenance responsibility filter only ranks
+    node-key distances, never structured/team-offset keys."""
+    hi = d[..., 0].astype(jnp.uint64)
+    lo = d[..., 1].astype(jnp.uint64) if d.shape[-1] > 1 else 0
+    return (hi << 32) | lo
+
+
 @dataclasses.dataclass(frozen=True)
 class DhtParams:
     """default.ini:67-77 + tier2 dhtTestApp namespace."""
@@ -144,6 +156,8 @@ class DhtState:
     # replica set receives my stored records, paced 2 per tick
     mnt_dst: jnp.ndarray       # [N] i32 — replication target (NO_NODE idle)
     mnt_pos: jnp.ndarray       # [N] i32 — next storage slot to push
+    mnt_resp: jnp.ndarray      # [N, D] bool — per-record responsibility
+    #   mask frozen at on_update staging time (DHT.cc:777 isSiblingFor)
 
 
 @jax.tree_util.register_dataclass
@@ -278,6 +292,7 @@ class DhtApp:
             commit_expire=jnp.zeros((n,), I64),
             mnt_dst=jnp.full((n,), NO_NODE, I32),
             mnt_pos=jnp.zeros((n,), I32),
+            mnt_resp=jnp.zeros((n, d), bool),
         )
 
     def glob_init(self, rng) -> DhtGlobal:
@@ -358,6 +373,52 @@ class DhtApp:
         # an active maintenance replication pumps every tick until done
         return jnp.where(app.mnt_dst != NO_NODE, jnp.int64(0), t)
 
+    def timer_event(self, app):
+        """Events needing an ``on_timer`` dispatch — EXCLUDES the
+        maintenance-pump sentinel (the pump runs via on_tick, which
+        every overlay calls unconditionally).  TierStack bases its
+        earliest-tier pick on this so an active pump can't monopolize
+        the stack's one timer slot per window and starve other tiers'
+        timeout processing."""
+        t = jnp.minimum(app.t_test, app.op_to)
+        return jnp.where(app.op_cont, jnp.int64(0), t)
+
+    def _vote_winner(self, votes, n_acks):
+        """Quorum bookkeeping shared by the response path and the
+        timeout path: per-value counts over the filled vote prefix and
+        the data-preferring winner (a value vote beats an equal count
+        of notfound votes — the reference's hash-map iteration order
+        breaks such ties arbitrarily; preferring data keeps a
+        partially-covered replica set readable)."""
+        q = self.p.num_get_requests
+        filled = jnp.arange(q) < jnp.clip(n_acks, 0, q)
+        counts = jnp.sum((votes[:, None] == votes[None, :])
+                         & filled[None, :], axis=1)
+        counts = jnp.where(filled, counts, 0)
+        winner = votes[jnp.argmax(counts * 2
+                                  + (votes != NO_VAL).astype(I32))]
+        return counts, winner
+
+    def _truth_outcomes(self, glob, op_g, op_key, winner, now, final):
+        """Truth-map validation shared by the response and timeout
+        paths (DHTTestApp::handleGetResponse, DHTTestApp.cc:173-232):
+        a recycled ring slot maps to the reference's entry==NULL error;
+        expired truth means an empty result is SUCCESS ("deleted key
+        gone") and a value is an error; live truth compares values.
+        ``final`` gates all three outcome masks."""
+        g_n = glob.val.shape[0]
+        gslot = jnp.clip(op_g, 0, g_n - 1)
+        slot_ok = jnp.all(glob.keys[gslot] == op_key) & (op_g >= 0)
+        expired = now > glob.expire[gslot]
+        has_val = winner != NO_VAL
+        good = final & slot_ok & jnp.where(
+            expired, ~has_val,
+            has_val & (winner == glob.val[gslot]))
+        wrong = final & slot_ok & has_val & (
+            expired | (winner != glob.val[gslot]))
+        notfound = final & ((slot_ok & ~expired & ~has_val) | ~slot_ok)
+        return slot_ok, expired, has_val, good, wrong, notfound
+
     def _stage_commit(self, app, en):
         """Stage the pending op's (key, value, expiry) as a truth-map
         commit for post_step — shared by put-complete, put-lookup-fail
@@ -372,22 +433,59 @@ class DhtApp:
                 en, app.op_t0 + jnp.int64(int(self.p.test_ttl * NS)),
                 app.commit_expire))
 
-    def on_update(self, app, en, ctx, ob, ev, now, node_idx, added):
+    def on_update(self, app, en, ctx, ob, ev, now, node_idx, added,
+                  sib_keys=None, sib_valid=None, urgent=None):
         """BaseApp::update (BaseApp.h:223) — the overlay reports a node
         that ENTERED this node's replica/sibling set; my stored records
         replicate to it (the reference DHT's update()-driven maintenance
         puts).  ``added`` [A] NO_NODE-padded; one target is staged at a
-        time and pumped 2 records/tick by on_timer."""
+        time and pumped 2 records/tick by on_timer.
+
+        Responsibility filter (DHT.cc:746-747 / :777 isSiblingFor): a
+        record replicates to the added node only if that node falls
+        within the numReplica sibling set for the record's key, judged
+        from this node's local sibling view (``sib_keys``/``sib_valid``,
+        passed by the overlay: succ list / sibling table / leafset —
+        the reference's overlay->local_lookup(key, numReplica).back()
+        comparison).  With fewer than numReplica members known, every
+        added node is admitted (matching the reference's over-send on
+        Chord's isSiblingFor err path, DHT.cc:779-797).  The mask is
+        frozen per record at staging time (``mnt_resp``)."""
         first = added[jnp.argmax(added != NO_NODE)]
-        # an active pump is never preempted — the in-flight target would
-        # silently lose its tail records; a member missed while busy is
-        # re-replicated on its next set delta (bounded-state tradeoff,
-        # the reference issues one maintenance put series per update())
+        # an active pump is normally not preempted — the in-flight
+        # target would silently lose its tail records; a member missed
+        # while busy is re-replicated on its next set delta.  EXCEPT
+        # when the overlay marks the delta ``urgent`` (Chord's new-
+        # predecessor ownership transfer — that delta never recurs, so
+        # missing it would orphan the transferred keyspace): an urgent
+        # delta restarts the pump at the new target.
+        idle = app.mnt_dst == NO_NODE
+        if urgent is not None:
+            idle = idle | urgent
         en = en & (first != NO_NODE) & (first != node_idx) & jnp.any(
-            app.s_val != NO_VAL) & (app.mnt_dst == NO_NODE)
+            app.s_val != NO_VAL) & idle
+        tgt_key = ctx.keys[jnp.maximum(first, 0)]
+        d_tgt = _dist64(self.dist(tgt_key[None, :], app.s_key))   # [D]
+        if sib_keys is None:
+            resp = jnp.ones(app.s_val.shape, bool)
+        else:
+            me_key = ctx.keys[node_idx]
+            # [D, S+1] compressed distances of {me} ∪ sibling view to
+            # each record key; invalid members push to +inf so a short
+            # view leaves the numReplica-th slot at +inf (admit-all)
+            d_me = _dist64(self.dist(me_key[None, :], app.s_key))
+            d_sib = _dist64(self.dist(sib_keys[:, None, :],
+                                      app.s_key[None, :, :]))      # [S, D]
+            d_sib = jnp.where(sib_valid[:, None], d_sib,
+                              jnp.uint64(2**64 - 1))
+            all_d = jnp.concatenate([d_me[None, :], d_sib], axis=0)
+            kth = jnp.sort(all_d, axis=0)[
+                min(self.p.num_replica, all_d.shape[0]) - 1]       # [D]
+            resp = d_tgt <= kth
         return dataclasses.replace(
             app,
             mnt_dst=jnp.where(en, first, app.mnt_dst),
+            mnt_resp=jnp.where(en, resp, app.mnt_resp),
             mnt_pos=jnp.where(en, 0, app.mnt_pos))
 
     def on_tick(self, app, ctx, ob, ev, node_idx):
@@ -397,17 +495,14 @@ class DhtApp:
         ceil(records/2) ticks instead of slots/2 (the pump holds the
         sim-wide event horizon down while active).
 
-        Responsibility filter (DHT::update, DHT.cc:732-764): a record
-        replicates only if the target is at least as close to its key as
-        we are — pushing the whole store regardless floods the target
-        with records it is not responsible for (and, with bounded
-        storage, could evict ones it is)."""
+        Only records whose frozen responsibility mask (``mnt_resp``,
+        the sibling-set membership test staged by on_update) admits the
+        target are pushed — flooding the target with records it is not
+        responsible for could, with bounded storage, evict ones it
+        is."""
         d = app.s_val.shape[0]
         idx = jnp.arange(d, dtype=I32)
-        me_key = ctx.keys[node_idx]
-        tgt_key = ctx.keys[jnp.maximum(app.mnt_dst, 0)]
-        resp = keys_mod.le(self.dist(tgt_key[None, :], app.s_key),
-                           self.dist(me_key[None, :], app.s_key))
+        resp = app.mnt_resp
         for _ in range(2):
             cand = (app.s_val != NO_VAL) & (idx >= app.mnt_pos) & resp
             m_en = (app.mnt_dst != NO_NODE) & jnp.any(cand)
@@ -438,7 +533,22 @@ class DhtApp:
         # the success check), so later gets of that key must expect the
         # failed put's value
         to = (app.op != OP_NONE) & (app.op_to < ctx.t_end)
-        ev.count("dht_lookup_failed", to)
+        # a timed-out GET with responses in hand is evaluated with what
+        # it has — the reference's DHTGet timeout path picks the value
+        # with the highest count among received responses, explicitly
+        # WITHOUT the ratioIdentical bar (DHT::handleRpcTimeout "no more
+        # nodes to ask, see what we can do with what we have"; the ratio
+        # check there is an #if 0 block).  Under churn a dead replica in
+        # the fan-out otherwise turns every such get into a guaranteed
+        # failure
+        to_get = to & (app.op == OP_GET) & (app.op_acks > 0)
+        _, winner_t = self._vote_winner(app.op_votes, app.op_acks)
+        _, _, _, good_t, wrong_t, nf_t = self._truth_outcomes(
+            glob, app.op_g, app.op_key, winner_t, now, to_get)
+        ev.count("dht_get_success", good_t)
+        ev.count("dht_get_wrong", wrong_t)
+        ev.count("dht_get_notfound", nf_t)
+        ev.count("dht_lookup_failed", to & ~to_get)
         app = self._stage_commit(app, to & (app.op == OP_PUT))
         app = dataclasses.replace(
             app,
@@ -651,7 +761,14 @@ class DhtApp:
             app,
             s_key=app.s_key.at[col].set(key, mode="drop"),
             s_val=app.s_val.at[col].set(val, mode="drop"),
-            s_expire=app.s_expire.at[col].set(expire, mode="drop")), did
+            s_expire=app.s_expire.at[col].set(expire, mode="drop"),
+            # an active maintenance pump's frozen responsibility mask
+            # (mnt_resp, staged by on_update) was computed for this
+            # slot's PREVIOUS contents — drop the bit so the pump never
+            # pushes a just-stored record under a stale judgment (the
+            # new record reached us via a fresh put/copy; it is
+            # re-replicated on the target's next set delta if needed)
+            mnt_resp=app.mnt_resp.at[col].set(False, mode="drop")), did
 
     def on_leave(self, app, en, ctx, ob, ev, now, node_idx, handover):
         """Graceful-leave data handover: push stored records to the
@@ -676,19 +793,48 @@ class DhtApp:
         return app
 
     def on_msg(self, app, m, ctx, ob, ev, is_sib):
+        """Single-slot fallback: wraps the batched ``on_msgs`` with a
+        one-message batch (overlays without an on_msgs dispatch)."""
+        return self.on_msgs(
+            app, jax.tree.map(lambda x: x[None], m), ctx, ob, ev,
+            jnp.atleast_1d(is_sib))
+
+    def on_msgs(self, app, msgs, ctx, ob, ev, is_sib, node_idx=None):
+        """Batched inbox handler: ONE pass over all R inbox slots.
+
+        The per-slot ``on_msg`` unrolled R× was the dominant compile
+        cost of every DHT-bearing graph (the round-4 suite/dryrun
+        compile stall): R copies of the quorum-vote + storage-scan
+        graph, vmapped over N.  This batched form issues each piece
+        once with [R]-shaped masks — vector Outbox sends, one storage
+        probe [R, D], one quorum evaluation per tick.
+
+        Semantic deltas vs the sequential unroll (both within one
+        50 ms delivery window, where message order is arbitrary
+        anyway): puts apply before gets batch-wide, and the GET quorum
+        is evaluated once after folding the whole batch's votes rather
+        than after each response.
+        """
+        del is_sib, node_idx
         p = self.p
-        now = m.t_deliver
+        now = msgs.t_deliver                                   # [R]
+        r_in = msgs.valid.shape[0]
 
         # DHTPutCall → store + ack (DHT::handlePutRequest); b == -1 marks
         # replication copies (maintenance/handover), which may not roll
-        # a newer record back
-        en = m.valid & (m.kind == wire.DHT_PUT_CALL)
-        expire = m.stamp
-        app, did_store = self._store(app, en, m.key, m.a, expire,
-                                     maintenance=(m.b == -1))
-        ev.count("dht_stored", did_store)
-        ob.send(en, now, m.src, wire.DHT_PUT_RES, key=m.key, b=m.b,
-                size_b=wire.BASE_CALL_B)
+        # a newer record back.  _store stays sequential per slot (exact
+        # same-key overwrite / free-slot / eviction semantics); it is
+        # [D]-cheap — the expensive pieces below are all batched.
+        en_put = msgs.valid & (msgs.kind == wire.DHT_PUT_CALL)  # [R]
+        stored = []
+        for r in range(r_in):
+            app, did_r = self._store(app, en_put[r], msgs.key[r],
+                                     msgs.a[r], msgs.stamp[r],
+                                     maintenance=(msgs.b[r] == -1))
+            stored.append(did_r)
+        ev.count("dht_stored", jnp.stack(stored))
+        ob.send(en_put, now, msgs.src, wire.DHT_PUT_RES, key=msgs.key,
+                b=msgs.b, size_b=wire.BASE_CALL_B)
 
         # DHTPutResponse → ack counting; majority = success.  The op
         # nonce echoed in b rejects straggler acks from a timed-out op
@@ -696,9 +842,12 @@ class DhtApp:
         # match rejects a previous TEAM's stragglers (variants)
         cur_key = (self._team_key(app.op_key, app.op_team)
                    if self.teams > 1 else app.op_key)
-        en = (m.valid & (m.kind == wire.DHT_PUT_RES) & (app.op == OP_PUT)
-              & (m.b == app.op_seq) & jnp.all(m.key == cur_key))
-        acks = app.op_acks + en.astype(I32)
+        en_ack = (msgs.valid & (msgs.kind == wire.DHT_PUT_RES)
+                  & (app.op == OP_PUT) & (msgs.b == app.op_seq)
+                  & jnp.all(msgs.key == cur_key[None, :], axis=-1))  # [R]
+        en = jnp.any(en_ack)
+        now_s = jnp.max(jnp.where(en_ack, now, jnp.int64(0)))
+        acks = app.op_acks + jnp.sum(en_ack.astype(I32), dtype=I32)
         # a MAJORITY of replica acks completes the put (DHT.cc
         # handlePutResponse: numResponses/numSent > 0.5) — requiring all
         # acks makes every stale replica-set entry a guaranteed failure
@@ -709,7 +858,7 @@ class DhtApp:
         next_team = team_done & more
         ev.count("dht_put_success", complete)
         ev.value("dht_put_latency_s",
-                 (now - app.op_t0).astype(jnp.float32) / NS, complete)
+                 (now_s - app.op_t0).astype(jnp.float32) / NS, complete)
         app = self._stage_commit(app, complete)   # truth commit
         app = dataclasses.replace(
             app,
@@ -721,80 +870,66 @@ class DhtApp:
             # each team round gets a fresh timeout budget (the parallel
             # reference teams each carry their own CAPI timeout)
             op_to=jnp.where(complete, T_INF,
-                            jnp.where(next_team, now + jnp.int64(
+                            jnp.where(next_team, now_s + jnp.int64(
                                 int(p.op_timeout * NS)), app.op_to)))
 
-        # DHTGetCall → storage probe + reply (DHT::handleGetRequest)
-        en = m.valid & (m.kind == wire.DHT_GET_CALL)
-        hit = (jnp.all(app.s_key == m.key[None, :], axis=-1)
-               & (app.s_val != NO_VAL) & (app.s_expire > now))
-        found = jnp.any(hit)
-        val = jnp.where(found, app.s_val[jnp.argmax(hit)], NO_VAL)
-        ob.send(en, now, m.src, wire.DHT_GET_RES, key=m.key, a=val, b=m.b,
-                size_b=wire.BASE_CALL_B + 8)
+        # DHTGetCall → storage probe + reply (DHT::handleGetRequest):
+        # one [R, D] probe for the whole batch
+        en_get = msgs.valid & (msgs.kind == wire.DHT_GET_CALL)
+        hit = (jnp.all(app.s_key[None, :, :] == msgs.key[:, None, :],
+                       axis=-1)
+               & (app.s_val != NO_VAL)[None, :]
+               & (app.s_expire[None, :] > now[:, None]))       # [R, D]
+        found = jnp.any(hit, axis=-1)
+        val = jnp.where(found,
+                        app.s_val[jnp.argmax(hit, axis=-1)], NO_VAL)
+        ob.send(en_get, now, msgs.src, wire.DHT_GET_RES, key=msgs.key,
+                a=val, b=msgs.b, size_b=wire.BASE_CALL_B + 8)
 
         # DHTGetResponse → quorum vote, then validate the winning value
         # vs the CURRENT truth (the reference hashes the responses and
         # requires a ratioIdentical majority, DHT.cc:620-648; DHTTestApp
         # reads GlobalDhtTestMap at response time, DHTTestApp.cc:121-182).
         # Nonce + key match guard against stale responses completing a
-        # newer GET with a mismatched value
+        # newer GET with a mismatched value.  The whole batch's votes
+        # fold in ONE scatter; the quorum evaluates once per tick.
         q = p.num_get_requests
         cur_key = (self._team_key(app.op_key, app.op_team)
                    if self.teams > 1 else app.op_key)
-        en = (m.valid & (m.kind == wire.DHT_GET_RES) & (app.op == OP_GET)
-              & (m.b == app.op_seq) & jnp.all(m.key == cur_key))
-        slot = jnp.where(en, jnp.clip(app.op_acks, 0, q - 1), q)
-        votes = app.op_votes.at[slot].set(m.a, mode="drop")
-        n_acks = app.op_acks + en.astype(I32)
-        filled = jnp.arange(q) < n_acks
-        counts = jnp.sum((votes[:, None] == votes[None, :])
-                         & filled[None, :], axis=1)
-        counts = jnp.where(filled, counts, 0)
+        en_v = (msgs.valid & (msgs.kind == wire.DHT_GET_RES)
+                & (app.op == OP_GET) & (msgs.b == app.op_seq)
+                & jnp.all(msgs.key == cur_key[None, :], axis=-1))   # [R]
+        en = jnp.any(en_v)
+        now_g = jnp.max(jnp.where(en_v, now, jnp.int64(0)))
+        rank = jnp.cumsum(en_v.astype(I32)) - en_v.astype(I32)
+        slot = jnp.where(en_v, jnp.clip(app.op_acks + rank, 0, q - 1), q)
+        votes = app.op_votes.at[slot].set(msgs.a, mode="drop")
+        n_acks = app.op_acks + jnp.sum(en_v.astype(I32), dtype=I32)
+        counts, winner = self._vote_winner(votes, n_acks)
         need = jnp.ceil(p.ratio_identical
                         * app.op_pending.astype(jnp.float32)).astype(I32)
         need = jnp.maximum(need, 1)
         win = en & jnp.any(counts >= need)
-        # tie-break: a value vote beats an equal count of notfound votes
-        # (the reference's hash-map iteration order breaks such ties
-        # arbitrarily; preferring data over absence is the sane engine
-        # behavior and keeps a partially-covered replica set readable)
-        counts_adj = counts * 2 + (votes != NO_VAL).astype(I32)
-        winner = votes[jnp.argmax(counts_adj)]
         exhausted = en & ~win & (n_acks >= app.op_pending)
-        # truth-map validation (DHTTestApp::handleGetResponse,
-        # DHTTestApp.cc:173-232): slot recycled (ring wrap) maps to the
-        # reference's entry==NULL error; expired truth means an empty
-        # result is SUCCESS ("deleted key gone") and a value is an error
-        # ("deleted key still available"); live truth compares values
-        g_n = ctx.glob.val.shape[0]
-        gslot = jnp.clip(app.op_g, 0, g_n - 1)
-        slot_ok = jnp.all(ctx.glob.keys[gslot] == app.op_key) & (
-            app.op_g >= 0)
-        expired = now > ctx.glob.expire[gslot]
-        expect = ctx.glob.val[gslot]
-        has_val = winner != NO_VAL
+        slot_ok, expired, has_val, good, wrong, nf = self._truth_outcomes(
+            ctx.glob, app.op_g, app.op_key, winner, now_g,
+            # gate on `win`: an exhausted vote with no ratioIdentical
+            # majority is a plain failure in the reference
+            # (DHT.cc:635-668 isSuccess false), not wrong data
+            final=jnp.bool_(True))
         # a live-truth team miss tries the NEXT replica team (variants;
         # the reference queries all teams in parallel and takes any hit)
         want_retry = (((win & ~has_val) | exhausted) & slot_ok
                       & ~expired)
         retry_team = want_retry & (app.op_team + 1 < self.teams)
         final = (win | exhausted) & ~retry_team
-        good = final & win & slot_ok & jnp.where(
-            expired, ~has_val, has_val & (winner == expect))
-        wrong = final & win & slot_ok & has_val & (
-            expired | (winner != expect))
+        good = good & final & win
+        wrong = wrong & final & win
         ev.count("dht_get_success", good)
-        # wrong-data = a QUORUM winner that mismatches the truth; an
-        # exhausted vote (responses in, no ratioIdentical majority) is a
-        # plain failure in the reference (DHT.cc:635-668 isSuccess
-        # false), not wrong data
         ev.count("dht_get_wrong", wrong)
-        ev.count("dht_get_notfound",
-                 final & win & ((slot_ok & ~expired & ~has_val)
-                                | ~slot_ok))
+        ev.count("dht_get_notfound", nf & final & win)
         ev.value("dht_get_latency_s",
-                 (now - app.op_t0).astype(jnp.float32) / NS, good)
+                 (now_g - app.op_t0).astype(jnp.float32) / NS, good)
         # NOTE: no votes/acks/pending reset here on retry_team — the
         # continuation lookup's completion resets them (on_lookup_done
         # is_get), stale-team responses are key-guarded out by cur_key,
@@ -809,7 +944,7 @@ class DhtApp:
             op_cont=app.op_cont | retry_team,
             op=jnp.where(final, OP_NONE, app.op),
             op_to=jnp.where(final, T_INF,
-                            jnp.where(retry_team, now + jnp.int64(
+                            jnp.where(retry_team, now_g + jnp.int64(
                                 int(p.op_timeout * NS)), app.op_to)))
         return app
 

@@ -20,13 +20,16 @@ Engine mapping (apps/base.py tier app over any KBR overlay):
 
 Longest-prefix anycast (I3::findClosestMatch, I3.h:56-120) over the
 32-bit trigger ids with a min_prefix_bits threshold.  Trigger stacks
-(id → continuation id) exist at the TABLE level: a matched trigger
-with tr_next set re-enters the local packet path instead of
-delivering (chains bounded by stack_hop_max).  The built-in workload
-registers plain triggers only, and a continuation living on another
-server is not followed across servers (the reference routes the
-repacketized id through the overlay; that needs the recursive route
-path) — documented deviation, exercised by the table-level unit test.
+(id → continuation id, bounded by stack_hop_max): a matched trigger
+with tr_next set repacketizes the payload to the continuation id.
+When the stack entry carries the continuation's full overlay key and
+the overlay processes recursive routes (``app.rcfg`` set), the
+repacketized id travels THROUGH the overlay to its own responsible
+server via KBR_ROUTE (the reference's cross-server identifier-stack
+forwarding, I3.h:56-120 + common/route.py); without a key or route
+support it falls back to a local table rematch.  The built-in random
+workload registers plain triggers; stacked triggers ride the same
+I3_INSERT wire format (continuation id in ``c``, full key in ``key``).
 """
 
 from __future__ import annotations
@@ -78,6 +81,11 @@ class I3State:
     tr_expire: jnp.ndarray  # [N, D] i64
     tr_next: jnp.ndarray   # [N, D] i32 — stack chaining: next trigger id
                            # the packet re-routes to (-1 = deliver)
+    tr_next_key: jnp.ndarray  # [N, D, KL] u32 — the continuation id's
+                           # FULL overlay key (the reference trigger
+                           # stack carries complete 256-bit ids,
+                           # I3.h:56-120 I3IdentifierStack); all-zero =
+                           # none known → local-rematch fallback
     # client timers
     t_ins: jnp.ndarray     # [N] i64
     t_send: jnp.ndarray    # [N] i64
@@ -110,6 +118,12 @@ class I3App:
         self.p = params
         self.spec = spec
         self.n = num_slots
+        # recursive-route config: set by the overlay (same late-binding
+        # convention as KbrTestApp.rcfg) when it processes KBR_ROUTE —
+        # enables CROSS-SERVER trigger-stack continuations (I3.h:56-120:
+        # the matched trigger's continuation id is repacketized and
+        # routed through the overlay).  None → local-rematch fallback.
+        self.rcfg = None
 
     def stat_spec(self):
         return dict(
@@ -126,6 +140,8 @@ class I3App:
             tr_owner=jnp.full((n, p.storage_slots), NO_NODE, I32),
             tr_expire=jnp.zeros((n, p.storage_slots), I64),
             tr_next=jnp.full((n, p.storage_slots), -1, I32),
+            tr_next_key=jnp.zeros(
+                (n, p.storage_slots, self.spec.lanes), jnp.uint32),
             t_ins=jnp.full((n,), T_INF, I64),
             t_send=jnp.full((n,), T_INF, I64),
             seq=jnp.zeros((n,), I32))
@@ -160,7 +176,7 @@ class I3App:
         col = jnp.argmax(valid).astype(I32)
         ob.send(has, now, handover, wire.I3_INSERT,
                 a=app.tr_id[col], b=app.tr_owner[col],
-                c=app.tr_next[col],
+                c=app.tr_next[col], key=app.tr_next_key[col],
                 stamp=app.tr_expire[col], size_b=wire.BASE_CALL_B + 12)
         ccol = jnp.where(has, col, app.tr_id.shape[0])
         return dataclasses.replace(
@@ -231,8 +247,11 @@ class I3App:
             tr_id=app.tr_id.at[col].set(m.a, mode="drop"),
             tr_owner=app.tr_owner.at[col].set(m.b, mode="drop"),
             tr_expire=app.tr_expire.at[col].set(m.stamp, mode="drop"),
-            # c carries the stack continuation id (-1 = plain trigger)
-            tr_next=app.tr_next.at[col].set(m.c, mode="drop"))
+            # c carries the stack continuation id (-1 = plain trigger);
+            # the wire key carries the continuation's FULL overlay key
+            # for cross-server forwarding
+            tr_next=app.tr_next.at[col].set(m.c, mode="drop"),
+            tr_next_key=app.tr_next_key.at[col].set(m.key, mode="drop"))
         ev.count("i3_stored", en)
 
         # data packet → longest-prefix anycast match
@@ -249,15 +268,39 @@ class I3App:
         matched = pl[best] >= p.min_prefix_bits
         owner = jnp.where(matched, app.tr_owner[best], NO_NODE)
         nxt_id = jnp.where(matched, app.tr_next[best], -1)
-        # trigger stacks: a matched trigger with a continuation id
-        # re-enters the packet path addressed to that id (self-send —
-        # the rematch next tick walks local chains; cross-server stack
-        # segments would ride the client's lookup path, not modeled),
-        # bounded by stack_hop_max; plain triggers deliver to the owner
-        chain = en & matched & (nxt_id >= 0) & (m.hops < p.stack_hop_max)
+        nxt_key = app.tr_next_key[best]
+        # trigger stacks (I3.h:56-120): a matched trigger with a
+        # continuation id repacketizes the payload addressed to that id.
+        # Chain depth rides ``c`` (``hops`` belongs to the route layer),
+        # bounded by stack_hop_max; plain triggers deliver to the owner.
+        chain = en & matched & (nxt_id >= 0) & (m.c < p.stack_hop_max)
         deliver = en & (owner != NO_NODE) & ~chain
-        ob.send(chain, now, m.dst, wire.I3_PACKET, a=nxt_id,
-                b=m.b, hops=m.hops + 1, stamp=m.stamp,
+        # CROSS-SERVER continuation: when the stored stack entry carries
+        # the continuation's full overlay key and the overlay processes
+        # recursive routes, the repacketized id is routed THROUGH the
+        # overlay to its own responsible server (the reference's
+        # sendPacket on the popped identifier stack) via a KBR_ROUTE
+        # self-send — the overlay decapsulates it back into I3_PACKET at
+        # the responsible node, where the match/chain cycle repeats.
+        if self.rcfg is not None:
+            ew = self.rcfg.ext_words
+            vis0 = jnp.full(m.nodes.shape, NO_NODE, I32).at[ew].set(
+                m.dst)
+            if ew:
+                vis0 = vis0.at[:ew].set(0)
+            have_key = jnp.any(nxt_key != 0)
+            cross = chain & have_key
+            ob.send(cross, now, m.dst, wire.KBR_ROUTE, key=nxt_key,
+                    d=jnp.int32(wire.I3_PACKET), a=nxt_id, b=m.b,
+                    c=m.c + 1, hops=0, nodes=vis0, stamp=m.stamp,
+                    size_b=p.payload_bytes + self.rcfg.overhead_b)
+            chain_local = chain & ~have_key
+        else:
+            chain_local = chain
+        # local-rematch fallback (no full key / no recursive routing):
+        # the packet re-enters this server's own table next tick
+        ob.send(chain_local, now, m.dst, wire.I3_PACKET, a=nxt_id,
+                b=m.b, c=m.c + 1, stamp=m.stamp,
                 size_b=p.payload_bytes)
         ob.send(deliver, now, jnp.maximum(owner, 0),
                 wire.I3_DELIVER, a=m.a, b=m.b, stamp=m.stamp,

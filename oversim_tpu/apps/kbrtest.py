@@ -171,13 +171,20 @@ class KbrTestApp:
         dup = cand & (dup_buf | earlier)
         fresh = cand & ~dup
         rank = jnp.cumsum(fresh.astype(I32)) - fresh.astype(I32)
-        pos = jnp.where(fresh, (app.seen_ptr + rank) % b, b)
+        # a batch with more than ``b`` fresh entries would wrap the ring
+        # WITHIN one scatter — later lanes silently overwriting earlier
+        # ones that then never entered the dedup ring.  Overflow lanes
+        # are dropped from insertion instead (still screened this batch
+        # via ``earlier``; the reference ring is bounded the same way,
+        # KBRTestApp.cc:458-476 overwrites oldest)
+        ins = fresh & (rank < b)
+        pos = jnp.where(ins, (app.seen_ptr + rank) % b, b)
         app = dataclasses.replace(
             app,
             seen_src=app.seen_src.at[pos].set(src, mode="drop"),
             seen_seq=app.seen_seq.at[pos].set(seq, mode="drop"),
             seen_ptr=(app.seen_ptr
-                      + jnp.sum(fresh.astype(I32), dtype=I32)) % b)
+                      + jnp.sum(ins.astype(I32), dtype=I32)) % b)
         return app, dup
 
     def glob_init(self, rng):

@@ -76,6 +76,26 @@ class TierStack:
             if hasattr(a, "rcfg"):
                 a.rcfg = value
 
+    # dist_fn pass-through: ring overlays patch ``app.dist_fn`` with
+    # their responsibility metric (chord.py:173/pastry.py) — without
+    # this forwarding, a DhtApp INSIDE a stack would silently keep the
+    # XOR fallback and the maintenance responsibility filter would
+    # judge ring keyspace with the wrong metric
+    @property
+    def dist_fn(self):
+        # None while ANY member still awaits its metric, so the
+        # overlay's ``getattr(app, "dist_fn", "no") is None`` probe
+        # fires and the setter fans out
+        if any(getattr(a, "dist_fn", "set") is None for a in self.apps):
+            return None
+        return getattr(self.apps[0], "dist_fn", None)
+
+    @dist_fn.setter
+    def dist_fn(self, value):
+        for a in self.apps:
+            if getattr(a, "dist_fn", "set") is None:
+                a.dist_fn = value
+
     def stat_spec(self):
         out = dict(scalars=(), hists=(), counters=())
         for a in self.apps:
@@ -136,7 +156,12 @@ class TierStack:
     def on_timer(self, states, en, ctx, now, rng, ev, node_idx):
         T = len(self.apps)
         rngs = jax.random.split(rng, T)
-        nevs = jnp.stack([a.next_event(s)
+        # pick on each tier's on_timer-relevant clock (timer_event when
+        # defined), NOT next_event: the DHT maintenance pump holds
+        # next_event at 0 for its whole duration (it runs via on_tick)
+        # and would monopolize the stack's one timer slot per window,
+        # deferring other tiers' rpc-timeout processing unboundedly
+        nevs = jnp.stack([getattr(a, "timer_event", a.next_event)(s)
                           for a, s in zip(self.apps, states)])
         pick = jnp.argmin(nevs).astype(I32)
         new_states = []
@@ -203,10 +228,12 @@ class TierStack:
                 veto = veto | a.forward(s, msgs, self._ctx(ctx, i))
         return veto
 
-    def _on_update(self, states, en, ctx, ob, ev, now, node_idx, added):
+    def _on_update(self, states, en, ctx, ob, ev, now, node_idx, added,
+                   sib_keys=None, sib_valid=None, urgent=None):
         return tuple(
             a.on_update(s, en, self._ctx(ctx, i), ob, ev, now, node_idx,
-                        added)
+                        added, sib_keys=sib_keys, sib_valid=sib_valid,
+                        urgent=urgent)
             if hasattr(a, "on_update") else s
             for i, (a, s) in enumerate(zip(self.apps, states)))
 

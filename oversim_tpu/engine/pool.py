@@ -20,6 +20,15 @@ A message carries: src/dst slot, kind, a key, a nonce, hop count, four i32
 payload scalars, and a node-list payload of RMAX slot indices (the
 FindNodeResponse closest-node set, CommonMessages.msg:246-262, travels as
 slot indices — node keys are recoverable from the global key table).
+
+Packed layout (PERFORMANCE.md lever #3): every 32-bit field — the ten
+i32 scalars, the key lanes (bitcast u32↔i32) and the RMAX node list —
+lives in ONE [P, W] i32 block, so the per-tick inbox build is one gather
+and the outbox allocation one scatter, instead of 12+ of each
+field-by-field.  Only the two i64 fields (t_deliver, stamp) and the
+valid mask stay separate; per-field access is provided by zero-copy
+column-slice properties, keeping the old field API for host-side readers
+(gateway drain, xmlrpcif) and the Msg view builder.
 """
 
 from __future__ import annotations
@@ -35,54 +44,109 @@ U32 = jnp.uint32
 T_INF = jnp.int64(2**62)
 NO_NODE = jnp.int32(-1)
 
-
-@jax.tree_util.register_dataclass
-@dataclasses.dataclass
-class MsgPool:
-    """All arrays [P, ...]."""
-
-    valid: jnp.ndarray      # [P] bool
-    t_deliver: jnp.ndarray  # [P] i64 ns
-    src: jnp.ndarray        # [P] i32
-    dst: jnp.ndarray        # [P] i32
-    kind: jnp.ndarray       # [P] i32
-    key: jnp.ndarray        # [P, KL] u32
-    nonce: jnp.ndarray      # [P] i32
-    hops: jnp.ndarray       # [P] i32
-    a: jnp.ndarray          # [P] i32
-    b: jnp.ndarray          # [P] i32
-    c: jnp.ndarray          # [P] i32
-    d: jnp.ndarray          # [P] i32
-    nodes: jnp.ndarray      # [P, RMAX] i32 (NO_NODE padded)
-    size_b: jnp.ndarray     # [P] i32 payload bytes (for delay model + stats)
-    stamp: jnp.ndarray      # [P] i64 ns timestamp payload (e.g. send time for
-                            # app-latency stats; reference keeps simTime() in
-                            # message fields, KBRTestApp.cc measurement path)
-
-    @property
-    def capacity(self):
-        return self.valid.shape[0]
-
+# column layout of the packed block: scalars first, then key lanes, then
+# the node list
+SCAL_COLS = ("src", "dst", "kind", "nonce", "hops", "a", "b", "c", "d",
+             "size_b")
+_COL = {name: i for i, name in enumerate(SCAL_COLS)}
 
 FIELDS = ("t_deliver", "src", "dst", "kind", "key", "nonce", "hops",
           "a", "b", "c", "d", "nodes", "size_b", "stamp")
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MsgPool:
+    """Packed pool: [P] masks/times + one [P, W] i32 payload block."""
+
+    valid: jnp.ndarray      # [P] bool
+    t_deliver: jnp.ndarray  # [P] i64 ns
+    stamp: jnp.ndarray      # [P] i64 ns timestamp payload (send time for
+                            # app-latency stats; reference keeps simTime()
+                            # in message fields, KBRTestApp.cc)
+    blk: jnp.ndarray        # [P, W] i32 — SCAL_COLS + key lanes + nodes
+    kl: int = dataclasses.field(metadata=dict(static=True), default=5)
+    rmax: int = dataclasses.field(metadata=dict(static=True), default=8)
+
+    @property
+    def capacity(self):
+        return self.valid.shape[0]
+
+    # -- zero-copy column views (old field API) --------------------------
+    @property
+    def src(self):
+        return self.blk[:, _COL["src"]]
+
+    @property
+    def dst(self):
+        return self.blk[:, _COL["dst"]]
+
+    @property
+    def kind(self):
+        return self.blk[:, _COL["kind"]]
+
+    @property
+    def nonce(self):
+        return self.blk[:, _COL["nonce"]]
+
+    @property
+    def hops(self):
+        return self.blk[:, _COL["hops"]]
+
+    @property
+    def a(self):
+        return self.blk[:, _COL["a"]]
+
+    @property
+    def b(self):
+        return self.blk[:, _COL["b"]]
+
+    @property
+    def c(self):
+        return self.blk[:, _COL["c"]]
+
+    @property
+    def d(self):
+        return self.blk[:, _COL["d"]]
+
+    @property
+    def size_b(self):
+        return self.blk[:, _COL["size_b"]]
+
+    @property
+    def key(self):
+        s = len(SCAL_COLS)
+        return jax.lax.bitcast_convert_type(
+            self.blk[..., s:s + self.kl], U32)
+
+    @property
+    def nodes(self):
+        return self.blk[..., len(SCAL_COLS) + self.kl:]
+
+
+def pack_block(out: dict, kl: int, rmax: int):
+    """Pack a field dict ([Q]-leading arrays, the Outbox.finish() /
+    gateway-inject format) into the [Q, W] i32 block."""
+    cols = [jnp.asarray(out[name], I32)[:, None] for name in SCAL_COLS]
+    cols.append(jax.lax.bitcast_convert_type(
+        jnp.asarray(out["key"], U32), I32).reshape(-1, kl))
+    cols.append(jnp.asarray(out["nodes"], I32).reshape(-1, rmax))
+    return jnp.concatenate(cols, axis=1)
+
+
 def empty(p: int, key_lanes: int, rmax: int) -> MsgPool:
+    w = len(SCAL_COLS) + key_lanes + rmax
+    blk = jnp.zeros((p, w), I32)
+    blk = blk.at[:, _COL["src"]].set(NO_NODE)
+    blk = blk.at[:, _COL["dst"]].set(NO_NODE)
+    blk = blk.at[:, len(SCAL_COLS) + key_lanes:].set(NO_NODE)
     return MsgPool(
         valid=jnp.zeros((p,), bool),
         t_deliver=jnp.full((p,), T_INF, I64),
-        src=jnp.full((p,), NO_NODE, I32),
-        dst=jnp.full((p,), NO_NODE, I32),
-        kind=jnp.zeros((p,), I32),
-        key=jnp.zeros((p, key_lanes), U32),
-        nonce=jnp.zeros((p,), I32),
-        hops=jnp.zeros((p,), I32),
-        a=jnp.zeros((p,), I32), b=jnp.zeros((p,), I32),
-        c=jnp.zeros((p,), I32), d=jnp.zeros((p,), I32),
-        nodes=jnp.full((p, rmax), NO_NODE, I32),
-        size_b=jnp.zeros((p,), I32),
         stamp=jnp.zeros((p,), I64),
+        blk=blk,
+        kl=key_lanes,
+        rmax=rmax,
     )
 
 
@@ -135,6 +199,9 @@ def alloc(pool: MsgPool, out: dict, want):
 
     ``out`` maps field name -> [Q, ...] flattened outbox arrays;
     ``want`` is [Q] bool.  Returns (pool', overflow_count).
+
+    One gather + ONE scatter for the whole 32-bit payload (the packed
+    block), plus the two i64 fields and the valid mask.
     """
     p = pool.capacity
     q = want.shape[0]
@@ -154,10 +221,14 @@ def alloc(pool: MsgPool, out: dict, want):
     slots = jnp.where(ok, fslot[:k], p)  # p = out-of-bounds, dropped
     srcs = wsrc[:k]
 
-    new = {}
-    for name in FIELDS:
-        cur = getattr(pool, name)
-        new[name] = cur.at[slots].set(out[name][srcs], mode="drop")
-    valid = pool.valid.at[slots].set(True, mode="drop")
+    out_blk = pack_block(out, pool.kl, pool.rmax)
+    new_pool = dataclasses.replace(
+        pool,
+        blk=pool.blk.at[slots].set(out_blk[srcs], mode="drop"),
+        t_deliver=pool.t_deliver.at[slots].set(
+            jnp.asarray(out["t_deliver"], I64)[srcs], mode="drop"),
+        stamp=pool.stamp.at[slots].set(
+            jnp.asarray(out["stamp"], I64)[srcs], mode="drop"),
+        valid=pool.valid.at[slots].set(True, mode="drop"))
     overflow = jnp.maximum(n_want - n_free, 0)
-    return MsgPool(valid=valid, **new), overflow
+    return new_pool, overflow

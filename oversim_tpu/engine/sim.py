@@ -178,19 +178,25 @@ class Simulation:
         logic_state = logic.reset(s.logic, created | killed, created, t_next,
                                   r_reset)
 
-        # 3. inbox
+        # 3. inbox — ONE gather of the packed [P, W] block for all the
+        # 32-bit fields (pool.py packed layout, PERFORMANCE.md lever #3)
         inbox, delivered, to_dead = pool_mod.build_inbox(
             s.pool, n, ep.inbox_slots, t_end, alive)
         safe = jnp.maximum(inbox, 0)
+        blk = s.pool.blk[safe]                        # [N, R, W]
+        ncol = len(pool_mod.SCAL_COLS)
+        col = lambda name: blk[..., pool_mod._COL[name]]  # noqa: E731
         msgs = Msg(
             valid=inbox >= 0,
             t_deliver=jnp.maximum(s.pool.t_deliver[safe], t_next),
-            src=s.pool.src[safe], dst=s.pool.dst[safe],
-            kind=s.pool.kind[safe], key=s.pool.key[safe],
-            nonce=s.pool.nonce[safe], hops=s.pool.hops[safe],
-            a=s.pool.a[safe], b=s.pool.b[safe],
-            c=s.pool.c[safe], d=s.pool.d[safe],
-            nodes=s.pool.nodes[safe], size_b=s.pool.size_b[safe],
+            src=col("src"), dst=col("dst"),
+            kind=col("kind"),
+            key=jax.lax.bitcast_convert_type(
+                blk[..., ncol:ncol + s.pool.kl], jnp.uint32),
+            nonce=col("nonce"), hops=col("hops"),
+            a=col("a"), b=col("b"),
+            c=col("c"), d=col("d"),
+            nodes=blk[..., ncol + s.pool.kl:], size_b=col("size_b"),
             stamp=s.pool.stamp[safe])
 
         # 4. context + vmapped node step

@@ -83,7 +83,8 @@ class RealtimeGateway:
 
     def __init__(self, sim, state, gw_slot: int = 0,
                  udp_port: int = 0, tcp_port: int | None = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 stun_server: tuple | None = None):
         self.sim = sim
         self.state = state
         self.gw = gw_slot
@@ -95,6 +96,23 @@ class RealtimeGateway:
         self.udp.bind((host, udp_port))
         self.udp.setblocking(False)
         self.udp_port = self.udp.getsockname()[1]
+        # STUN bootstrap (SingleHostUnderlayConfigurator.cc:108-134 —
+        # **.stunServer learns the public address before joining): the
+        # binding request goes out the OVERLAY's own UDP socket so the
+        # reflexive address maps this very port.  public_addr falls
+        # back to the local bind when no server is given/reachable.
+        self.public_addr = (host, self.udp_port)
+        self.nat_detected = False
+        if stun_server is not None:
+            from oversim_tpu import singlehost as _sh
+            mapped = _sh.stun_discover(self.udp, stun_server)
+            if mapped is not None:
+                self.public_addr = mapped
+                # NAT is only attributable when the bind address is a
+                # concrete interface IP — a wildcard bind has no local
+                # address to compare the reflexive one against
+                self.nat_detected = (host not in ("0.0.0.0", "::", "")
+                                     and mapped != (host, self.udp_port))
         self.tcp = None
         self.tcp_port = None
         self._tcp_conns: dict = {}      # session id -> (sock, rx buffer)

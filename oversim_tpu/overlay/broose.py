@@ -304,7 +304,7 @@ class BrooseLogic:
         dup = K.dup_mask(aug) | (aug == NO_NODE)
         aug = jnp.where(dup, NO_NODE, aug)
         d = self._xor_to(ctx, aug, bkey)
-        _, (aug_s, seen_s) = K.sort_by_distance(d, (aug, aseen))
+        _, (aug_s, seen_s) = K.sort_by_distance(d, (aug, aseen), approx=True)
         return aug_s[:cap], jnp.where(aug_s[:cap] == NO_NODE, 0, seen_s[:cap])
 
     def _routing_add(self, ctx, st, me_key, node_idx, cands, alive, now):
@@ -451,7 +451,7 @@ class BrooseLogic:
         sort_key = jnp.where(brother, key, rk2)
         d = self._xor_to(ctx, cands, sort_key)
         d = jnp.where(K.dup_mask(cands)[:, None], UMAX, d)
-        _, (cands_s,) = K.sort_by_distance(d, (cands,))
+        _, (cands_s,) = K.sort_by_distance(d, (cands,), approx=True)
         res = cands_s[:rmax]
         if res.shape[0] < rmax:
             res = jnp.concatenate(

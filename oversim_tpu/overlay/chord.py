@@ -128,7 +128,7 @@ class ChordState:
 
 
 def _sort_lanes(dist, payload):
-    return K.sort_by_distance(dist, payload)[1]
+    return K.sort_by_distance(dist, payload, approx=True)[1]
 
 
 def _lex_argmin(dist):
@@ -443,6 +443,7 @@ class ChordLogic:
         lksucc_cnt = jnp.int32(0)
         routedrop_cnt = jnp.int32(0)
         old_succ = st.succ                   # update() delta base
+        old_pred = st.pred
 
         # --------------------------------------------- inbox (batched) -----
         # Kind-major batching: each message kind is handled in ONE masked
@@ -1268,9 +1269,26 @@ class ChordLogic:
                 (st.succ != NO_NODE)
                 & ~jnp.any(st.succ[:, None] == old_succ[None, :], axis=1),
                 st.succ, NO_NODE)
+            # a NEW PREDECESSOR is an ownership transfer: the joiner
+            # inherits the keyspace between the old and new pred, and
+            # must receive this node's records for it.  The reference
+            # reaches the same spot via the isSiblingFor err-hack
+            # (DHT.cc:779-797 "For Chord: we've got a new predecessor"
+            # → sendMaintenancePutCall regardless) — without it every
+            # join creates a data-less primary and DHT get-success
+            # erodes under churn.  Listed FIRST so the app's one-target
+            # stager prioritizes the ownership transfer over ordinary
+            # succ-list deltas.
+            new_pred = jnp.where(
+                (st.pred != NO_NODE) & (st.pred != old_pred)
+                & (st.pred != node_idx), st.pred, NO_NODE)
+            new_in = jnp.concatenate([new_pred[None], new_in])
             st = dataclasses.replace(st, app=self.app.on_update(
                 st.app, st.state == READY, ctx, ob, ev, t0, node_idx,
-                new_in))
+                new_in,
+                sib_keys=ctx.keys[jnp.maximum(st.succ, 0)],
+                sib_valid=st.succ != NO_NODE,
+                urgent=new_pred != NO_NODE))
 
         # ------------------------------------------------------ events -----
         events = {

@@ -240,7 +240,8 @@ class EpiChordLogic:
         d = K.sub(ck, me_b, self.key_spec) if clockwise \
             else K.sub(me_b, ck, self.key_spec)
         d = jnp.where(bad[:, None], UMAX, d)
-        _, (c_s, bad_s) = K.sort_by_distance(d, (cands, bad.astype(I32)))
+        _, (c_s, bad_s) = K.sort_by_distance(d, (cands, bad.astype(I32)),
+                                             approx=True)
         out = jnp.where(bad_s[:s] != 0, NO_NODE, c_s[:s])
         if out.shape[0] < s:
             out = jnp.concatenate(
@@ -392,7 +393,7 @@ class EpiChordLogic:
             src_ok & (cands == src)) | (cands == head) | K.dup_mask(cands)
         d = K.sub(ck, jnp.broadcast_to(key, ck.shape), spec)  # cw key→cand
         d = jnp.where(bad[:, None], UMAX, d)
-        _, (c_s,) = K.sort_by_distance(d, (cands,))
+        _, (c_s,) = K.sort_by_distance(d, (cands,), approx=True)
         res = jnp.full((rmax,), NO_NODE, I32)
         res = res.at[0].set(jnp.where(head != NO_NODE, head, c_s[0]))
         take = min(p.redundant_nodes, rmax - 1)

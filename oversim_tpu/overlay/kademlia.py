@@ -284,7 +284,7 @@ class KademliaLogic:
         bad = (c == NO_NODE) | (c == node_idx) | K.dup_mask(c)
         c = jnp.where(bad, NO_NODE, c)
         d = self._xor_to(ctx, c, me_key)
-        (c_s,) = K.sort_by_distance(d, (c,))[1]
+        (c_s,) = K.sort_by_distance(d, (c,), approx=True)[1]
         new_sib = c_s[:s]
         # displaced: previously a sibling, no longer one
         was = sib != NO_NODE
@@ -489,7 +489,8 @@ class KademliaLogic:
         d = ck[None, :, :] ^ keys[:, None, :]                      # [T, C, KL]
         d = jnp.where((cands == NO_NODE)[None, :, None], UMAX, d)
         (c_s,) = K.sort_by_distance(
-            d, (jnp.broadcast_to(cands, (t_dim, cands.shape[0])),))[1]
+            d, (jnp.broadcast_to(cands, (t_dim, cands.shape[0])),),
+            approx=True)[1]
         ready = st.state == READY
         out = jnp.where(ready, c_s[:, :rmax], NO_NODE)
         r = p.redundant_nodes
@@ -526,7 +527,7 @@ class KademliaLogic:
             st.sib != NO_NODE)
         sib_masked = jnp.where(hit, NO_NODE, st.sib)
         d = self._xor_to(ctx, sib_masked, me_key)
-        (sib_s,) = K.sort_by_distance(d, (sib_masked,))[1]
+        (sib_s,) = K.sort_by_distance(d, (sib_masked,), approx=True)[1]
         st = dataclasses.replace(
             st, sib=jnp.where(en, sib_s, st.sib))
         # bucket stale increment (one strike per batch occurrence)
@@ -884,7 +885,7 @@ class KademliaLogic:
         local = req.want & sib_a
         loc_cands = jnp.concatenate([node_idx[None], st.sib])
         loc_d = self._xor_to(ctx, loc_cands, req.key)
-        (loc_s,) = K.sort_by_distance(loc_d, (loc_cands,))[1]
+        (loc_s,) = K.sort_by_distance(loc_d, (loc_cands,), approx=True)[1]
         res_local = loc_s[:lcfg.frontier]
         if res_local.shape[0] < lcfg.frontier:
             res_local = jnp.concatenate([res_local, jnp.full(
@@ -1070,7 +1071,9 @@ class KademliaLogic:
                 st.sib, NO_NODE)
             st = dataclasses.replace(st, app=self.app.on_update(
                 st.app, st.state == READY, ctx, ob, ev, t0, node_idx,
-                new_in))
+                new_in,
+                sib_keys=ctx.keys[jnp.maximum(st.sib, 0)],
+                sib_valid=st.sib != NO_NODE))
 
         # ------------------------------------------------------ events -----
         events = {

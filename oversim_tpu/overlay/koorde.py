@@ -224,7 +224,7 @@ class KoordeLogic(ChordLogic):
         ek = ctx.keys[jnp.maximum(lst, 0)]
         d = K.sub(jnp.broadcast_to(key, ek.shape), ek, spec)
         d = jnp.where((lst == NO_NODE)[:, None], jnp.uint32(0xFFFFFFFF), d)
-        (srt,) = K.sort_by_distance(d, (lst,))[1]
+        (srt,) = K.sort_by_distance(d, (lst,), approx=True)[1]
         return jnp.where(jnp.any(lst != NO_NODE), srt[0], NO_NODE)
 
     def _find_start_key(self, me_key, s0k, key):

@@ -231,7 +231,8 @@ class PastryLogic:
         d = K.sub(ck, me_b, self.key_spec) if clockwise \
             else K.sub(me_b, ck, self.key_spec)
         d = jnp.where(bad[:, None], UMAX, d)
-        (c_s, bad_s) = K.sort_by_distance(d, (cands, bad.astype(I32)))[1]
+        (c_s, bad_s) = K.sort_by_distance(d, (cands, bad.astype(I32)),
+                                          approx=True)[1]
         return jnp.where(bad_s[:h] != 0, NO_NODE, c_s[:h])
 
     def _leaf_merge(self, ctx, st, me_key, node_idx, cands, en):
@@ -323,7 +324,7 @@ class PastryLogic:
             ctx.keys[jnp.maximum(cw_far, 0)], spec)
         leafs = self._leafset_nodes(st, node_idx)
         d_leafs = kdist(leafs, key)
-        (leafs_s,) = K.sort_by_distance(d_leafs, (leafs,))[1]
+        (leafs_s,) = K.sort_by_distance(d_leafs, (leafs,), approx=True)[1]
         leaf_dest = leafs_s[0]
 
         # routing table hop (PastryRoutingTable::lookupNextHop)
@@ -345,7 +346,7 @@ class PastryLogic:
         kpfx = K.shared_prefix_digits(kk, key_b, p.bits_per_digit, spec)
         ok = (known != NO_NODE) & closer & (kpfx >= pfx)
         df = jnp.where(ok[:, None], dk, UMAX)
-        (fb_s,) = K.sort_by_distance(df, (known,))[1]
+        (fb_s,) = K.sort_by_distance(df, (known,), approx=True)[1]
         fallback = jnp.where(jnp.any(ok), fb_s[0], NO_NODE)
 
         # result set: sibling case → closest leafs (replica set); else hop
@@ -764,7 +765,9 @@ class PastryLogic:
                 new_leaf, NO_NODE)
             st = dataclasses.replace(st, app=self.app.on_update(
                 st.app, st.state == READY, ctx, ob, ev, t0, node_idx,
-                new_in))
+                new_in,
+                sib_keys=ctx.keys[jnp.maximum(new_leaf, 0)],
+                sib_valid=new_leaf != NO_NODE))
 
         events = {
             "c:pastry_joins": joins_cnt,

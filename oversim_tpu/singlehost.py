@@ -336,3 +336,160 @@ class ZeroconfDiscovery:
 
     def close(self):
         self.sock.close()
+
+
+# ---------------------------------------------------------------------------
+# STUN NAT traversal (reference src/underlay/singlehostunderlay/stun/)
+# ---------------------------------------------------------------------------
+#
+# The reference bundles the classic vovida STUN 0.96 client (stun.{h,cc}:
+# BindRequestMsg/BindResponseMsg, MappedAddress/XorMappedAddress attrs,
+# stunNatType()) and calls it from SingleHostUnderlayConfigurator.cc:108-134
+# to learn the node's public address before joining.  This is the modern
+# equivalent: an RFC 5389 binding-request client (magic-cookie header,
+# XOR-MAPPED-ADDRESS, RTO-doubling retransmission) that also understands
+# the classic MAPPED-ADDRESS replies the reference's library sends, plus a
+# loopback responder for tests.
+
+STUN_MAGIC = 0x2112A442
+STUN_BIND_REQ = 0x0001        # BindRequestMsg, stun.h:53
+STUN_BIND_RES = 0x0101        # BindResponseMsg
+STUN_ATTR_MAPPED = 0x0001     # MappedAddress, stun.h:36
+STUN_ATTR_XOR_MAPPED = 0x0020  # RFC 5389 (classic library used 0x8020)
+STUN_ATTR_XOR_MAPPED_OLD = 0x8020
+_STUN_HDR = struct.Struct("!HHI12s")
+
+
+def build_binding_request(txid: bytes) -> bytes:
+    """RFC 5389 §6 binding request (no attributes)."""
+    if len(txid) != 12:
+        raise ValueError("txid must be 12 bytes")
+    return _STUN_HDR.pack(STUN_BIND_REQ, 0, STUN_MAGIC, txid)
+
+
+def build_binding_response(txid: bytes, ip: str, port: int,
+                           xor_mapped: bool = True) -> bytes:
+    """Binding success response carrying the reflexive transport address."""
+    fam = 0x01
+    addr = struct.unpack("!I", socket.inet_aton(ip))[0]
+    if xor_mapped:
+        attr_v = struct.pack("!BBHI", 0, fam, port ^ (STUN_MAGIC >> 16),
+                             addr ^ STUN_MAGIC)
+        attr = struct.pack("!HH", STUN_ATTR_XOR_MAPPED, 8) + attr_v
+    else:
+        attr_v = struct.pack("!BBHI", 0, fam, port, addr)
+        attr = struct.pack("!HH", STUN_ATTR_MAPPED, 8) + attr_v
+    return _STUN_HDR.pack(STUN_BIND_RES, len(attr), STUN_MAGIC, txid) + attr
+
+
+def parse_stun(data: bytes):
+    """Parse a STUN message → dict(type, txid, mapped=(ip, port) | None).
+    Returns None for non-STUN data (first two bits must be 00 and the
+    magic cookie must match — RFC 5389 §6 demultiplexing)."""
+    if len(data) < _STUN_HDR.size or data[0] & 0xC0:
+        return None
+    mtype, mlen, magic, txid = _STUN_HDR.unpack_from(data)
+    if magic != STUN_MAGIC or len(data) < _STUN_HDR.size + mlen:
+        return None
+    out = {"type": mtype, "txid": txid, "mapped": None}
+    off = _STUN_HDR.size
+    end = off + mlen
+    while off + 4 <= end:
+        at, alen = struct.unpack_from("!HH", data, off)
+        off += 4
+        if off + alen > end:
+            break
+        val = data[off:off + alen]
+        off += alen + ((4 - alen % 4) % 4)          # attrs pad to 32 bits
+        if alen == 8 and at in (STUN_ATTR_XOR_MAPPED,
+                                STUN_ATTR_XOR_MAPPED_OLD):
+            _, fam, xport, xaddr = struct.unpack("!BBHI", val)
+            if fam == 0x01:
+                out["mapped"] = (
+                    socket.inet_ntoa(struct.pack("!I", xaddr ^ STUN_MAGIC)),
+                    xport ^ (STUN_MAGIC >> 16))
+        elif alen == 8 and at == STUN_ATTR_MAPPED and out["mapped"] is None:
+            _, fam, port, addr = struct.unpack("!BBHI", val)
+            if fam == 0x01:
+                out["mapped"] = (socket.inet_ntoa(struct.pack("!I", addr)),
+                                 port)
+    return out
+
+
+def stun_discover(sock, server, rto_s: float = 0.5, retries: int = 3):
+    """Send a binding request from ``sock`` and return the reflexive
+    (ip, port) the server saw, or None.
+
+    RFC 5389 §7.2.1 retransmission: RTO doubles per attempt (the
+    reference's stunNatType() drives the same request/timeout loop,
+    stun.cc).  Uses the caller's socket so the mapped address
+    corresponds to the port the overlay will actually use — the whole
+    point of the exercise for NAT traversal."""
+    import os as _os
+    txid = _os.urandom(12)
+    req = build_binding_request(txid)
+    old_to = sock.gettimeout()
+    try:
+        for attempt in range(retries):
+            try:
+                sock.sendto(req, server)
+            except OSError:
+                return None
+            deadline = time.time() + rto_s * (2 ** attempt)
+            while True:
+                remain = deadline - time.time()
+                if remain <= 0:
+                    break
+                sock.settimeout(remain)
+                try:
+                    data, _addr = sock.recvfrom(2048)
+                except (socket.timeout, OSError):
+                    break
+                msg = parse_stun(data)
+                if (msg and msg["type"] == STUN_BIND_RES
+                        and msg["txid"] == txid and msg["mapped"]):
+                    return msg["mapped"]
+        return None
+    finally:
+        sock.settimeout(old_to)
+
+
+class StunResponder:
+    """Minimal loopback STUN server (test double for a public server —
+    the role stunServer plays in SingleHostUnderlayConfigurator.cc:108).
+    Replies to binding requests with the sender's reflexive address;
+    ``classic=True`` answers with the pre-RFC-5389 MAPPED-ADDRESS the
+    reference's vovida library would send."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 classic: bool = False):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind((host, port))
+        self.addr = self.sock.getsockname()
+        self.classic = classic
+        self._stop = False
+        import threading
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self.sock.settimeout(0.1)
+        while not self._stop:
+            try:
+                data, addr = self.sock.recvfrom(2048)
+            except (socket.timeout, OSError):
+                continue
+            msg = parse_stun(data)
+            if msg and msg["type"] == STUN_BIND_REQ:
+                try:
+                    self.sock.sendto(
+                        build_binding_response(
+                            msg["txid"], addr[0], addr[1],
+                            xor_mapped=not self.classic), addr)
+                except OSError:
+                    pass
+
+    def close(self):
+        self._stop = True
+        self._thread.join(timeout=1.0)
+        self.sock.close()

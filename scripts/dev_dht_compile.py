@@ -1,0 +1,63 @@
+"""Measure trace/lower/compile time of the chord+DHT step vs inbox width
+— evidence for the unrolled-on_msg compile blowup (VERDICT r4 weak #6)."""
+
+import os
+import sys
+import time
+
+sys.modules["zstandard"] = None
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in flags:
+    flags += (" --xla_backend_optimization_level=0"
+              " --xla_llvm_disable_expensive_passes=true")
+os.environ["XLA_FLAGS"] = flags
+
+import jax  # noqa: E402
+
+from jax._src import compilation_cache as _cc  # noqa: E402
+if getattr(_cc, "zstandard", None) is not None:
+    _cc.zstandard = None
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_compilation_cache", False)
+
+sys.path.insert(0, "/root/repo")
+
+from oversim_tpu import churn as churn_mod  # noqa: E402
+from oversim_tpu.apps.dht import DhtApp, DhtParams  # noqa: E402
+from oversim_tpu.engine import sim as sim_mod  # noqa: E402
+from oversim_tpu.overlay.chord import ChordLogic  # noqa: E402
+
+
+def probe(inbox, chunk=16):
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=16,
+                               init_interval=0.5, lifetime_mean=600.0,
+                               graceful_leave_delay=15.0,
+                               graceful_leave_probability=1.0)
+    logic = ChordLogic(app=DhtApp(DhtParams(test_interval=20.0,
+                                            test_ttl=600.0)))
+    s = sim_mod.Simulation(logic, cp,
+                           engine_params=sim_mod.EngineParams(
+                               window=0.05, transition_time=60.0,
+                               inbox_slots=inbox))
+    st = s.init(seed=4)
+    t0 = time.time()
+    lowered = jax.jit(
+        lambda x: s.run_chunk(x, chunk)).lower(st)
+    t1 = time.time()
+    txt = lowered.as_text()
+    n_ops = txt.count("\n")
+    t2 = time.time()
+    compiled = lowered.compile()
+    t3 = time.time()
+    del compiled
+    print(f"inbox={inbox} trace+lower={t1-t0:.1f}s hlo_lines={n_ops} "
+          f"compile={t3-t2:.1f}s", flush=True)
+
+
+if __name__ == "__main__":
+    for r in [int(x) for x in (sys.argv[1:] or ["2", "8"])]:
+        probe(r)

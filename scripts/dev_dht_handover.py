@@ -1,0 +1,63 @@
+"""Diagnostic driver for the red handover-under-churn test: same
+scenario as tests/test_faults.py::test_dht_handover_under_churn but
+printing the full counter breakdown mid-run."""
+
+import os
+import sys
+import time
+
+sys.modules["zstandard"] = None
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in flags:
+    flags += (" --xla_backend_optimization_level=0"
+              " --xla_llvm_disable_expensive_passes=true")
+os.environ["XLA_FLAGS"] = flags
+
+import jax  # noqa: E402
+
+from jax._src import compilation_cache as _cc  # noqa: E402
+if getattr(_cc, "zstandard", None) is not None:
+    _cc.zstandard = None
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_compilation_cache", False)
+
+sys.path.insert(0, "/root/repo")
+
+from oversim_tpu import churn as churn_mod  # noqa: E402
+from oversim_tpu.apps.dht import DhtApp, DhtParams  # noqa: E402
+from oversim_tpu.engine import sim as sim_mod  # noqa: E402
+from oversim_tpu.overlay.chord import ChordLogic  # noqa: E402
+
+
+def main():
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=16,
+                               init_interval=0.5, lifetime_mean=600.0,
+                               graceful_leave_delay=15.0,
+                               graceful_leave_probability=1.0)
+    logic = ChordLogic(app=DhtApp(DhtParams(test_interval=20.0,
+                                            test_ttl=600.0,
+                                            storage_slots=192)))
+    s = sim_mod.Simulation(logic, cp,
+                           engine_params=sim_mod.EngineParams(
+                               window=0.05, transition_time=60.0))
+    st = s.init(seed=4)
+    t0 = time.time()
+    for stop in (200.0, 350.0, 500.0, 650.0):
+        st = s.run_until(st, stop, chunk=256)
+        out = s.summary(st)
+        dht = {k: v for k, v in out.items()
+               if k.startswith("dht_") and not k.endswith("_s")}
+        ok = out["dht_get_success"] / max(out["dht_get_attempts"], 1)
+        print(f"t={stop:.0f} wall={time.time()-t0:.0f}s ratio={ok:.3f} "
+              f"{dht}", flush=True)
+    eng = out["_engine"]
+    print("engine:", {k: v for k, v in sorted(eng.items()) if v},
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

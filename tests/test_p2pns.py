@@ -123,3 +123,94 @@ def test_i3_prefix_anycast_and_stack():
     sent = [(int(k), int(a)) for k, a, v in
             zip(fields["kind"], fields["a"], valid) if v]
     assert (int(w.I3_PACKET), int(ida)) in sent, sent
+
+
+def test_i3_cross_server_continuation():
+    """Cross-server trigger-stack forwarding (I3.h:56-120): a matched
+    trigger whose continuation lives on ANOTHER server repacketizes the
+    payload as a KBR_ROUTE keyed to the continuation's full overlay id;
+    decapsulated at the responsible server, it rematches and delivers —
+    a two-server chain."""
+    import dataclasses as dc
+    import jax
+    import jax.numpy as jnp
+    from oversim_tpu.apps.i3 import I3App, I3Global, I3Params, wire_id
+    from oversim_tpu.common import route as rt_mod
+    from oversim_tpu.common import wire as w
+    from oversim_tpu.core import keys as K
+    from oversim_tpu.engine.logic import Msg, Outbox
+
+    app_obj = I3App(I3Params(min_prefix_bits=8), num_slots=4)
+    app_obj.rcfg = rt_mod.RouteConfig()      # overlay-processed routes
+    glob = I3Global(trigger_ids=K.random_keys(
+        jax.random.PRNGKey(3), (4,), app_obj.spec))
+
+    class Ctx:
+        measuring = jnp.bool_(True)
+    Ctx.glob = glob
+
+    class Ev:
+        def __init__(self):
+            self.c = {}
+
+        def count(self, name, inc):
+            self.c[name] = self.c.get(name, 0) + int(jnp.sum(
+                jnp.asarray(inc).astype(jnp.int32)))
+
+        def value(self, *a):
+            pass
+
+    # server 0 stores trigger A (node 2's id) chaining to trigger B
+    # (node 3's id, full key attached); server 1 stores plain trigger B
+    ida = wire_id(glob, jnp.int32(2))
+    idb = wire_id(glob, jnp.int32(3))
+    s0 = jax.tree.map(lambda x: x[0], app_obj.init(1))
+    s0 = dc.replace(
+        s0,
+        tr_id=s0.tr_id.at[0].set(ida),
+        tr_owner=s0.tr_owner.at[0].set(2),
+        tr_expire=s0.tr_expire.at[0].set(10**15),
+        tr_next=s0.tr_next.at[0].set(idb),
+        tr_next_key=s0.tr_next_key.at[0].set(glob.trigger_ids[3]))
+    s1 = jax.tree.map(lambda x: x[0], app_obj.init(1))
+    s1 = dc.replace(
+        s1,
+        tr_id=s1.tr_id.at[0].set(idb),
+        tr_owner=s1.tr_owner.at[0].set(3),
+        tr_expire=s1.tr_expire.at[0].set(10**15))
+
+    def mk(kind, pkt_id, dst, c=0):
+        z = jnp.int32(0)
+        return Msg(valid=jnp.bool_(True), t_deliver=jnp.int64(1000),
+                   src=jnp.int32(5), dst=jnp.int32(dst), kind=jnp.int32(kind),
+                   key=jnp.zeros((5,), jnp.uint32), nonce=z,
+                   hops=z, a=jnp.int32(pkt_id), b=jnp.int32(7),
+                   c=jnp.int32(c), d=z,
+                   nodes=jnp.full((8,), -1, jnp.int32),
+                   size_b=jnp.int32(40), stamp=jnp.int64(123))
+
+    # packet for A hits server 0 → cross-server KBR_ROUTE to B's key
+    ob = Outbox(4, 5, 8)
+    app_obj.on_msg(s0, mk(w.I3_PACKET, ida, 0), Ctx(), ob, Ev(),
+                   jnp.bool_(True))
+    fields, valid, _ = ob.finish()
+    routed = [i for i in range(len(valid))
+              if valid[i] and int(fields["kind"][i]) == int(w.KBR_ROUTE)]
+    assert routed, "no cross-server route emitted"
+    i = routed[0]
+    assert int(fields["d"][i]) == int(w.I3_PACKET)
+    assert int(fields["a"][i]) == int(idb)
+    assert int(fields["c"][i]) == 1                    # chain depth
+    assert (fields["key"][i] == glob.trigger_ids[3]).all()
+
+    # the route layer decapsulates at server 1 (kind := d) — replay the
+    # decapsulated packet there: plain trigger B delivers to owner 3
+    ob = Outbox(4, 5, 8)
+    ev = Ev()
+    app_obj.on_msg(s1, mk(w.I3_PACKET, int(fields["a"][i]), 1,
+                          c=int(fields["c"][i])), Ctx(), ob, ev,
+                   jnp.bool_(True))
+    fields, valid, _ = ob.finish()
+    sent = [(int(k), int(d)) for k, d, v in
+            zip(fields["kind"], fields["dst"], valid) if v]
+    assert (int(w.I3_DELIVER), 3) in sent, sent

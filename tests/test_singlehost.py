@@ -133,3 +133,70 @@ def test_zeroconf_announce_browse_loopback():
         a.close()
         if b is not None:
             b.close()
+
+
+# ---------------------------------------------------------------------------
+# STUN (reference src/underlay/singlehostunderlay/stun/ + the
+# SingleHostUnderlayConfigurator.cc:108-134 stunServer bootstrap path)
+# ---------------------------------------------------------------------------
+
+def test_stun_codec_roundtrip():
+    from oversim_tpu.singlehost import (STUN_BIND_REQ, STUN_BIND_RES,
+                                        build_binding_request,
+                                        build_binding_response,
+                                        parse_stun)
+    txid = bytes(range(12))
+    req = parse_stun(build_binding_request(txid))
+    assert req and req["type"] == STUN_BIND_REQ and req["txid"] == txid
+    # modern XOR-MAPPED-ADDRESS and the classic MAPPED-ADDRESS the
+    # reference's vovida 0.96 library answers with (stun.h:36)
+    for xor_mapped in (True, False):
+        res = parse_stun(build_binding_response(
+            txid, "203.0.113.7", 61234, xor_mapped=xor_mapped))
+        assert res and res["type"] == STUN_BIND_RES
+        assert res["mapped"] == ("203.0.113.7", 61234), res
+    assert parse_stun(b"\xff\xff not stun") is None
+
+
+def test_stun_discover_loopback():
+    """Binding request against a loopback responder returns the
+    reflexive address of the asking socket (both RFC 5389 and classic
+    response encodings)."""
+    from oversim_tpu.singlehost import StunResponder, stun_discover
+    for classic in (False, True):
+        try:
+            srv = StunResponder(classic=classic)
+        except OSError:
+            pytest.skip("no loopback sockets available")
+        try:
+            cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            cli.bind(("127.0.0.1", 0))
+            mapped = stun_discover(cli, srv.addr, rto_s=0.5, retries=2)
+            assert mapped == cli.getsockname(), (mapped, classic)
+            cli.close()
+        finally:
+            srv.close()
+
+
+def test_gateway_stun_bootstrap():
+    """RealtimeGateway learns its public address via **.stunServer the
+    way SingleHostUnderlayConfigurator does before the overlay joins."""
+    from oversim_tpu.gateway import RealtimeGateway
+    from oversim_tpu.singlehost import StunResponder
+
+    class _SimStub:       # the STUN path runs before any sim pumping
+        pass
+
+    try:
+        srv = StunResponder()
+    except OSError:
+        pytest.skip("no loopback sockets available")
+    try:
+        gw = RealtimeGateway.__new__(RealtimeGateway)
+        RealtimeGateway.__init__(gw, sim=_SimStub(), state=None,
+                                 stun_server=srv.addr)
+        assert gw.public_addr == ("127.0.0.1", gw.udp_port)
+        assert gw.nat_detected is False     # loopback: reflexive == local
+        gw.udp.close()
+    finally:
+        srv.close()
