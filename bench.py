@@ -229,6 +229,7 @@ def child_main():
     sys.modules["zstandard"] = None  # zstd C ext segfaults on this box
     import jax
 
+    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
     from jax._src import compilation_cache as _cc
     if getattr(_cc, "zstandard", None) is not None:
         _cc.zstandard = None
@@ -243,7 +244,7 @@ def child_main():
     else:
         # sim-step graphs compile slowly; cache across invocations/rounds
         jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/oversim_jax_cache")
+                          _host_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     # last update wins over the sitecustomize hook's forced "axon,cpu";
     # None keeps the ambient (tunnel) selection

@@ -233,10 +233,14 @@ class I3App:
         p = self.p
         now = m.t_deliver
 
-        # trigger insert (I3::insertTrigger): same-id overwrite, else
-        # free slot, else evict earliest expiry
+        # trigger insert (I3::insertTrigger): the table holds a SET of
+        # triggers per identifier (triggerTable[id] is a std::set keyed
+        # by the full trigger incl. owner, I3.cc:100) — overwrite is
+        # keyed on (id, owner) so two owners sharing an id coexist
+        # (that set IS i3 multicast); else free slot, else evict
+        # earliest expiry
         en = m.valid & (m.kind == wire.I3_INSERT)
-        same = (app.tr_id == m.a) & (m.a >= 0)
+        same = (app.tr_id == m.a) & (app.tr_owner == m.b) & (m.a >= 0)
         free = app.tr_id < 0
         col = jnp.where(jnp.any(same), jnp.argmax(same),
                         jnp.where(jnp.any(free), jnp.argmax(free),
@@ -257,7 +261,11 @@ class I3App:
         # data packet → longest-prefix anycast match
         # (I3::forwardPacket via findClosestMatch, I3.h:56-120): among
         # live triggers, pick the one sharing the longest id prefix with
-        # the packet id; at least min_prefix_bits must match
+        # the packet id; at least min_prefix_bits must match.  The
+        # packet then goes to EVERY trigger stored under the winning
+        # identifier — the reference's per-identifier std::set loop
+        # (I3.cc sendPacket "send to all friends") — which is what makes
+        # a shared identifier a multicast group (i3Apps/I3Multicast.cc).
         en = m.valid & (m.kind == wire.I3_PACKET)
         live = (app.tr_id >= 0) & (app.tr_expire > now)
         xor = jnp.bitwise_xor(app.tr_id, m.a).astype(jnp.uint32)
@@ -266,15 +274,15 @@ class I3App:
         pl = jnp.where(live & (m.a >= 0), pl, -1)
         best = jnp.argmax(pl).astype(I32)
         matched = pl[best] >= p.min_prefix_bits
-        owner = jnp.where(matched, app.tr_owner[best], NO_NODE)
-        nxt_id = jnp.where(matched, app.tr_next[best], -1)
-        nxt_key = app.tr_next_key[best]
+        # the matched identifier's full trigger set ([D] mask)
+        grp = en & matched & live & (app.tr_id == app.tr_id[best])
         # trigger stacks (I3.h:56-120): a matched trigger with a
-        # continuation id repacketizes the payload addressed to that id.
-        # Chain depth rides ``c`` (``hops`` belongs to the route layer),
+        # continuation id repacketizes the payload addressed to that id
+        # (per trigger — each set member carries its own stack).  Chain
+        # depth rides ``c`` (``hops`` belongs to the route layer),
         # bounded by stack_hop_max; plain triggers deliver to the owner.
-        chain = en & matched & (nxt_id >= 0) & (m.c < p.stack_hop_max)
-        deliver = en & (owner != NO_NODE) & ~chain
+        chain_v = grp & (app.tr_next >= 0) & (m.c < p.stack_hop_max)
+        deliver_v = grp & ~chain_v
         # CROSS-SERVER continuation: when the stored stack entry carries
         # the continuation's full overlay key and the overlay processes
         # recursive routes, the repacketized id is routed THROUGH the
@@ -282,32 +290,44 @@ class I3App:
         # sendPacket on the popped identifier stack) via a KBR_ROUTE
         # self-send — the overlay decapsulates it back into I3_PACKET at
         # the responsible node, where the match/chain cycle repeats.
+        # All sends are [D]-vectorized (one Outbox call per kind).
         if self.rcfg is not None:
             ew = self.rcfg.ext_words
             vis0 = jnp.full(m.nodes.shape, NO_NODE, I32).at[ew].set(
                 m.dst)
             if ew:
                 vis0 = vis0.at[:ew].set(0)
-            have_key = jnp.any(nxt_key != 0)
-            cross = chain & have_key
-            ob.send(cross, now, m.dst, wire.KBR_ROUTE, key=nxt_key,
-                    d=jnp.int32(wire.I3_PACKET), a=nxt_id, b=m.b,
+            have_key = jnp.any(app.tr_next_key != 0, axis=-1)      # [D]
+            cross_v = chain_v & have_key
+            ob.send(cross_v, now, m.dst, wire.KBR_ROUTE,
+                    key=app.tr_next_key,
+                    d=jnp.int32(wire.I3_PACKET), a=app.tr_next, b=m.b,
                     c=m.c + 1, hops=0, nodes=vis0, stamp=m.stamp,
                     size_b=p.payload_bytes + self.rcfg.overhead_b)
-            chain_local = chain & ~have_key
+            chain_local = chain_v & ~have_key
         else:
-            chain_local = chain
+            chain_local = chain_v
         # local-rematch fallback (no full key / no recursive routing):
         # the packet re-enters this server's own table next tick
-        ob.send(chain_local, now, m.dst, wire.I3_PACKET, a=nxt_id,
-                b=m.b, c=m.c + 1, stamp=m.stamp,
+        ob.send(chain_local, now, m.dst, wire.I3_PACKET, a=app.tr_next,
+                b=m.b, c=m.c + 1, d=m.d, stamp=m.stamp,
                 size_b=p.payload_bytes)
-        ob.send(deliver, now, jnp.maximum(owner, 0),
-                wire.I3_DELIVER, a=m.a, b=m.b, stamp=m.stamp,
+        # ``d`` carries the sample apps' payload kind end-to-end
+        # (I3SessionMessage-style typed payloads, i3Apps/*.cc)
+        ob.send(deliver_v, now, jnp.maximum(app.tr_owner, 0),
+                wire.I3_DELIVER, a=m.a, b=m.b, d=m.d, stamp=m.stamp,
                 size_b=p.payload_bytes)
 
         # delivery at the trigger owner
         en = m.valid & (m.kind == wire.I3_DELIVER)
+        return self._on_deliver(app, m, ctx, ob, ev, en)
+
+    def _on_deliver(self, app, m, ctx, ob, ev, en):
+        """Owner-side delivery accounting for the built-in random
+        workload; sample apps (apps/i3apps.py) override this with their
+        own payload handling (the I3BaseApp::deliver upcall)."""
+        p = self.p
+        now = m.t_deliver
         glob: I3Global = ctx.glob
         # truly ours? an anycast delivery is legitimate when the packet
         # id shares >= min_prefix_bits with OUR trigger id (longest-

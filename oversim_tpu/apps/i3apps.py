@@ -1,0 +1,456 @@
+"""i3 sample applications (reference src/applications/i3/i3Apps/*).
+
+Vectorized rebuilds of the reference's I3BaseApp demo suite over the
+I3App server core (apps/i3.py).  Each app keeps the reference's
+rendezvous structure: identifiers share a CLASS PREFIX, and
+``asOverlayKey`` uses only the prefix bytes (I3Identifier.cc:124-127) —
+so every identifier of a class resolves to the SAME responsible server,
+where longest-prefix matching picks among the class's triggers.
+
+  * I3MulticastApp  — i3Apps/I3Multicast.cc: all group members register
+    the IDENTICAL identifier; a packet to it fans out to the whole
+    trigger set (I3.cc sendPacket's per-identifier loop).
+  * I3AnycastApp    — i3Apps/I3Anycast.cc: members register
+    prefix+own-suffix triggers; a packet to prefix+random-suffix lands
+    on the closest match (one random member), which re-sends — a
+    perpetual anycast ping chain.
+  * I3MobilityApp   — i3Apps/I3HostMobility.cc: members register
+    prefix+suffix ids, anycast-discover partners (MSG_QUERY_ID /
+    MSG_REPLY_ID), then ping them; a mobility event re-randomizes the
+    member's identifier (doMobilityEvent → reinsert), so pings to the
+    stale id are lost until the next partner rediscovery — the lost-
+    packet KPI.
+  * I3StretchApp    — i3Apps/I3LatencyStretch.cc: each ping crosses the
+    indirection point while the pong returns directly; the latency
+    ratio of the two legs is the i3 stretch KPI.
+
+Identifier mapping: class key = ``glob.trigger_ids[slot]`` (lookup key,
+the "prefix hash"); wire id = top ``min_prefix_bits`` of that key's
+head lane (the class prefix) | a per-node or random suffix in the low
+bits.  Payload kinds ride the pooled ``d`` field (the reference's typed
+cPacket kinds, I3HostMobility.cc MSG_*).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.apps import base
+from oversim_tpu.apps.i3 import (I3App, I3Global, I3Params, I3State,
+                                 M_INSERT, M_SEND, NO_NODE, NS, T_INF,
+                                 wire_id)
+from oversim_tpu.common import wire
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+# payload kinds in ``d`` (I3HostMobility.cc MSG_QUERY_ID/MSG_REPLY_ID/
+# MSG_PING/MSG_REPLY; I3LatencyStretch's ping/pong)
+D_DATA = 0
+D_QUERY = 1
+D_REPLY_ID = 2
+D_PING = 3
+D_PONG = 4
+
+
+def _prefix_of(glob: I3Global, slot, bits: int):
+    """Class prefix: top ``bits`` of the slot's oracle wire id."""
+    mask = jnp.uint32(0xFFFFFFFF) << (32 - bits)
+    return (wire_id(glob, slot).astype(jnp.uint32) & mask)
+
+
+def _class_id(glob: I3Global, slot, suffix, bits: int):
+    """prefix | suffix, top bit cleared (-1 is the empty marker)."""
+    mask_lo = (jnp.uint32(1) << (32 - bits)) - 1
+    raw = _prefix_of(glob, slot, bits) | (
+        jnp.asarray(suffix).astype(jnp.uint32) & mask_lo)
+    return (raw & jnp.uint32(0x7FFFFFFF)).astype(I32)
+
+
+def _mix(x):
+    """Cheap deterministic 32-bit mixer for in-graph random suffixes."""
+    x = jnp.asarray(x).astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+class I3MulticastApp(I3App):
+    """All members of group g register the identical identifier; every
+    send to it reaches the whole group (i3Apps/I3Multicast.cc: "All
+    nodes register the same identifier ... all participating nodes
+    receive the packet")."""
+
+    def __init__(self, params: I3Params = I3Params(), num_groups: int = 1,
+                 **kw):
+        super().__init__(params, **kw)
+        self.num_groups = num_groups
+
+    def stat_spec(self):
+        s = super().stat_spec()
+        s["counters"] = s["counters"] + ("i3_mcast_recv",)
+        return s
+
+    def _group(self, node_idx):
+        return node_idx % self.num_groups
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        p = self.p
+        g = self._group(node_idx)
+        ins_hit = en & (app.t_ins < ctx.t_end)
+        snd_hit = en & (app.t_send < ctx.t_end)
+        ins_due = ins_hit
+        snd_due = snd_hit & ~ins_due
+        ev.count("i3_inserts", ins_due)
+        ev.count("i3_sent", snd_due & ctx.measuring)
+        key = ctx.glob.trigger_ids[g]          # class key: same server
+        app = dataclasses.replace(
+            app,
+            t_ins=jnp.where(ins_hit, now + jnp.int64(
+                int(p.refresh * NS)), app.t_ins),
+            t_send=jnp.where(snd_hit, now + jnp.int64(
+                int(p.send_interval * NS)), app.t_send),
+            seq=app.seq + (ins_due | snd_due).astype(I32))
+        mode = jnp.where(ins_due, M_INSERT, M_SEND)
+        # base on_lookup_done derives the wire id from tag//4 — the
+        # group slot — so insert and send both use the group identifier
+        return app, base.LookupReq(want=ins_due | snd_due, key=key,
+                                   tag=g * 4 + mode)
+
+    def _on_deliver(self, app, m, ctx, ob, ev, en):
+        mine = m.a == wire_id(ctx.glob, self._group(m.dst))
+        ev.count("i3_misdelivered", en & ~mine & ctx.measuring)
+        en = en & mine
+        ev.count("i3_delivered", en & ctx.measuring)
+        ev.count("i3_mcast_recv", en & ctx.measuring)
+        ev.value("i3_latency_s",
+                 (m.t_deliver - m.stamp).astype(jnp.float32) / NS,
+                 en & ctx.measuring)
+        return app
+
+
+class I3AnycastApp(I3App):
+    """Anycast ping chain (i3Apps/I3Anycast.cc): every member registers
+    prefix|own-suffix; initiators send prefix|random-suffix once, and
+    every delivery re-sends to a fresh random suffix — packets hop
+    member-to-member forever through the rendezvous server."""
+
+    POOL = 0   # class slot: glob.trigger_ids[0] is the pool identifier
+
+    def _suffix(self, node_idx):
+        return _mix(node_idx.astype(jnp.uint32) ^ jnp.uint32(0xA17C)) | 1
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        p = self.p
+        ins_hit = en & (app.t_ins < ctx.t_end)
+        snd_hit = en & (app.t_send < ctx.t_end)
+        ins_due = ins_hit
+        snd_due = snd_hit & ~ins_due
+        ev.count("i3_inserts", ins_due)
+        ev.count("i3_sent", snd_due & ctx.measuring)
+        key = ctx.glob.trigger_ids[self.POOL]
+        app = dataclasses.replace(
+            app,
+            t_ins=jnp.where(ins_hit, now + jnp.int64(
+                int(p.refresh * NS)), app.t_ins),
+            # the reference seeds the chain once (node 0); circulating
+            # packets can die here (drops, ttl gaps), so members re-seed
+            # at a slow cadence to keep the chain population stable
+            t_send=jnp.where(snd_hit, now + jnp.int64(
+                int(4 * p.send_interval * NS)), app.t_send),
+            seq=app.seq + (ins_due | snd_due).astype(I32))
+        mode = jnp.where(ins_due, M_INSERT, M_SEND)
+        return app, base.LookupReq(want=ins_due | snd_due, key=key,
+                                   tag=node_idx * 4 + mode)
+
+    def on_lookup_done(self, app, done, ctx, ob, ev, now, node_idx):
+        p = self.p
+        en = done.en
+        mode = done.tag % 4
+        suc = done.success & (done.results[0] != NO_NODE)
+        ev.count("i3_lookup_failed", en & ~suc)
+        server = done.results[0]
+        my_id = _class_id(ctx.glob, self.POOL, self._suffix(node_idx),
+                          p.min_prefix_bits)
+        rnd_id = _class_id(ctx.glob, self.POOL,
+                           _mix(now.astype(jnp.uint32)
+                                ^ node_idx.astype(jnp.uint32)),
+                           p.min_prefix_bits)
+        ob.send(en & suc & (mode == M_INSERT), now, server,
+                wire.I3_INSERT, a=my_id, b=node_idx, c=jnp.int32(-1),
+                stamp=now + jnp.int64(int(p.trigger_ttl * NS)),
+                size_b=wire.BASE_CALL_B + 12)
+        ob.send(en & suc & (mode == M_SEND), now, server,
+                wire.I3_PACKET, a=rnd_id, b=node_idx, stamp=now,
+                size_b=p.payload_bytes)
+        return app
+
+    def _on_deliver(self, app, m, ctx, ob, ev, en):
+        p = self.p
+        now = m.t_deliver
+        # prefix membership is the only validity test (any member is a
+        # legitimate anycast target)
+        mine = (m.a.astype(jnp.uint32)
+                & (jnp.uint32(0xFFFFFFFF) << (32 - p.min_prefix_bits))
+                ) == _prefix_of(ctx.glob, self.POOL, p.min_prefix_bits)
+        ev.count("i3_misdelivered", en & ~mine & ctx.measuring)
+        en = en & mine
+        ev.count("i3_delivered", en & ctx.measuring)
+        ev.value("i3_latency_s",
+                 (now - m.stamp).astype(jnp.float32) / NS,
+                 en & ctx.measuring)
+        # deliver() re-sends to a fresh random suffix (I3Anycast.cc:
+        # "after arrival, repeat the same process"); the rendezvous
+        # server is the forwarder (m.src), no fresh lookup needed
+        nxt = _class_id(ctx.glob, self.POOL,
+                        _mix(now.astype(jnp.uint32) * jnp.uint32(2654435761)
+                             ^ m.dst.astype(jnp.uint32)),
+                        p.min_prefix_bits)
+        ob.send(en, now, m.src, wire.I3_PACKET, a=nxt, b=m.dst,
+                stamp=now, size_b=p.payload_bytes)
+        return app
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MobilityState(I3State):
+    gen: jnp.ndarray        # [N] i32 — identifier generation (mobility)
+    partner: jnp.ndarray    # [N, 2] i32 — learned partner wire ids
+    srv: jnp.ndarray        # [N] i32 — cached rendezvous server slot
+    t_ping: jnp.ndarray     # [N] i64
+    t_disc: jnp.ndarray     # [N] i64 — partner (re)discovery timer
+    t_move: jnp.ndarray     # [N] i64 — next mobility event
+
+
+class I3MobilityApp(I3App):
+    """Host mobility over i3 (i3Apps/I3HostMobility.cc): partners are
+    discovered by anycast MSG_QUERY_ID (answered with the responder's
+    CURRENT identifier), pinged periodically, and a mobility event
+    re-randomizes the identifier — pings addressed to the stale id are
+    lost until the next rediscovery round, the recorded lost-packet
+    KPI (I3HostMobility::finish)."""
+
+    def __init__(self, params: I3Params = I3Params(),
+                 ping_interval: float = 2.0,
+                 rediscover_interval: float = 60.0,
+                 move_interval: float = 120.0, **kw):
+        super().__init__(params, **kw)
+        self.ping_interval = ping_interval
+        self.rediscover_interval = rediscover_interval
+        self.move_interval = move_interval
+
+    POOL = 0
+
+    def stat_spec(self):
+        s = super().stat_spec()
+        s["counters"] = s["counters"] + (
+            "i3_mob_ping_sent", "i3_mob_pong_recv", "i3_mob_moves",
+            "i3_mob_partners")
+        return s
+
+    def _my_id(self, glob, node_idx, gen):
+        sfx = _mix(node_idx.astype(jnp.uint32) * jnp.uint32(2654435761)
+                   ^ gen.astype(jnp.uint32))
+        return _class_id(glob, self.POOL, sfx, self.p.min_prefix_bits)
+
+    def init(self, n: int) -> MobilityState:
+        b = super().init(n)
+        fields = {f.name: getattr(b, f.name)
+                  for f in dataclasses.fields(I3State)}
+        return MobilityState(
+            **fields,
+            gen=jnp.zeros((n,), I32),
+            partner=jnp.full((n, 2), -1, I32),
+            srv=jnp.full((n,), NO_NODE, I32),
+            t_ping=jnp.full((n,), T_INF, I64),
+            t_disc=jnp.full((n,), T_INF, I64),
+            t_move=jnp.full((n,), T_INF, I64))
+
+    def on_ready(self, app, en, now, rng):
+        # NOTE: called from inside the overlay's vmapped step — all
+        # fields are per-node scalars here (unlike init's [N] arrays)
+        app = super().on_ready(app, en, now, rng)
+        r1, r2 = jax.random.split(rng)
+        joff = (jax.random.uniform(r1, ())
+                * self.ping_interval * NS).astype(I64)
+        moff = (jax.random.uniform(r2, ())
+                * self.move_interval * NS).astype(I64)
+        return dataclasses.replace(
+            app,
+            # the base random-workload send timer is unused here (the
+            # discovery/ping cadence replaces it) — park it or it pins
+            # the event horizon with no on_timer branch advancing it
+            t_send=jnp.where(en, T_INF, app.t_send),
+            t_ping=jnp.where(en, now + jnp.int64(int(5 * NS)) + joff,
+                             app.t_ping),
+            t_disc=jnp.where(en, now + jnp.int64(int(2 * NS)),
+                             app.t_disc),
+            t_move=jnp.where(
+                en, now + jnp.int64(int(self.move_interval * NS)) + moff,
+                app.t_move))
+
+    def on_stop(self, app, en):
+        app = super().on_stop(app, en)
+        return dataclasses.replace(
+            app,
+            t_ping=jnp.where(en, T_INF, app.t_ping),
+            t_disc=jnp.where(en, T_INF, app.t_disc),
+            t_move=jnp.where(en, T_INF, app.t_move))
+
+    def next_event(self, app):
+        t = jnp.minimum(super().next_event(app), app.t_ping)
+        return jnp.minimum(t, jnp.minimum(app.t_disc, app.t_move))
+
+    def on_timer(self, app, en, ctx, now, rng, ev, node_idx):
+        p = self.p
+        glob = ctx.glob
+        # mobility event (doMobilityEvent → MSG_TIMER_RESET_ID): bump
+        # the generation and re-insert the NEW identifier promptly
+        mv = en & (app.t_move < ctx.t_end)
+        ev.count("i3_mob_moves", mv)
+        app = dataclasses.replace(
+            app,
+            gen=app.gen + mv.astype(I32),
+            t_move=jnp.where(mv, now + jnp.int64(
+                int(self.move_interval * NS)), app.t_move),
+            t_ins=jnp.where(mv, now, app.t_ins))
+
+        ins_hit = en & (app.t_ins < ctx.t_end)
+        disc_hit = en & (app.t_disc < ctx.t_end) & ~ins_hit
+        ins_due = ins_hit
+        ev.count("i3_inserts", ins_due)
+        key = glob.trigger_ids[self.POOL]
+        app = dataclasses.replace(
+            app,
+            t_ins=jnp.where(ins_hit, now + jnp.int64(
+                int(p.refresh * NS)), app.t_ins),
+            t_disc=jnp.where(disc_hit, now + jnp.int64(
+                int(self.rediscover_interval * NS)), app.t_disc))
+
+        mode = jnp.where(ins_due, M_INSERT, M_SEND)
+        return app, base.LookupReq(want=ins_due | disc_hit, key=key,
+                                   tag=node_idx * 4 + mode)
+
+    def on_tick(self, app, ctx, ob, ev, node_idx):
+        """Ping a learned partner directly through the cached server
+        (the on_timer hook has no outbox access; pings pace here, the
+        same discipline as the DHT maintenance pump).  No lookup — the
+        reference client caches its i3 server too."""
+        now = ctx.t_start
+        ping_hit = (ctx.ready[node_idx] & (app.t_ping < ctx.t_end)
+                    & (app.t_ping != T_INF))
+        kp = (_mix(now.astype(jnp.uint32) ^ node_idx.astype(jnp.uint32))
+              & 1).astype(I32)
+        pid = app.partner[kp]
+        do_ping = ping_hit & (pid >= 0) & (app.srv != NO_NODE)
+        ev.count("i3_mob_ping_sent", do_ping & ctx.measuring)
+        ob.send(do_ping, now, jnp.maximum(app.srv, 0), wire.I3_PACKET,
+                a=pid, b=node_idx, d=jnp.int32(D_PING), stamp=now,
+                size_b=self.p.payload_bytes)
+        return dataclasses.replace(
+            app, t_ping=jnp.where(ping_hit, now + jnp.int64(
+                int(self.ping_interval * NS)), app.t_ping))
+
+    def on_lookup_done(self, app, done, ctx, ob, ev, now, node_idx):
+        p = self.p
+        en = done.en
+        mode = done.tag % 4
+        suc = done.success & (done.results[0] != NO_NODE)
+        ev.count("i3_lookup_failed", en & ~suc)
+        server = done.results[0]
+        app = dataclasses.replace(
+            app, srv=jnp.where(en & suc, server, app.srv))
+        my_id = self._my_id(ctx.glob, node_idx, app.gen)
+        ob.send(en & suc & (mode == M_INSERT), now, server,
+                wire.I3_INSERT, a=my_id, b=node_idx, c=jnp.int32(-1),
+                stamp=now + jnp.int64(int(p.trigger_ttl * NS)),
+                size_b=wire.BASE_CALL_B + 12)
+        # partner discovery: anycast MSG_QUERY_ID to a random suffix
+        # (discoverPartners, I3HostMobility.cc:185-200)
+        rnd_id = _class_id(ctx.glob, self.POOL,
+                           _mix(now.astype(jnp.uint32)
+                                ^ node_idx.astype(jnp.uint32)),
+                           p.min_prefix_bits)
+        ob.send(en & suc & (mode == M_SEND), now, server,
+                wire.I3_PACKET, a=rnd_id, b=node_idx,
+                d=jnp.int32(D_QUERY), stamp=now,
+                size_b=p.payload_bytes)
+        return app
+
+    def _on_deliver(self, app, m, ctx, ob, ev, en):
+        p = self.p
+        now = m.t_deliver
+        my_id = self._my_id(ctx.glob, m.dst, app.gen)
+        is_q = en & (m.d == D_QUERY)
+        is_rid = en & (m.d == D_REPLY_ID)
+        # a ping addressed to a PREVIOUS-generation identifier is LOST:
+        # the reference host's old trigger points at its pre-move
+        # address (I3HostMobility's lost-packet KPI) — the stale
+        # trigger still matches at the server, but the owner is no
+        # longer reachable under that identity
+        is_ping = en & (m.d == D_PING) & (m.a == my_id)
+        is_pong = en & (m.d == D_PONG)
+        # MSG_QUERY_ID → reply directly with my CURRENT identifier
+        ob.send(is_q & (m.b != m.dst), now, jnp.maximum(m.b, 0),
+                wire.I3_DELIVER, a=my_id, b=m.dst,
+                d=jnp.int32(D_REPLY_ID), stamp=m.stamp,
+                size_b=p.payload_bytes)
+        # MSG_REPLY_ID → store the partner id (ring of 2)
+        slot = (app.seq % 2).astype(I32)
+        slot = jnp.where(is_rid, slot, app.partner.shape[0])
+        ev.count("i3_mob_partners", is_rid)
+        app = dataclasses.replace(
+            app,
+            partner=app.partner.at[slot].set(m.a, mode="drop"),
+            seq=app.seq + is_rid.astype(I32))
+        # MSG_PING → direct MSG_REPLY to the sender (echo send stamp)
+        ob.send(is_ping, now, jnp.maximum(m.b, 0), wire.I3_DELIVER,
+                a=m.a, b=m.dst, d=jnp.int32(D_PONG), stamp=m.stamp,
+                size_b=p.payload_bytes)
+        ev.count("i3_delivered", is_ping & ctx.measuring)
+        # MSG_REPLY → round-trip complete
+        ev.count("i3_mob_pong_recv", is_pong & ctx.measuring)
+        ev.value("i3_latency_s",
+                 (now - m.stamp).astype(jnp.float32) / NS,
+                 is_pong & ctx.measuring)
+        return app
+
+
+class I3StretchApp(I3App):
+    """Latency stretch (i3Apps/I3LatencyStretch.cc): the ping leg
+    crosses the rendezvous server, the pong leg returns directly; the
+    per-leg latencies are recorded separately and their ratio is the
+    i3 stretch KPI (the reference records exactly these two
+    end-to-end legs per exchange)."""
+
+    def stat_spec(self):
+        s = super().stat_spec()
+        s["scalars"] = s["scalars"] + ("i3_leg_s", "direct_leg_s")
+        return s
+
+    def _on_deliver(self, app, m, ctx, ob, ev, en):
+        p = self.p
+        now = m.t_deliver
+        is_ping = en & (m.d == D_DATA)
+        is_pong = en & (m.d == D_PONG)
+        glob = ctx.glob
+        xor_o = jnp.bitwise_xor(m.a, wire_id(glob, m.dst)).astype(
+            jnp.uint32)
+        plo = jnp.where(xor_o == 0, 32, jax.lax.clz(xor_o).astype(I32))
+        mine = plo >= p.min_prefix_bits
+        ev.count("i3_misdelivered", is_ping & ~mine & ctx.measuring)
+        is_ping = is_ping & mine
+        ev.count("i3_delivered", is_ping & ctx.measuring)
+        # i3 leg: send-time → delivery through the indirection point
+        ev.value("i3_leg_s", (now - m.stamp).astype(jnp.float32) / NS,
+                 is_ping & ctx.measuring)
+        # pong goes back DIRECTLY (the reference's direct-IP leg)
+        ob.send(is_ping & (m.b != m.dst), now, jnp.maximum(m.b, 0),
+                wire.I3_DELIVER, a=m.a, b=m.dst, d=jnp.int32(D_PONG),
+                stamp=now, size_b=p.payload_bytes)
+        ev.value("direct_leg_s", (now - m.stamp).astype(jnp.float32) / NS,
+                 is_pong & ctx.measuring)
+        return app

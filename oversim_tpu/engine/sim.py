@@ -30,6 +30,7 @@ is the one semantic relaxation vs the reference's total event order; shrink
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 
 import jax
@@ -309,12 +310,23 @@ class Simulation:
         s, _ = jax.lax.scan(body, s, None, length=n_ticks)
         return s
 
-    def run_until(self, s: SimState, t_sim: float,
-                  chunk: int = 256) -> SimState:
-        """Host loop: run chunks until simulated time passes t_sim seconds."""
+    def run_until(self, s: SimState, t_sim: float, chunk: int = 256,
+                  check_invariants: bool | None = None) -> SimState:
+        """Host loop: run chunks until simulated time passes t_sim seconds.
+
+        ``check_invariants`` (or OVERSIM_DEBUG_INVARIANTS=1) runs the
+        host-side structural validator between chunks — the reference's
+        debug-build assert tier (SURVEY §5; oversim_tpu/invariants.py).
+        """
+        if check_invariants is None:
+            check_invariants = bool(os.environ.get(
+                "OVERSIM_DEBUG_INVARIANTS"))
         target = int(t_sim * NS)
         while int(s.t_now) < target:
             s = self.run_chunk(s, chunk)
+            if check_invariants:
+                from oversim_tpu import invariants as inv_mod
+                inv_mod.check_state(s)
         return s
 
     def summary(self, s: SimState) -> dict:

@@ -53,6 +53,32 @@ EXT_OUT = 151   # gateway node → real network (same fields echoed)
 _HDR = struct.Struct("!IIII")
 
 
+class GenericPacketParser:
+    """Pluggable wire codec between real packets and sim messages.
+
+    Rebuild of the reference's GenericPacketParser
+    (src/common/GenericPacketParser.{h,cc}: ``decapsulatePayload(buf,
+    length) -> cPacket`` / ``encapsulatePayload(msg) -> buf``, selected
+    per underlay via the ``parserType`` NED parameter and used by the
+    singlehost message parsers).  The gateway calls ``decapsulate`` on
+    every received datagram/TCP frame and ``encapsulate`` on every
+    outbound EXT_OUT message — subclass both to speak any external
+    protocol (the default implements the framework's native
+    ``u32 kind | a | b | c`` header)."""
+
+    def decapsulate(self, data: bytes):
+        """bytes → (b, c) payload words, or None to drop the packet."""
+        if len(data) < _HDR.size:
+            return None
+        _, _, b, c = _HDR.unpack_from(data)
+        return b, c
+
+    def encapsulate(self, sid: int, b: int, c: int) -> bytes:
+        """EXT_OUT message fields → wire bytes."""
+        return _HDR.pack(EXT_OUT, sid & 0xFFFFFFFF, b & 0xFFFFFFFF,
+                         c & 0xFFFFFFFF)
+
+
 def drain_ext_out(state, gw_slot: int, handler):
     """Scan the pool for EXT_OUT messages addressed to ``gw_slot`` and
     offer each to ``handler(sid, b, c) -> consumed``; free exactly the
@@ -84,10 +110,18 @@ class RealtimeGateway:
     def __init__(self, sim, state, gw_slot: int = 0,
                  udp_port: int = 0, tcp_port: int | None = None,
                  host: str = "127.0.0.1",
-                 stun_server: tuple | None = None):
+                 stun_server: tuple | None = None,
+                 crypto=None, parser: GenericPacketParser | None = None):
         self.sim = sim
         self.state = state
         self.gw = gw_slot
+        # pluggable wire codec (GenericPacketParser.h parserType)
+        self.parser = parser or GenericPacketParser()
+        # real-signature path (common/crypto.py CryptoModule — the
+        # reference signs RPC messages with the keyFile key in
+        # SingleHost mode, CryptoModule.h:56): every outbound frame is
+        # signed, every inbound frame must carry a valid auth block
+        self.crypto = crypto
         # extra between-tick drains (TunBridge registers here): EXT_OUT
         # messages a drain does not consume would be DELIVERED back into
         # the gateway node's inbox on the next tick and lost
@@ -161,9 +195,14 @@ class RealtimeGateway:
                 return
             except OSError:
                 return
-            if len(data) < _HDR.size:
-                continue
-            kind_tag, a, b, c = _HDR.unpack_from(data)
+            if self.crypto is not None:
+                data = self.crypto.verify_frame(data)
+                if data is None:
+                    continue          # unauthenticated datagram: drop
+            parsed = self.parser.decapsulate(data)
+            if parsed is None:
+                continue              # parser rejected the packet
+            b, c = parsed
             sid = self._next_session
             self._next_session += 1
             self._sessions[sid] = ("udp", addr)
@@ -198,11 +237,20 @@ class RealtimeGateway:
             # length-prefixed frames (SimpleTCP stream framing)
             while len(buf) >= 4:
                 ln = int.from_bytes(buf[:4], "big")
-                if len(buf) < 4 + ln or ln < _HDR.size:
-                    break
+                if len(buf) < 4 + ln:
+                    break             # incomplete frame: wait for more
+                # undersized frames fall through to the parser, which
+                # rejects them (custom parsers may use smaller framing)
                 frame = bytes(buf[4:4 + ln])
                 del buf[:4 + ln]
-                kind_tag, a, b, c = _HDR.unpack_from(frame)
+                if self.crypto is not None:
+                    frame = self.crypto.verify_frame(frame)
+                    if frame is None:
+                        continue      # unauthenticated frame: drop
+                parsed = self.parser.decapsulate(frame)
+                if parsed is None:
+                    continue          # parser rejected the frame
+                b, c = parsed
                 self.inject(EXT_IN, a=sid, b=b, c=c)
         for sid in dead:
             self._tcp_conns.pop(sid, None)
@@ -214,12 +262,14 @@ class RealtimeGateway:
         :func:`drain_ext_out` frees only what its handler consumed)."""
 
         def handler(sid, b, c):
-            payload = _HDR.pack(EXT_OUT, sid, b, c)
             sess = self._sessions.get(sid)
             if sess is not None and sess[0] == "tun":
                 return False          # not ours — leave for the bridge
             if sess is None:
                 return True           # orphan: free, nothing to send
+            payload = self.parser.encapsulate(sid, b, c)
+            if self.crypto is not None:
+                payload = self.crypto.sign_frame(payload)
             if sess[0] == "udp":
                 try:
                     self.udp.sendto(payload, sess[1])

@@ -1,0 +1,25 @@
+"""Host-keyed persistent-compile-cache path.
+
+XLA-CPU AOT executables embed machine features; an entry compiled on a
+different host poisons the cache with load-time machine-feature
+mismatches (the round-4 goldens-regen failure).  Keying the cache
+directory on the CPU model + ISA flags makes a foreign entry simply
+invisible instead of fatal.  Pure stdlib — safe to import before jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import platform
+
+
+def cache_dir(prefix: str = "/tmp/oversim_jax_cache") -> str:
+    sig = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            lines = f.read().splitlines()
+        sig += "".join(ln for ln in lines
+                       if ln.startswith(("model name", "flags")))[:8192]
+    except OSError:
+        sig += platform.processor() or ""
+    return prefix + "_" + hashlib.sha1(sig.encode()).hexdigest()[:10]

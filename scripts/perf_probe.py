@@ -20,13 +20,15 @@ def log(msg):
 
 import jax
 
+from oversim_tpu.hostcache import cache_dir as _host_cache_dir
+
 from jax._src import compilation_cache as _cc
 for attr in ("zstandard", "zstd"):
     if getattr(_cc, attr, None) is not None:
         setattr(_cc, attr, None)
 
 jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 1024

@@ -77,6 +77,8 @@ def _setup_jax(platform):
                     " --xla_llvm_disable_expensive_passes=true").strip()
     sys.modules["zstandard"] = None
     import jax
+
+    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
     from jax._src import compilation_cache as _cc
     if getattr(_cc, "zstandard", None) is not None:
         _cc.zstandard = None
@@ -88,7 +90,7 @@ def _setup_jax(platform):
         if platform == "cpu":
             jax.config.update("jax_enable_compilation_cache", False)
             return jax
-    jax.config.update("jax_compilation_cache_dir", "/tmp/oversim_jax_cache")
+    jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
 

@@ -1,0 +1,71 @@
+"""Runtime invariant checker (SURVEY §5 sanitizer tier;
+oversim_tpu/invariants.py)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu import invariants as inv
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.engine import sim as sim_mod
+from oversim_tpu.overlay.chord import ChordLogic
+
+
+@pytest.fixture(scope="module")
+def chord8():
+    logic = ChordLogic(app=KbrTestApp(KbrTestParams(test_interval=20.0)))
+    cp = churn_mod.ChurnParams(model="none", target_num=8,
+                               init_interval=0.3)
+    s = sim_mod.Simulation(logic, cp,
+                           engine_params=sim_mod.EngineParams(window=0.05))
+    st = s.init(seed=3)
+    # checker runs BETWEEN chunks for the whole convergence run
+    st = s.run_until(st, 120.0, chunk=128, check_invariants=True)
+    return s, st
+
+
+def test_clean_run_passes(chord8):
+    s, st = chord8
+    inv.check_state(st)          # converged state re-validates
+    out = s.summary(st)
+    assert out["kbr_delivered"] > 0
+
+
+def test_detects_succ_compaction_hole(chord8):
+    _, st = chord8
+    lg = st.logic
+    succ = jnp.asarray(lg.succ)
+    # punch a hole: [live, NO_NODE, live] violates compaction
+    bad = succ.at[0, 0].set(succ[0, 1]).at[0, 1].set(-1)
+    bad = bad.at[0, 2].set(succ[0, 0])
+    broken = dataclasses.replace(
+        st, logic=dataclasses.replace(lg, succ=bad))
+    with pytest.raises(inv.InvariantViolation, match="succ_compact"):
+        inv.check_state(broken)
+
+
+def test_detects_ring_order_breakage(chord8):
+    _, st = chord8
+    lg = st.logic
+    s0 = np.asarray(lg.succ[:, 0])
+    # swap two nodes' successors: the quiet-ring order check must fire
+    succ = jnp.asarray(lg.succ)
+    succ = succ.at[0, 0].set(int(s0[1])).at[1, 0].set(int(s0[0]))
+    broken = dataclasses.replace(
+        st, logic=dataclasses.replace(lg, succ=succ))
+    if int(s0[0]) == int(s0[1]):
+        pytest.skip("degenerate draw: identical successors")
+    with pytest.raises(inv.InvariantViolation):
+        inv.check_state(broken)
+
+
+def test_detects_negative_counter(chord8):
+    _, st = chord8
+    counters = dict(st.counters)
+    counters["pool_overflow"] = jnp.int64(-1)
+    broken = dataclasses.replace(st, counters=counters)
+    with pytest.raises(inv.InvariantViolation, match="nonnegative"):
+        inv.check_state(broken)
