@@ -148,7 +148,10 @@ def _shifted_pareto(rng, alpha: float, mean, shape=()):
 def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
     n = p.num_slots
     tgt = p.target_num
-    zeros = jnp.zeros((n,), jnp.float32)
+    # NOTE: l_mean/d_mean must be DISTINCT arrays — a shared object
+    # would alias their buffers and break run_chunk's state donation
+    # (XLA rejects donating the same buffer twice)
+    zeros = lambda: jnp.zeros((n,), jnp.float32)  # noqa: E731
     r1, r2, r3, r4 = jax.random.split(rng, 4)
     if p.model == "none":
         stagger = _truncnormal(r1, p.init_interval, p.init_deviation, (n,))
@@ -156,7 +159,7 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         return ChurnState(**_with_grace(dict(
             t_create=(t_create * NS).astype(I64),
             t_kill=jnp.full((n,), T_INF, I64),
-            l_mean=zeros, d_mean=zeros, t_tick=T_INF), n))
+            l_mean=zeros(), d_mean=zeros(), t_tick=T_INF), n))
     if p.model == "trace":
         # TraceChurn: the schedule IS the trace (GlobalTraceManager
         # createNode/deleteNode at the traced times)
@@ -167,7 +170,7 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
             [t * NS if t is not None else int(T_INF)
              for t in p.trace_kill], I64)
         return ChurnState(**_with_grace(dict(t_create=t_create, t_kill=t_kill,
-                          l_mean=zeros, d_mean=zeros, t_tick=T_INF), n))
+                          l_mean=zeros(), d_mean=zeros(), t_tick=T_INF), n))
     if p.model == "lifetime":
         fin = p.init_finished_time
         i = jnp.arange(tgt)
@@ -184,7 +187,7 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         t_kill = jnp.maximum(t_kill - p.graceful_leave_delay, t_create)
         return ChurnState(**_with_grace(dict(t_create=(t_create * NS).astype(I64),
             t_kill=(t_kill * NS).astype(I64),
-            l_mean=zeros, d_mean=zeros, t_tick=T_INF), n))
+            l_mean=zeros(), d_mean=zeros(), t_tick=T_INF), n))
     if p.model == "pareto":
         # ParetoChurn.cc:66-126: per-slot individual mean life/dead times,
         # equilibrium init (alive w.p. availability), stretch to hit the
@@ -240,7 +243,7 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         return ChurnState(**_with_grace(dict(
             t_create=(t_create * NS).astype(I64),
             t_kill=jnp.full((n,), T_INF, I64),
-            l_mean=zeros, d_mean=zeros,
+            l_mean=zeros(), d_mean=zeros(),
             t_tick=jnp.int64(int((p.init_finished_time
                                   + p.churn_change_interval) * NS))), n))
     raise ValueError(f"unknown churn model {p.model}")

@@ -74,14 +74,20 @@ class CryptoModule:
             self.key = key
         elif key_file is not None:
             # keyFile discipline: created on first use so every node of
-            # a deployment can share one provisioned secret
+            # a deployment can share one provisioned secret.  O_EXCL
+            # makes provisioning race-free (two concurrent first users
+            # cannot silently overwrite each other's key) and 0o600
+            # keeps the secret out of world-readable mode.
             import os
-            if os.path.exists(key_file):
+            try:
+                fd = os.open(key_file,
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+            except FileExistsError:
                 with open(key_file, "rb") as f:
                     self.key = f.read()
             else:
                 self.key = os.urandom(32)
-                with open(key_file, "wb") as f:
+                with os.fdopen(fd, "wb") as f:
                     f.write(self.key)
         else:
             raise ValueError("CryptoModule needs key_file or key")

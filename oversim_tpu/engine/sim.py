@@ -11,7 +11,7 @@ This replaces the reference's single-threaded OMNeT++ discrete-event kernel
        run the vmapped per-node logic step — each node consumes up to R
        messages plus its due timers and appends to a bounded outbox;
     3. push the outbox through the analytic underlay delay model and write
-       it into free message-pool slots (second sort);
+       it into free message-pool slots (sort-free cumsum allocation);
     4. apply churn create/kill events as alive-mask flips + state resets;
     5. fold the tick's stat events into global accumulators.
 
@@ -88,6 +88,28 @@ ENGINE_COUNTERS = ("queue_lost", "bit_error_lost", "dest_unavailable_lost",
                    "inbox_deferred")
 
 
+def _dedupe_buffers(state):
+    """Copy any state leaf that shares a device buffer with an earlier
+    leaf.  ``run_chunk``/``run_until_device`` DONATE the state; XLA
+    refuses to donate the same buffer twice, so a logic/churn init that
+    assigns one array object to two fields would poison every later
+    chunk.  One-time cost at init; no-op for alias-free states."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    seen, out = set(), []
+    for leaf in leaves:
+        try:
+            ptr = leaf.unsafe_buffer_pointer()
+        except (AttributeError, ValueError):
+            out.append(leaf)
+            continue
+        if ptr in seen:
+            leaf = jnp.array(leaf, copy=True)
+        else:
+            seen.add(ptr)
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 class Simulation:
     """Host-side driver binding logic + underlay + churn params."""
 
@@ -115,7 +137,7 @@ class Simulation:
          r_mal) = jax.random.split(rng, 6)
         n = self.n
         node_keys = keys_mod.random_keys(r_keys, (n,), self.spec)
-        return SimState(
+        return _dedupe_buffers(SimState(
             t_now=jnp.int64(0),
             tick=jnp.int64(0),
             rng=r_run,
@@ -130,31 +152,37 @@ class Simulation:
             logic=self.logic.init(r_logic, n),
             stats=stats_mod.init_stats(self.logic.stat_spec()),
             counters={name: jnp.zeros((), I64) for name in ENGINE_COUNTERS},
-        )
+        ))
 
     # -- one tick -----------------------------------------------------------
+    #
+    # The tick is split into five PHASE methods (horizon / churn / inbox /
+    # node_step / alloc_stats) so oversim_tpu/profiling.py can jit and
+    # time each phase separately under OVERSIM_PROFILE=1.  ``step``
+    # composes them; under one jit the split is invisible to XLA (same
+    # fused graph as the old monolithic step).
 
-    def step(self, s: SimState) -> SimState:
-        n = self.n
-        ep, up, cp = self.ep, self.up, self.cp
-        logic = self.logic
-        window_ns = jnp.int64(int(ep.window * NS))
-
-        # 1. event horizon
+    def _phase_horizon(self, s: SimState):
+        """Phase 1/5: advance to the event horizon + per-tick rng split."""
+        window_ns = jnp.int64(int(self.ep.window * NS))
         t_next = jnp.minimum(
             pool_mod.next_deliver_time(s.pool),
             jnp.minimum(
-                jnp.min(jnp.where(s.alive, logic.next_event(s.logic), T_INF)),
+                jnp.min(jnp.where(s.alive, self.logic.next_event(s.logic),
+                                  T_INF)),
                 churn_mod.next_event(s.churn)))
         t_next = jnp.maximum(t_next, s.t_now)
         # with no pending events anywhere t_next is T_INF; keep t_end there
         # too so T_INF-parked timers/churn sentinels never satisfy `< t_end`
         t_end = jnp.where(t_next >= T_INF, t_next, t_next + window_ns)
+        rngs = jax.random.split(s.rng, 7)
+        return t_next, t_end, rngs
 
-        (rng, r_churn, r_keys, r_reset, r_nodes, r_mig,
-         r_send) = jax.random.split(s.rng, 7)
-
-        # 2. churn events (incl. graceful-leave grace windows)
+    def _phase_churn(self, s: SimState, t_next, t_end, r_churn, r_keys,
+                     r_reset, r_mig):
+        """Phase 2/5: churn events (incl. graceful-leave grace windows)."""
+        n, cp, up = self.n, self.cp, self.up
+        logic = self.logic
         churn_state, created, killed, _leaving = churn_mod.step(
             s.churn, cp, s.alive, t_next, t_end, r_churn)
         alive = (s.alive | created) & ~killed
@@ -178,9 +206,14 @@ class Simulation:
         # clear both created and killed slots; created ones schedule a join
         logic_state = logic.reset(s.logic, created | killed, created, t_next,
                                   r_reset)
+        return churn_state, alive, pre_killed, node_keys, ul_state, logic_state
 
-        # 3. inbox — ONE gather of the packed [P, W] block for all the
-        # 32-bit fields (pool.py packed layout, PERFORMANCE.md lever #3)
+    def _phase_inbox(self, s: SimState, t_next, t_end, alive):
+        """Phase 3/5: group due messages by destination — ONE gather of
+        the packed [P, W] block for all the 32-bit fields (pool.py packed
+        layout, PERFORMANCE.md lever #3) behind the tick's single
+        full-pool sort."""
+        n, ep = self.n, self.ep
         inbox, delivered, to_dead = pool_mod.build_inbox(
             s.pool, n, ep.inbox_slots, t_end, alive)
         safe = jnp.maximum(inbox, 0)
@@ -199,8 +232,14 @@ class Simulation:
             c=col("c"), d=col("d"),
             nodes=blk[..., ncol + s.pool.kl:], size_b=col("size_b"),
             stamp=s.pool.stamp[safe])
+        return msgs, delivered, to_dead
 
-        # 4. context + vmapped node step
+    def _phase_node_step(self, s: SimState, t_next, t_end, alive, pre_killed,
+                         churn_state, node_keys, ul_state, logic_state, msgs,
+                         r_nodes):
+        """Phase 4/5: tick context + the vmapped per-node logic step."""
+        n, ep, up, cp = self.n, self.ep, self.up, self.cp
+        logic = self.logic
         ready = logic.ready_mask(logic_state) & alive & ~pre_killed
         ready_cumsum = jnp.cumsum(ready.astype(I32))
         measure_start = jnp.int64(
@@ -243,8 +282,17 @@ class Simulation:
                        if hasattr(logic, "merge") else node_part)
         if hasattr(logic, "post_step"):
             logic_state = logic.post_step(ctx, logic_state, events)
+        return (logic_state, out_fields, out_valid, out_overflow, events,
+                measuring)
 
-        # 5. free delivered, send outbox through the underlay
+    def _phase_alloc_stats(self, s: SimState, t_end, rng, r_send, alive,
+                           pre_killed, node_keys, ul_state, churn_state,
+                           logic_state, delivered, to_dead, out_fields,
+                           out_valid, out_overflow, events, measuring):
+        """Phase 5/5: free delivered slots, send the outbox through the
+        underlay into free pool slots (sort-free alloc), fold stats."""
+        ep, up = self.ep, self.up
+        node_idx = jnp.arange(self.n, dtype=I32)
         new_pool = pool_mod.free(s.pool, delivered | to_dead)
         t_del, ok, ul_state, drops = self.ul.send_batch(
             ul_state, up, r_send, jnp.broadcast_to(node_idx[:, None],
@@ -259,8 +307,8 @@ class Simulation:
         new_pool, pool_overflow = pool_mod.alloc(
             new_pool, flat, (out_valid & ok).reshape(-1))
 
-        # 6. stats
-        new_stats = stats_mod.record(s.stats, events, ctx.measuring)
+        # stats
+        new_stats = stats_mod.record(s.stats, events, measuring)
         counters = dict(s.counters)
         counters["queue_lost"] += drops["queue_lost"]
         counters["bit_error_lost"] += drops["bit_error_lost"]
@@ -293,6 +341,23 @@ class Simulation:
                         logic=logic_state, stats=new_stats,
                         counters=counters)
 
+    def step(self, s: SimState) -> SimState:
+        """One tick: the five phases composed (see the phase methods)."""
+        t_next, t_end, rngs = self._phase_horizon(s)
+        (rng, r_churn, r_keys, r_reset, r_nodes, r_mig, r_send) = rngs
+        (churn_state, alive, pre_killed, node_keys, ul_state,
+         logic_state) = self._phase_churn(s, t_next, t_end, r_churn, r_keys,
+                                          r_reset, r_mig)
+        msgs, delivered, to_dead = self._phase_inbox(s, t_next, t_end, alive)
+        (logic_state, out_fields, out_valid, out_overflow, events,
+         measuring) = self._phase_node_step(
+            s, t_next, t_end, alive, pre_killed, churn_state, node_keys,
+            ul_state, logic_state, msgs, r_nodes)
+        return self._phase_alloc_stats(
+            s, t_end, rng, r_send, alive, pre_killed, node_keys, ul_state,
+            churn_state, logic_state, delivered, to_dead, out_fields,
+            out_valid, out_overflow, events, measuring)
+
     def _node_step(self, ctx, state_n, msgs_n, rng_n, node_idx):
         """Single-node step (vmapped): logic consumes inbox + timers."""
         state_n, outbox, events = self.logic.step(
@@ -303,8 +368,19 @@ class Simulation:
 
     # -- run ----------------------------------------------------------------
 
-    @partial(jax.jit, static_argnames=("self", "n_ticks"))
+    @partial(jax.jit, static_argnames=("self", "n_ticks"),
+             donate_argnums=(1,))
     def run_chunk(self, s: SimState, n_ticks: int) -> SimState:
+        """One fused dispatch of ``n_ticks`` ticks.
+
+        The incoming SimState is DONATED: XLA writes the output state
+        into the input's buffers instead of round-tripping the whole
+        state through fresh HBM allocations every chunk
+        (parallel/mesh.py already donated; this is the default
+        single-chip path).  Callers must rebind
+        (``s = sim.run_chunk(s, k)``) and never touch the old reference
+        afterwards.
+        """
         def body(carry, _):
             return self.step(carry), None
         s, _ = jax.lax.scan(body, s, None, length=n_ticks)
@@ -314,6 +390,8 @@ class Simulation:
                   check_invariants: bool | None = None) -> SimState:
         """Host loop: run chunks until simulated time passes t_sim seconds.
 
+        One device→host sync (``t_now``) per chunk; use
+        ``run_until_device`` for the sync-free single-dispatch loop.
         ``check_invariants`` (or OVERSIM_DEBUG_INVARIANTS=1) runs the
         host-side structural validator between chunks — the reference's
         debug-build assert tier (SURVEY §5; oversim_tpu/invariants.py).
@@ -328,6 +406,34 @@ class Simulation:
                 from oversim_tpu import invariants as inv_mod
                 inv_mod.check_state(s)
         return s
+
+    @partial(jax.jit, static_argnames=("self", "chunk"), donate_argnums=(1,))
+    def _run_until_device(self, s: SimState, target, chunk: int) -> SimState:
+        def cond(carry):
+            return carry.t_now < target
+
+        def body(carry):
+            def sbody(c, _):
+                return self.step(c), None
+            c, _ = jax.lax.scan(sbody, carry, None, length=chunk)
+            return c
+
+        return jax.lax.while_loop(cond, body, s)
+
+    def run_until_device(self, s: SimState, t_sim: float,
+                         chunk: int = 256) -> SimState:
+        """Device-resident run loop: the whole run is ONE dispatch.
+
+        Wraps the ``chunk``-tick scan in a ``lax.while_loop`` guarded by
+        ``t_now < target`` so the host never reads ``t_now`` back
+        between chunks (``run_until`` pays one device→host sync per
+        chunk).  Both advance in whole chunks until ``t_now >= target``,
+        so results are bit-identical to ``run_until`` at equal ``chunk``.
+        The state is donated, like ``run_chunk``.  Keep ``run_until``
+        for invariant-checking or per-chunk host work.
+        """
+        target = jnp.int64(int(t_sim * NS))
+        return self._run_until_device(s, target, chunk)
 
     def summary(self, s: SimState) -> dict:
         out = stats_mod.summarize(s.stats)

@@ -87,9 +87,28 @@ def check_chord(state, alive):
     if ready.sum() < 3:
         return
     s0 = succ[:, 0]
-    quiet = all(s0[i] != NO_NODE and ready[s0[i]]
-                for i in np.nonzero(ready)[0])
+    ready_idx = np.nonzero(ready)[0]
+    quiet = all(s0[i] != NO_NODE and ready[s0[i]] for i in ready_idx)
     if not quiet:
+        return
+    # The gate above is necessary but not sufficient: when B joins
+    # between A and A's successor C and reaches READY before A's next
+    # stabilize, every ready node's succ0 is still ready yet A.succ0==C
+    # is no longer clockwise-nearest — a correct transient, not a bug.
+    # Only fire the order check once succ0 forms a SINGLE CYCLE over
+    # exactly the ready set (the stabilization fixed point): in the
+    # transient above C is succ0 of both A and B, so the map is not a
+    # permutation and the check stays quiet.
+    targets = s0[ready_idx]
+    if (len(set(targets.tolist())) != len(ready_idx)
+            or set(targets.tolist()) != set(ready_idx.tolist())):
+        return
+    start = ready_idx[0]
+    cur, cycle_len = int(s0[start]), 1
+    while cur != start and cycle_len <= len(ready_idx):
+        cur = int(s0[cur])
+        cycle_len += 1
+    if cycle_len != len(ready_idx):
         return
     keys = np.asarray(state.node_keys)
     kints = [int.from_bytes(b"".join(

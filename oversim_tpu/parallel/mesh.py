@@ -88,3 +88,34 @@ def jit_run(sim, mesh: Mesh, n_ticks: int, donate: bool = True):
 
     return jax.jit(run, in_shardings=(shardings,), out_shardings=shardings,
                    donate_argnums=(0,) if donate else ())
+
+
+def jit_run_until(sim, mesh: Mesh, chunk: int = 64, donate: bool = True):
+    """jit a device-resident ``(state, target_ns) -> state`` runner.
+
+    The multi-chip equivalent of ``Simulation.run_until_device``: a
+    ``lax.while_loop`` re-runs ``chunk``-tick scans until
+    ``t_now >= target_ns``, so the whole run to a simulation-time target
+    is ONE dispatch — no per-chunk host round-trip (the per-chunk sync
+    in the host loop costs a full ICI/DCN drain at scale).  ``target_ns``
+    is an i64 scalar in engine ns (``t_sim * sim_mod.NS``), replicated.
+    """
+    example = sim.init()
+    shardings = state_shardings(example, mesh)
+
+    def run(s, target_ns):
+        def cond(carry):
+            return carry.t_now < target_ns
+
+        def body(carry):
+            def sbody(c, _):
+                return sim.step(c), None
+            c, _ = jax.lax.scan(sbody, carry, None, length=chunk)
+            return c
+
+        return jax.lax.while_loop(cond, body, s)
+
+    return jax.jit(run,
+                   in_shardings=(shardings, NamedSharding(mesh, P())),
+                   out_shardings=shardings,
+                   donate_argnums=(0,) if donate else ())

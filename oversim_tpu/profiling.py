@@ -1,0 +1,149 @@
+"""Per-phase tick profiling — OVERSIM_PROFILE=1 (PERFORMANCE.md lever).
+
+The tick graph is op-issue/compile-bound and opaque: when a bench run
+dies or posts a bad number, nothing says WHICH of the tick's phases ate
+the time (the round-5 bench artifact was a deadline-killed 0.0 with no
+diagnosis).  This module times the five phases of ``Simulation.step``
+(engine/sim.py splits them exactly for this):
+
+  horizon      event-horizon scan + rng split
+  churn        churn events, alive flips, key/coord migration, resets
+  inbox        due-message grouping (the tick's single full-pool sort)
+  node_step    tick context + the vmapped per-node logic sweep
+  alloc_stats  underlay send, sort-free pool alloc, stat folding
+
+Each phase is jitted SEPARATELY and timed with ``block_until_ready``
+over ``n_ticks`` real ticks.  Sub-jits lose cross-phase fusion, so the
+phase sum exceeds the fused tick cost — the per-phase SHARES are the
+diagnostic signal, and the fused cost is measured alongside via
+``run_chunk`` for the honest denominator.
+
+Usage:
+    from oversim_tpu import profiling
+    if profiling.enabled():
+        report, s = profiling.profile_ticks(sim, s, n_ticks=4)
+        print(json.dumps(report))
+
+``bench.py``, ``scripts/perf_probe.py`` and ``scripts/scale_smoke.py``
+emit the report as a JSON line when OVERSIM_PROFILE=1.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+PHASES = ("horizon", "churn", "inbox", "node_step", "alloc_stats")
+
+
+def enabled() -> bool:
+    """True when OVERSIM_PROFILE is set to a non-empty, non-"0" value."""
+    return os.environ.get("OVERSIM_PROFILE", "") not in ("", "0")
+
+
+def _jit_phases(sim):
+    """Jit the five phase methods of a Simulation (closures keep ``sim``
+    static, mirroring run_chunk's static ``self``)."""
+    return {
+        "horizon": jax.jit(
+            lambda s: sim._phase_horizon(s)),
+        "churn": jax.jit(
+            lambda s, tn, te, rc, rk, rr, rm: sim._phase_churn(
+                s, tn, te, rc, rk, rr, rm)),
+        "inbox": jax.jit(
+            lambda s, tn, te, alive: sim._phase_inbox(s, tn, te, alive)),
+        "node_step": jax.jit(
+            lambda s, tn, te, alive, pk, cs, nk, ul, lg, msgs, rn:
+            sim._phase_node_step(s, tn, te, alive, pk, cs, nk, ul, lg,
+                                 msgs, rn)),
+        "alloc_stats": jax.jit(
+            lambda s, te, rng, rs, alive, pk, nk, ul, cs, lg, dlv, dead,
+            of, ov, oo, ev, ms: sim._phase_alloc_stats(
+                s, te, rng, rs, alive, pk, nk, ul, cs, lg, dlv, dead,
+                of, ov, oo, ev, ms)),
+    }
+
+
+def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True):
+    """Run ``n_ticks`` real ticks phase by phase, timing each phase.
+
+    Returns ``(report, s)`` — the report dict (JSON-serializable) and
+    the advanced SimState (the profiled ticks are real simulation
+    progress; callers keep using the returned state).  The first tick
+    pays all five phase compiles and is EXCLUDED from the averages.
+    """
+    fns = _jit_phases(sim)
+    totals = {p: 0.0 for p in PHASES}
+    compile_s = 0.0
+    measured = 0
+
+    for tick in range(n_ticks + 1):
+        first = tick == 0
+        t_tick0 = time.perf_counter()
+
+        t0 = time.perf_counter()
+        t_next, t_end, rngs = jax.block_until_ready(
+            fns["horizon"](s))
+        dt_h = time.perf_counter() - t0
+        (rng, r_churn, r_keys, r_reset, r_nodes, r_mig, r_send) = rngs
+
+        t0 = time.perf_counter()
+        (churn_state, alive, pre_killed, node_keys, ul_state,
+         logic_state) = jax.block_until_ready(
+            fns["churn"](s, t_next, t_end, r_churn, r_keys, r_reset, r_mig))
+        dt_c = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        msgs, delivered, to_dead = jax.block_until_ready(
+            fns["inbox"](s, t_next, t_end, alive))
+        dt_i = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        (logic_state, out_fields, out_valid, out_overflow, events,
+         measuring) = jax.block_until_ready(
+            fns["node_step"](s, t_next, t_end, alive, pre_killed,
+                             churn_state, node_keys, ul_state, logic_state,
+                             msgs, r_nodes))
+        dt_n = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        s = jax.block_until_ready(
+            fns["alloc_stats"](s, t_end, rng, r_send, alive, pre_killed,
+                               node_keys, ul_state, churn_state, logic_state,
+                               delivered, to_dead, out_fields, out_valid,
+                               out_overflow, events, measuring))
+        dt_a = time.perf_counter() - t0
+
+        if first:
+            compile_s = time.perf_counter() - t_tick0
+            continue
+        measured += 1
+        for p, dt in zip(PHASES, (dt_h, dt_c, dt_i, dt_n, dt_a)):
+            totals[p] += dt
+
+    denom = max(measured, 1)
+    phase_ms = {p: round(totals[p] / denom * 1e3, 3) for p in PHASES}
+    split_sum = sum(totals.values()) / denom
+    report = {
+        "metric": "tick_phase_breakdown",
+        "n_ticks": measured,
+        "phase_ms_per_tick": phase_ms,
+        "phase_frac": {p: round(totals[p] / max(sum(totals.values()), 1e-12),
+                                4) for p in PHASES},
+        "split_sum_ms_per_tick": round(split_sum * 1e3, 3),
+        "phase_compile_s": round(compile_s, 2),
+    }
+
+    if fused_reference:
+        # fused cost via run_chunk (donating; rebind s both times).  The
+        # first call may compile — time only the second.
+        s = jax.block_until_ready(sim.run_chunk(s, n_ticks))
+        t0 = time.perf_counter()
+        s = jax.block_until_ready(sim.run_chunk(s, n_ticks))
+        fused = (time.perf_counter() - t0) / max(n_ticks, 1)
+        report["fused_ms_per_tick"] = round(fused * 1e3, 3)
+        report["split_overhead_x"] = round(split_sum / max(fused, 1e-12), 2)
+
+    return report, s

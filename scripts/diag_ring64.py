@@ -17,6 +17,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+from oversim_tpu.hostcache import cache_dir as _host_cache_dir  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 jax.config.update("jax_compilation_cache_dir", _host_cache_dir())

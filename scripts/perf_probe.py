@@ -2,6 +2,8 @@
 
 Usage: python scripts/perf_probe.py [n] [chunk] [overlay]
 Prints timestamped stages so a hang is attributable to a stage.
+OVERSIM_PROFILE=1 appends a per-phase tick-time breakdown JSON line
+(oversim_tpu/profiling.py).
 """
 
 import sys
@@ -82,6 +84,16 @@ for i in range(4):
     jax.block_until_ready(s.t_now)
     dt = time.perf_counter() - t
     log(f"chunk{i + 2}: {dt:.3f}s = {dt / chunk * 1e3:.1f} ms/tick")
+
+from oversim_tpu import profiling  # noqa: E402
+
+if profiling.enabled():
+    log("profiling phases (OVERSIM_PROFILE=1) ...")
+    report, s = profiling.profile_ticks(sim, s, n_ticks=4)
+    import json
+
+    print(json.dumps(report), flush=True)
+
 out = sim.summary(s)
 log(f"summary: alive={out['_alive']} ticks={out['_ticks']} "
     f"sent={out.get('kbr_sent')} delivered={out.get('kbr_delivered')}")

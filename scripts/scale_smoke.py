@@ -171,6 +171,11 @@ def churn_row(jax, overlay, n, t_sim):
         sim_t = min(sim_t + step * 4, target)
         st = sim.run_until(st, sim_t, chunk=64)
         jax.block_until_ready(st.t_now)
+    from oversim_tpu import profiling
+    if profiling.enabled() and _remaining() > 90:
+        report, st = profiling.profile_ticks(sim, st, n_ticks=3)
+        report.update(mode="churn_smoke", overlay=overlay, n=n)
+        _emit(report)
     out = sim.summary(st)
     eng = out["_engine"]
     row = {

@@ -196,6 +196,64 @@ def test_ping_pong_end_to_end():
     assert out["_engine"]["dest_unavailable_lost"] == 0
 
 
+# ---------------------------------------------------------------------------
+# hot-path structure: sort count, donation, device-resident loop
+# ---------------------------------------------------------------------------
+
+def test_tick_has_at_most_one_full_pool_sort():
+    """The sort-free allocator (engine/pool.py alloc) leaves the inbox
+    grouping as the tick's ONLY full-pool sort — pin that on the
+    compiled HLO so a regression back to sort-based allocation (or a
+    new accidental O(P log P) pass) fails loudly.  n=24 makes the pool
+    dimension P = 24*8 = 192 distinctive in shape strings."""
+    sim = make_sim(n=24)
+    s = sim.init(seed=1)
+    txt = jax.jit(lambda st: sim.step(st)).lower(s).compile().as_text()
+    full_pool_sorts = [ln for ln in txt.splitlines()
+                       if " sort(" in ln and "[192" in ln]
+    assert len(full_pool_sorts) <= 1, full_pool_sorts
+
+
+def test_run_chunk_donates_state():
+    """run_chunk declares donate_argnums on the SimState: after the
+    call the caller's input buffers must be gone (re-used in place by
+    XLA), so holding the old state is a use-after-donate bug."""
+    sim = make_sim(n=8)
+    s = sim.init(seed=2)
+    old_t, old_valid = s.t_now, s.pool.valid
+    s2 = sim.run_chunk(s, 2)
+    jax.block_until_ready(s2.t_now)
+    assert old_t.is_deleted()
+    assert old_valid.is_deleted()
+    assert not s2.t_now.is_deleted()
+
+
+def test_run_until_device_matches_host_loop_chord64():
+    """The lax.while_loop device-resident runner must be bit-identical
+    to the host chunk loop on a real overlay scenario (chord, N=64):
+    same ticks, same RNG stream, same summary."""
+    from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+    from oversim_tpu.overlay.chord import ChordLogic
+
+    app = KbrTestApp(KbrTestParams(test_interval=2.0))
+    logic = ChordLogic(app=app)
+    cp = churn_mod.ChurnParams(model="none", target_num=64,
+                               init_interval=0.1)
+    ep = EngineParams(window=0.2, transition_time=10.0)
+    sim = Simulation(logic, cp, engine_params=ep)
+
+    target = cp.init_finished_time + 8.0
+    s_host = sim.init(seed=11)
+    s_dev = sim.init(seed=11)
+    a = sim.run_until(s_host, target, chunk=16)
+    b = sim.run_until_device(s_dev, target, chunk=16)
+    assert int(a.t_now) == int(b.t_now)
+    oa, ob = sim.summary(a), sim.summary(b)
+    assert oa.keys() == ob.keys()
+    for k in oa:
+        assert str(oa[k]) == str(ob[k]), k
+
+
 def test_ping_rtt_matches_analytic_delay():
     """With jitter off, RTT between two specific nodes must equal twice the
     calcDelay formula (SimpleNodeEntry.cc:155-195)."""
