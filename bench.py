@@ -22,10 +22,19 @@ Robustness layout (rounds 1-2 recorded nothing: rc=1, then rc=124):
     updated JSON line after EVERY measurement window — the last line
     printed is the result.
 
+Measurement windows are DEVICE-RESIDENT (round 7): warm-up and each
+window run through ``run_until_device``'s donated ``lax.while_loop`` —
+one dispatch per window, one host sync per window (a single
+``jax.device_get`` of the counter leaves; ``run_measurement_windows``).
+``OVERSIM_INVARIANTS=1`` keeps the old host-synced ``run_until`` loop
+with the structural validator between chunks.
+
 Env overrides: OVERSIM_BENCH_N (nodes), OVERSIM_BENCH_MEASURE_WALL
 (seconds of wall-clock to measure for), OVERSIM_BENCH_INTERVAL (per-node
 test period, s), OVERSIM_BENCH_PLATFORM ("axon" | "cpu" — skips probing),
-OVERSIM_BENCH_DEADLINE (orchestrator kill + exit-0 watchdog, s).
+OVERSIM_BENCH_DEADLINE (orchestrator kill + exit-0 watchdog, s),
+OVERSIM_BENCH_CHUNK (scan ticks per while_loop body; default 256 TPU /
+32 CPU).
 
 OVERSIM_PROFILE=1 additionally emits a per-phase tick-time breakdown
 (oversim_tpu/profiling.py) as a ``tick_phase_breakdown`` JSON line
@@ -186,6 +195,60 @@ def orchestrate() -> int:
 
 
 # ---------------------------------------------------------------------------
+# device-resident measurement windows
+# ---------------------------------------------------------------------------
+
+def _fetch_window_leaves(s):
+    """ONE host sync: a single jax.device_get of the counter leaves
+    (stats accumulators, engine counters, clock, alive mask)."""
+    import jax
+    return jax.device_get({"stats": s.stats, "counters": s.counters,
+                           "t_now": s.t_now, "tick": s.tick,
+                           "alive": s.alive})
+
+
+def _summary_from_leaves(leaves) -> dict:
+    """Host-side summary off already-fetched leaves (no device access —
+    the per-window sync stays the one device_get above)."""
+    from oversim_tpu import stats as stats_mod
+    out = stats_mod.summarize(leaves["stats"])
+    out["_engine"] = {k: int(v) for k, v in leaves["counters"].items()}
+    out["_t_sim"] = float(leaves["t_now"]) / 1e9
+    out["_ticks"] = int(leaves["tick"])
+    out["_alive"] = int(leaves["alive"].sum())
+    return out
+
+
+def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
+                            measure_wall, chunk, on_window,
+                            host_loop=False, now=time.perf_counter):
+    """Drive wall-clock measurement windows, device-resident.
+
+    Each window advances the sim by ``window_sim_s`` simulated seconds
+    with ONE dispatch (``run_until_device``'s donated while_loop) and
+    ONE host sync (a single ``jax.device_get`` of the counter leaves),
+    then calls ``on_window(summary, wall_s)``.  ``host_loop=True``
+    falls back to the per-chunk-synced ``run_until`` WITH invariant
+    checking — the OVERSIM_INVARIANTS=1 debug tier.  Returns
+    ``(s, n_windows)``.  Tested against a fake-timer simulation in
+    tests/test_bench_windows.py (exactly one dispatch per window).
+    """
+    t0 = now()
+    sim_t = start_sim_t
+    windows = 0
+    while now() - t0 < measure_wall:
+        sim_t += window_sim_s
+        if host_loop:
+            s = sim.run_until(s, sim_t, chunk=chunk, check_invariants=True)
+        else:
+            s = sim.run_until_device(s, sim_t, chunk=chunk)
+        summary = _summary_from_leaves(_fetch_window_leaves(s))
+        windows += 1
+        on_window(summary, now() - t0)
+    return s, windows
+
+
+# ---------------------------------------------------------------------------
 # child: probe + measure
 # ---------------------------------------------------------------------------
 
@@ -301,7 +364,17 @@ def child_main():
     measure_wall = float(os.environ.get(
         "OVERSIM_BENCH_MEASURE_WALL", "45"))
     overlay = os.environ.get("OVERSIM_BENCH_OVERLAY", "kademlia")
-    chunk = 32 if on_cpu else 64
+    # TPU chunk 256 (was 64): with the device-resident window loop a
+    # whole measurement window is one dispatch regardless, but fatter
+    # scan chunks amortize the while_loop body launch (PERFORMANCE.md
+    # lever #4); CPU keeps 32 (compile-bound tier)
+    chunk = int(os.environ.get("OVERSIM_BENCH_CHUNK",
+                               "32" if on_cpu else "256"))
+    # OVERSIM_INVARIANTS=1 keeps the host-synced run_until loop with the
+    # structural validator between chunks; default is the sync-free
+    # device-resident loop (run_until_device)
+    host_loop = bool(os.environ.get("OVERSIM_INVARIANTS")
+                     or os.environ.get("OVERSIM_DEBUG_INVARIANTS"))
 
     dev = jax.devices()[0]
     sys.stderr.write("bench: platform=%s device=%s n=%d\n"
@@ -335,11 +408,13 @@ def child_main():
     s = sim.init(seed=7)
     warm_until = cp.init_finished_time + warm_extra
     t0 = time.perf_counter()
-    s = sim.run_until(s, warm_until, chunk=chunk)
-    jax.block_until_ready(s.t_now)
+    if host_loop:
+        s = sim.run_until(s, warm_until, chunk=chunk, check_invariants=True)
+    else:
+        s = sim.run_until_device(s, warm_until, chunk=chunk)
+    base = _summary_from_leaves(_fetch_window_leaves(s))
     sys.stderr.write("bench: warmup (%.0f sim-s) took %.1fs wall\n"
                      % (warm_until, time.perf_counter() - t0))
-    base = sim.summary(s)
     sys.stderr.write("bench: post-warm counters %r alive=%d\n"
                      % (base["_engine"], base["_alive"]))
 
@@ -356,17 +431,10 @@ def child_main():
                          % (report["phase_ms_per_tick"],
                             report.get("fused_ms_per_tick", -1.0)))
 
-    # measure in wall-clock windows, emitting an updated JSON line after
+    # measure in wall-clock windows (each ONE device dispatch + ONE host
+    # sync, run_measurement_windows), emitting an updated JSON line after
     # each — the orchestrator relays them, the driver takes the last
-    t_meas0 = time.perf_counter()
-    sim_t = warm_until
-    chunk_sim_s = chunk * window
-    while time.perf_counter() - t_meas0 < measure_wall:
-        sim_t += chunk_sim_s
-        s = sim.run_until(s, sim_t, chunk=chunk)
-        jax.block_until_ready(s.t_now)
-        out = sim.summary(s)
-        wall = time.perf_counter() - t_meas0
+    def on_window(out, wall):
         delivered = out["kbr_delivered"] - base["kbr_delivered"]
         sent = out["kbr_sent"] - base["kbr_sent"]
         rate = delivered / wall if wall > 0 else 0.0
@@ -400,6 +468,11 @@ def child_main():
                          "healthy=%s counters=%r\n"
                          % (rate, wall, delivered, sent, healthy,
                             out["_engine"]))
+
+    s, _ = run_measurement_windows(
+        sim, s, start_sim_t=warm_until, window_sim_s=chunk * window,
+        measure_wall=measure_wall, chunk=chunk, on_window=on_window,
+        host_loop=host_loop)
 
 
 def main():

@@ -308,11 +308,20 @@ def build_simulation(ini: IniFile, config: str = "General",
         cp = build_churn(ini, config)
     ap = build_app(ini, config, spec, trace=workload)
     mp = build_malicious(ini, config)
+    inbox_impl = str(_value(
+        ini.get("**.inboxImpl", config), "scatter")).strip('"')
+    if inbox_impl not in ("scatter", "sort"):
+        raise ScenarioError(f"unsupported inboxImpl: {inbox_impl!r} "
+                            "(expected \"scatter\" or \"sort\")")
     ep = engine_params or sim_mod.EngineParams(
         transition_time=float(_value(
             ini.get("**.transitionTime", config), 0.0)),
         measurement_time=float(_value(
             ini.get("**.measurementTime", config), -1.0)),
+        # **.inboxImpl: inbox grouping algorithm — "scatter" (zero-sort
+        # scatter-min rounds, default) | "sort" (legacy full-pool sort);
+        # this framework's ini extension, engine/pool.py build_inbox
+        inbox_impl=inbox_impl,
         malicious=mp,
     )
 

@@ -6,13 +6,21 @@ in-flight packets live in one structure-of-arrays pool of P slots; each
 simulation tick:
 
   * the due messages (deliver time inside the tick window) are grouped by
-    destination into a fixed-width inbox index table via one lexicographic
-    sort (dst, t_deliver) — O(P log P) on the whole batch instead of a heap
-    pop per message;
+    destination into a fixed-width inbox index table.  The default
+    ``scatter`` implementation runs R rounds of deterministic scatter-min
+    selection: each round one [P]→[N] scatter-min on t_deliver picks every
+    destination's earliest remaining due message (a second scatter-min on
+    the pool index breaks t_deliver ties exactly like the old stable
+    sort), the winners are masked out, and R rounds fill the [N, R] table
+    in O(R·P) work — ZERO full-pool sorts in the tick graph
+    (tests/test_engine.py pins sort and scatter counts on the HLO).  The
+    legacy ``sort`` implementation (one lexicographic (dst, t_deliver)
+    ``lax.sort``, O(P log P)) stays selectable via
+    ``EngineParams.inbox_impl`` / the ``**.inboxImpl`` ini key; both
+    produce bit-identical inboxes (identity tests in tests/test_engine.py);
   * delivered slots are freed, and the tick's outbox is written into free
     slots with a sort-free cumsum allocation (prefix sum over the free
-    mask + one scatter) — the inbox sort above is the ONLY full-pool
-    sort in the tick graph (tests/test_engine.py pins this on the HLO).
+    mask + one scatter).
 
 Messages that overflow a node's R inbox slots in one window simply stay in
 the pool and deliver next tick (receive-queue backpressure).  Pool
@@ -157,20 +165,19 @@ def next_deliver_time(pool: MsgPool):
     return jnp.min(jnp.where(pool.valid, pool.t_deliver, T_INF))
 
 
-def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive):
-    """Group due messages by destination into an index table.
-
-    Returns:
-      inbox: [N, R] i32 pool indices, -1 for empty slots, ordered by
-             deliver time within each row.
-      delivered: [P] bool — messages placed into the inbox this tick.
-      dropped_dead: [P] bool — messages due for a dead node (freed, counted;
-             reference drops these as "dest unavailable", SimpleUDP.cc:307).
-    """
-    p = pool.capacity
+def _due_masks(pool: MsgPool, n: int, t_end, alive):
+    """(due, to_dead) masks shared by both inbox implementations."""
     due = pool.valid & (pool.t_deliver < t_end)
     to_dead = due & ~alive[jnp.clip(pool.dst, 0, n - 1)]
-    due = due & ~to_dead
+    return due & ~to_dead, to_dead
+
+
+def build_inbox_sort(pool: MsgPool, n: int, r: int, t_end, alive):
+    """Legacy inbox grouping: one lexicographic (dst, t_deliver) full-pool
+    stable sort, O(P log P).  Kept selectable (``inbox_impl="sort"``) so
+    the scatter path stays identity-testable against it."""
+    p = pool.capacity
+    due, to_dead = _due_masks(pool, n, t_end, alive)
 
     dst_k = jnp.where(due, pool.dst, n).astype(I32)
     t_k = jnp.where(due, pool.t_deliver, T_INF)
@@ -187,6 +194,61 @@ def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive):
         idx_s, mode="drop")
     delivered = jnp.zeros((p,), bool).at[idx_s].set(take)
     return inbox, delivered, to_dead
+
+
+def build_inbox_scatter(pool: MsgPool, n: int, r: int, t_end, alive):
+    """Zero-sort inbox grouping: R rounds of deterministic scatter-min.
+
+    Round k scatter-mins t_deliver over the destination axis to find each
+    row's earliest remaining due message, then scatter-mins the POOL INDEX
+    over the messages matching that minimum — reproducing the stable
+    sort's exact (t_deliver, idx) tie-break — and masks the winners out.
+    O(R·P) work, 2R small [P]→[N] scatters, no full-pool sort; under
+    GSPMD the scatter-min partitions into a local select + all-reduce-min
+    (parallel/mesh.py), replacing the distributed sort's merge exchange.
+    Bit-identical to :func:`build_inbox_sort` (pinned by the identity
+    tests in tests/test_engine.py).
+    """
+    p = pool.capacity
+    due, to_dead = _due_masks(pool, n, t_end, alive)
+
+    idx = jnp.arange(p, dtype=I32)
+    dstc = jnp.clip(pool.dst, 0, n - 1)
+    # remaining-candidate key; winners flip to T_INF between rounds
+    tkey = jnp.where(due, pool.t_deliver, T_INF)
+    cols, delivered = [], jnp.zeros((p,), bool)
+    for _ in range(r):
+        min_t = jnp.full((n,), T_INF, I64).at[dstc].min(tkey)
+        cand = (tkey < T_INF) & (tkey == min_t[dstc])
+        win = jnp.full((n,), p, I32).at[dstc].min(jnp.where(cand, idx, p))
+        cols.append(jnp.where(win < p, win, NO_NODE))
+        is_win = cand & (idx == win[dstc])
+        delivered |= is_win
+        tkey = jnp.where(is_win, T_INF, tkey)
+    return jnp.stack(cols, axis=1), delivered, to_dead
+
+
+def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive,
+                impl: str = "scatter"):
+    """Group due messages by destination into an index table.
+
+    ``impl`` selects the grouping algorithm: ``"scatter"`` (default,
+    zero-sort scatter-min rounds) or ``"sort"`` (legacy full-pool
+    lexicographic sort).  Both return bit-identical results.
+
+    Returns:
+      inbox: [N, R] i32 pool indices, -1 for empty slots, ordered by
+             (deliver time, pool index) within each row.
+      delivered: [P] bool — messages placed into the inbox this tick.
+      dropped_dead: [P] bool — messages due for a dead node (freed, counted;
+             reference drops these as "dest unavailable", SimpleUDP.cc:307).
+    """
+    if impl == "sort":
+        return build_inbox_sort(pool, n, r, t_end, alive)
+    if impl == "scatter":
+        return build_inbox_scatter(pool, n, r, t_end, alive)
+    raise ValueError(f"unknown inbox_impl: {impl!r} "
+                     "(expected 'scatter' or 'sort')")
 
 
 def free(pool: MsgPool, mask) -> MsgPool:

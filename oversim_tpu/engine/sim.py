@@ -7,7 +7,8 @@ This replaces the reference's single-threaded OMNeT++ discrete-event kernel
     1. advance simulated time to the earliest pending event (message
        deliveries, per-node timers, churn) and open a window of
        ``window_ns`` nanoseconds;
-    2. group all messages due in the window by destination (one sort) and
+    2. group all messages due in the window by destination (R rounds of
+       scatter-min selection — zero full-pool sorts; engine/pool.py) and
        run the vmapped per-node logic step — each node consumes up to R
        messages plus its due timers and appends to a bounded outbox;
     3. push the outbox through the analytic underlay delay model and write
@@ -56,6 +57,9 @@ class EngineParams:
 
     window: float = 0.010          # tick window (s)
     inbox_slots: int = 8           # R — msgs consumed per node per tick
+    inbox_impl: str = "scatter"    # inbox grouping: "scatter" (zero-sort
+                                   # scatter-min rounds, default) | "sort"
+                                   # (legacy full-pool lexicographic sort)
     outbox_slots: int = 16         # MOUT — msgs emitted per node per tick
     pool_factor: int = 8           # P = pool_factor * N message slots
     rmax: int = 16                 # node-list payload width
@@ -156,11 +160,12 @@ class Simulation:
 
     # -- one tick -----------------------------------------------------------
     #
-    # The tick is split into five PHASE methods (horizon / churn / inbox /
-    # node_step / alloc_stats) so oversim_tpu/profiling.py can jit and
-    # time each phase separately under OVERSIM_PROFILE=1.  ``step``
-    # composes them; under one jit the split is invisible to XLA (same
-    # fused graph as the old monolithic step).
+    # The tick is split into PHASE methods (horizon / churn /
+    # inbox_select / inbox_gather / node_step / alloc_stats) so
+    # oversim_tpu/profiling.py can jit and time each phase separately
+    # under OVERSIM_PROFILE=1.  ``step`` composes them; under one jit the
+    # split is invisible to XLA (same fused graph as the old monolithic
+    # step).
 
     def _phase_horizon(self, s: SimState):
         """Phase 1/5: advance to the event horizon + per-tick rng split."""
@@ -208,14 +213,18 @@ class Simulation:
                                   r_reset)
         return churn_state, alive, pre_killed, node_keys, ul_state, logic_state
 
-    def _phase_inbox(self, s: SimState, t_next, t_end, alive):
-        """Phase 3/5: group due messages by destination — ONE gather of
-        the packed [P, W] block for all the 32-bit fields (pool.py packed
-        layout, PERFORMANCE.md lever #3) behind the tick's single
-        full-pool sort."""
-        n, ep = self.n, self.ep
-        inbox, delivered, to_dead = pool_mod.build_inbox(
-            s.pool, n, ep.inbox_slots, t_end, alive)
+    def _phase_inbox_select(self, s: SimState, t_end, alive):
+        """Phase 3a: pick each destination's R earliest due messages
+        (scatter-min rounds by default — zero full-pool sorts; see
+        engine/pool.py and ``EngineParams.inbox_impl``)."""
+        return pool_mod.build_inbox(
+            s.pool, self.n, self.ep.inbox_slots, t_end, alive,
+            impl=self.ep.inbox_impl)
+
+    def _phase_inbox_gather(self, s: SimState, t_next, inbox):
+        """Phase 3b: ONE gather of the packed [P, W] block for all the
+        32-bit fields of the selected messages (pool.py packed layout,
+        PERFORMANCE.md lever #3) into the [N, R] Msg view."""
         safe = jnp.maximum(inbox, 0)
         blk = s.pool.blk[safe]                        # [N, R, W]
         ncol = len(pool_mod.SCAL_COLS)
@@ -232,6 +241,13 @@ class Simulation:
             c=col("c"), d=col("d"),
             nodes=blk[..., ncol + s.pool.kl:], size_b=col("size_b"),
             stamp=s.pool.stamp[safe])
+        return msgs
+
+    def _phase_inbox(self, s: SimState, t_next, t_end, alive):
+        """Phase 3: inbox select + gather composed (profiling.py times
+        the two halves separately)."""
+        inbox, delivered, to_dead = self._phase_inbox_select(s, t_end, alive)
+        msgs = self._phase_inbox_gather(s, t_next, inbox)
         return msgs, delivered, to_dead
 
     def _phase_node_step(self, s: SimState, t_next, t_end, alive, pre_killed,

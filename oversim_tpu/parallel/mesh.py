@@ -9,9 +9,13 @@ under `jit` with GSPMD partitioning — XLA inserts the collectives:
 
   * the global key-table gathers (`ctx.keys[slot]`) become all-gathers of
     the [N, KL] key table (small: 20 B/node) over ICI;
-  * the pool's sort-based inbox grouping (engine/pool.py) becomes a
-    distributed `lax.sort` (XLA's partitioned sort = local sort +
-    all-to-all merge exchange);
+  * the pool's scatter-min inbox selection (engine/pool.py, default
+    ``inbox_impl="scatter"``) partitions into a LOCAL per-shard
+    select + an all-reduce-min of the [N] per-destination minima —
+    O(N) reduction traffic per round instead of the legacy sort path's
+    all-to-all merge exchange (XLA's partitioned `lax.sort` moves the
+    whole [P] pool's keys across chips; still taken under
+    ``inbox_impl="sort"``);
   * per-node vmapped logic stays fully local to each shard (the dominant
     FLOPs — finger scans, key arithmetic — never cross chips);
   * scalar stats/counters are replicated and all-reduced.

@@ -3,20 +3,27 @@
 The tick graph is op-issue/compile-bound and opaque: when a bench run
 dies or posts a bad number, nothing says WHICH of the tick's phases ate
 the time (the round-5 bench artifact was a deadline-killed 0.0 with no
-diagnosis).  This module times the five phases of ``Simulation.step``
+diagnosis).  This module times the phases of ``Simulation.step``
 (engine/sim.py splits them exactly for this):
 
-  horizon      event-horizon scan + rng split
-  churn        churn events, alive flips, key/coord migration, resets
-  inbox        due-message grouping (the tick's single full-pool sort)
-  node_step    tick context + the vmapped per-node logic sweep
-  alloc_stats  underlay send, sort-free pool alloc, stat folding
+  horizon       event-horizon scan + rng split
+  churn         churn events, alive flips, key/coord migration, resets
+  inbox_select  due-message top-R selection (scatter-min rounds by
+                default; the legacy full-pool sort under
+                inbox_impl="sort")
+  inbox_gather  packed-block gather of the selected messages → Msg view
+  node_step     tick context + the vmapped per-node logic sweep
+  alloc_stats   underlay send, sort-free pool alloc, stat folding
 
 Each phase is jitted SEPARATELY and timed with ``block_until_ready``
 over ``n_ticks`` real ticks.  Sub-jits lose cross-phase fusion, so the
 phase sum exceeds the fused tick cost — the per-phase SHARES are the
 diagnostic signal, and the fused cost is measured alongside via
-``run_chunk`` for the honest denominator.
+``run_chunk`` for the honest denominator.  The report also carries
+``sort_count`` / ``scatter_count`` pinned-op counts off the fused
+compiled tick (scripts/hlo_breakdown.py counting rules), so a lever
+regression (a sort sneaking back into the hot path) shows up in every
+profiled bench artifact.
 
 Usage:
     from oversim_tpu import profiling
@@ -35,7 +42,8 @@ import time
 
 import jax
 
-PHASES = ("horizon", "churn", "inbox", "node_step", "alloc_stats")
+PHASES = ("horizon", "churn", "inbox_select", "inbox_gather", "node_step",
+          "alloc_stats")
 
 
 def enabled() -> bool:
@@ -44,7 +52,7 @@ def enabled() -> bool:
 
 
 def _jit_phases(sim):
-    """Jit the five phase methods of a Simulation (closures keep ``sim``
+    """Jit the phase methods of a Simulation (closures keep ``sim``
     static, mirroring run_chunk's static ``self``)."""
     return {
         "horizon": jax.jit(
@@ -52,8 +60,10 @@ def _jit_phases(sim):
         "churn": jax.jit(
             lambda s, tn, te, rc, rk, rr, rm: sim._phase_churn(
                 s, tn, te, rc, rk, rr, rm)),
-        "inbox": jax.jit(
-            lambda s, tn, te, alive: sim._phase_inbox(s, tn, te, alive)),
+        "inbox_select": jax.jit(
+            lambda s, te, alive: sim._phase_inbox_select(s, te, alive)),
+        "inbox_gather": jax.jit(
+            lambda s, tn, inbox: sim._phase_inbox_gather(s, tn, inbox)),
         "node_step": jax.jit(
             lambda s, tn, te, alive, pk, cs, nk, ul, lg, msgs, rn:
             sim._phase_node_step(s, tn, te, alive, pk, cs, nk, ul, lg,
@@ -66,13 +76,30 @@ def _jit_phases(sim):
     }
 
 
-def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True):
+def tick_op_counts(sim, s) -> dict:
+    """sort/scatter pinned-op counts off the FUSED compiled tick.
+
+    Compiles ``jit(sim.step)`` (cache-shared with run_chunk's scan body
+    where the backend persists compilations) and applies the
+    scripts/hlo_breakdown.py counting rules.  Returns {} when the
+    backend does not expose compiled HLO text (some tunnel plugins).
+    """
+    try:
+        from scripts.hlo_breakdown import hlo_op_counts
+        txt = jax.jit(sim.step).lower(s).compile().as_text()
+        return hlo_op_counts(txt, sim.ep.pool_factor * sim.n)
+    except Exception:  # noqa: BLE001 — diagnostics must never kill a bench
+        return {}
+
+
+def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
+                  op_counts: bool = True):
     """Run ``n_ticks`` real ticks phase by phase, timing each phase.
 
     Returns ``(report, s)`` — the report dict (JSON-serializable) and
     the advanced SimState (the profiled ticks are real simulation
     progress; callers keep using the returned state).  The first tick
-    pays all five phase compiles and is EXCLUDED from the averages.
+    pays all phase compiles and is EXCLUDED from the averages.
     """
     fns = _jit_phases(sim)
     totals = {p: 0.0 for p in PHASES}
@@ -96,9 +123,14 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True):
         dt_c = time.perf_counter() - t0
 
         t0 = time.perf_counter()
-        msgs, delivered, to_dead = jax.block_until_ready(
-            fns["inbox"](s, t_next, t_end, alive))
-        dt_i = time.perf_counter() - t0
+        inbox, delivered, to_dead = jax.block_until_ready(
+            fns["inbox_select"](s, t_end, alive))
+        dt_is = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        msgs = jax.block_until_ready(
+            fns["inbox_gather"](s, t_next, inbox))
+        dt_ig = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         (logic_state, out_fields, out_valid, out_overflow, events,
@@ -120,7 +152,7 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True):
             compile_s = time.perf_counter() - t_tick0
             continue
         measured += 1
-        for p, dt in zip(PHASES, (dt_h, dt_c, dt_i, dt_n, dt_a)):
+        for p, dt in zip(PHASES, (dt_h, dt_c, dt_is, dt_ig, dt_n, dt_a)):
             totals[p] += dt
 
     denom = max(measured, 1)
@@ -129,12 +161,16 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True):
     report = {
         "metric": "tick_phase_breakdown",
         "n_ticks": measured,
+        "inbox_impl": sim.ep.inbox_impl,
         "phase_ms_per_tick": phase_ms,
         "phase_frac": {p: round(totals[p] / max(sum(totals.values()), 1e-12),
                                 4) for p in PHASES},
         "split_sum_ms_per_tick": round(split_sum * 1e3, 3),
         "phase_compile_s": round(compile_s, 2),
     }
+
+    if op_counts:
+        report.update(tick_op_counts(sim, s))
 
     if fused_reference:
         # fused cost via run_chunk (donating; rebind s both times).  The

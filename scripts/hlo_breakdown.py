@@ -1,9 +1,25 @@
 """Compile the bench-shaped sim step and break the optimized HLO down by
 opcode — evidence for which op classes dominate the op-issue-bound tick.
 
-Usage: python scripts/hlo_breakdown.py [n] [overlay] [window] [inbox]
-Prints: instruction counts by opcode inside the scan body, the largest
-sort/scatter/gather shapes, and fusion count.
+Usage:
+  python scripts/hlo_breakdown.py [n] [overlay] [window] [inbox]
+      Prints instruction counts by opcode inside the scan body, the
+      largest sort/scatter/gather shapes, and fusion count.
+  python scripts/hlo_breakdown.py --budget [n] [overlay] [window] [inbox]
+      Compiles ONE tick and exits non-zero when the HLO exceeds the
+      pinned op budget: zero full-pool sorts (inbox_impl="scatter"
+      default) and at most 200 scatter ops (overlay logic contributes
+      ~120-150 small per-node scatters; the engine's own share is
+      ``8 + 2*inbox``).  Override with --max-sorts / --max-scatters.
+      Wired into the fast test tier via tests/test_engine.py, which
+      calls :func:`hlo_op_counts` / :func:`check_budget` on its own
+      compiled tick.
+
+The counting helpers are import-safe (no jax import at module level):
+XLA-CPU at -O0 expands scatters into ``while`` loops (ScatterExpander),
+so :func:`hlo_op_counts` counts native ``scatter(`` ops PLUS while ops
+carrying a ``.../scatter`` op_name — the same graph compiled for TPU
+keeps them as native scatters.
 """
 
 import collections
@@ -12,9 +28,6 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-sys.modules["zstandard"] = None
-
 T0 = time.time()
 
 
@@ -22,79 +35,183 @@ def log(msg):
     print(f"[{time.time() - T0:6.1f}s] {msg}", flush=True)
 
 
-import jax
+# ---------------------------------------------------------------------------
+# pure HLO-text analysis (import-safe; used by tests/test_engine.py)
+# ---------------------------------------------------------------------------
 
-from oversim_tpu.hostcache import cache_dir as _host_cache_dir
+_SCATTER_WHILE = re.compile(r'op_name="[^"]*/scatter')
 
-from jax._src import compilation_cache as _cc
-for attr in ("zstandard", "zstd"):
-    if getattr(_cc, attr, None) is not None:
-        setattr(_cc, attr, None)
 
-jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+def hlo_op_counts(txt: str, pool_dim: int | None = None) -> dict:
+    """Count sort/scatter ops in optimized HLO text.
 
-n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-overlay = sys.argv[2] if len(sys.argv) > 2 else "kademlia"
-window = float(sys.argv[3]) if len(sys.argv) > 3 else 0.2
-inbox = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    Returns ``{"sort_count", "full_pool_sort_count", "scatter_count"}``.
+    ``full_pool_sort_count`` counts sorts whose operand shape contains
+    the pool dimension ``pool_dim`` (0 when pool_dim is None).
+    ``scatter_count`` = native ``scatter(`` ops + XLA-CPU's
+    scatter-expanded ``while`` loops (identified by op_name metadata).
+    """
+    sorts = full = scatters = 0
+    for ln in txt.splitlines():
+        if " sort(" in ln:
+            sorts += 1
+            if pool_dim is not None and f"[{pool_dim}" in ln:
+                full += 1
+        elif " scatter(" in ln:
+            scatters += 1
+        elif " while(" in ln and _SCATTER_WHILE.search(ln):
+            scatters += 1
+    return {"sort_count": sorts, "full_pool_sort_count": full,
+            "scatter_count": scatters}
 
-from oversim_tpu import churn as churn_mod
-from oversim_tpu.apps import kbrtest
-from oversim_tpu.apps.kbrtest import KbrTestApp
-from oversim_tpu.common import lookup as lk_mod
-from oversim_tpu.engine import sim as sim_mod
 
-app = KbrTestApp(kbrtest.KbrTestParams(test_interval=0.2))
-if overlay == "chord":
-    from oversim_tpu.overlay.chord import ChordLogic
-    logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
-else:
-    from oversim_tpu.overlay.kademlia import KademliaLogic
-    logic = KademliaLogic(app=app,
-                          lcfg=lk_mod.LookupConfig(slots=8, merge=True))
-cp = churn_mod.ChurnParams(model="none", target_num=n,
-                           init_interval=20.0 / n, init_deviation=2.0 / n)
-ep = sim_mod.EngineParams(window=window, inbox_slots=inbox, pool_factor=4)
-sim = sim_mod.Simulation(logic, cp, engine_params=ep)
-s = sim.init(seed=7)
-log("init done")
+def check_budget(txt: str, pool_dim: int, max_full_pool_sorts: int,
+                 max_scatters: int):
+    """(ok, counts) — does the compiled tick fit the pinned op budget?"""
+    counts = hlo_op_counts(txt, pool_dim)
+    ok = (counts["full_pool_sort_count"] <= max_full_pool_sorts
+          and counts["scatter_count"] <= max_scatters)
+    return ok, counts
 
-lowered = sim.run_chunk.lower(sim, s, 4)
-log("lowered")
-compiled = lowered.compile()
-log("compiled")
-txt = compiled.as_text()
-log(f"text: {len(txt)} chars, {txt.count(chr(10))} lines")
 
-# find the while-loop body computation (the scan body = one tick)
-# opcode histogram over every computation, plus top-level of body
-op_re = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\]{}, ]+\s+(\w+)\(")
-counts = collections.Counter()
-big = collections.Counter()
-cur_comp = None
-comp_sizes = collections.Counter()
-for line in txt.splitlines():
-    m_hdr = re.match(r"^\s*(?:ENTRY\s+)?(%?[\w.\-]+)\s.*\{\s*(//.*)?$", line)
-    if m_hdr:
-        cur_comp = m_hdr.group(1).lstrip("%")
-    m = op_re.match(line)
-    if m:
-        op = m.group(1)
-        counts[op] += 1
-        comp_sizes[cur_comp] += 1
-        if op in ("sort", "scatter", "gather", "custom-call", "all-to-all",
-                  "while", "dynamic-update-slice", "reduce"):
-            shape = line.split("=", 1)[1].strip().split(" ")[0]
-            big[f"{op} {shape[:70]}"] += 1
+# ---------------------------------------------------------------------------
+# CLI: compile + report / budget-check
+# ---------------------------------------------------------------------------
 
-log("opcode histogram (all computations):")
-for op, c in counts.most_common(25):
-    print(f"  {op:26s} {c}")
-log("sort/scatter/gather shapes (top 30):")
-for k, c in big.most_common(30):
-    print(f"  {c:4d}x {k}")
-log("largest computations:")
-for name, c in comp_sizes.most_common(10):
-    print(f"  {c:6d} ops  {name}")
+def _setup_jax():
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    sys.modules["zstandard"] = None
+    import jax
+
+    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
+    from jax._src import compilation_cache as _cc
+    for attr in ("zstandard", "zstd"):
+        if getattr(_cc, attr, None) is not None:
+            setattr(_cc, attr, None)
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def _build_sim(n, overlay, window, inbox, pool_factor=4, inbox_impl="scatter"):
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu.apps import kbrtest
+    from oversim_tpu.apps.kbrtest import KbrTestApp
+    from oversim_tpu.common import lookup as lk_mod
+    from oversim_tpu.engine import sim as sim_mod
+
+    app = KbrTestApp(kbrtest.KbrTestParams(test_interval=0.2))
+    if overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app,
+                              lcfg=lk_mod.LookupConfig(slots=8, merge=True))
+    cp = churn_mod.ChurnParams(model="none", target_num=n,
+                               init_interval=20.0 / n,
+                               init_deviation=2.0 / n)
+    ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
+                              pool_factor=pool_factor, inbox_impl=inbox_impl)
+    return sim_mod.Simulation(logic, cp, engine_params=ep)
+
+
+def budget_main(n, overlay, window, inbox, max_sorts, max_scatters) -> int:
+    """Compile one tick, check the sort/scatter budget, exit non-zero on
+    breach (the --budget mode)."""
+    jax = _setup_jax()
+    sim = _build_sim(n, overlay, window, inbox)
+    s = sim.init(seed=7)
+    log("init done")
+    txt = jax.jit(sim.step).lower(s).compile().as_text()
+    log(f"one-tick HLO compiled: {txt.count(chr(10))} lines")
+    pool_dim = sim.ep.pool_factor * n
+    if max_scatters is None:
+        # measured: kademlia 151 / chord 123 scatters at inbox=8 (mostly
+        # per-node logic scatters) — 200 catches gross regressions while
+        # the zero-full-pool-sort pin stays the sharp budget
+        max_scatters = 200
+    ok, counts = check_budget(txt, pool_dim, max_sorts, max_scatters)
+    print(f"budget: full_pool_sorts {counts['full_pool_sort_count']} "
+          f"(max {max_sorts}), scatters {counts['scatter_count']} "
+          f"(max {max_scatters}), total sorts {counts['sort_count']} "
+          f"-> {'OK' if ok else 'EXCEEDED'}", flush=True)
+    return 0 if ok else 1
+
+
+def breakdown_main(n, overlay, window, inbox) -> int:
+    jax = _setup_jax()
+    sim = _build_sim(n, overlay, window, inbox)
+    s = sim.init(seed=7)
+    log("init done")
+
+    lowered = sim.run_chunk.lower(sim, s, 4)
+    log("lowered")
+    compiled = lowered.compile()
+    log("compiled")
+    txt = compiled.as_text()
+    log(f"text: {len(txt)} chars, {txt.count(chr(10))} lines")
+
+    # find the while-loop body computation (the scan body = one tick)
+    # opcode histogram over every computation, plus top-level of body
+    op_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\]{}, ]+\s+(\w+)\(")
+    counts = collections.Counter()
+    big = collections.Counter()
+    cur_comp = None
+    comp_sizes = collections.Counter()
+    for line in txt.splitlines():
+        m_hdr = re.match(r"^\s*(?:ENTRY\s+)?(%?[\w.\-]+)\s.*\{\s*(//.*)?$",
+                         line)
+        if m_hdr:
+            cur_comp = m_hdr.group(1).lstrip("%")
+        m = op_re.match(line)
+        if m:
+            op = m.group(1)
+            counts[op] += 1
+            comp_sizes[cur_comp] += 1
+            if op in ("sort", "scatter", "gather", "custom-call",
+                      "all-to-all", "while", "dynamic-update-slice",
+                      "reduce"):
+                shape = line.split("=", 1)[1].strip().split(" ")[0]
+                big[f"{op} {shape[:70]}"] += 1
+
+    log("opcode histogram (all computations):")
+    for op, c in counts.most_common(25):
+        print(f"  {op:26s} {c}")
+    log("sort/scatter/gather shapes (top 30):")
+    for k, c in big.most_common(30):
+        print(f"  {c:4d}x {k}")
+    log("largest computations:")
+    for name, c in comp_sizes.most_common(10):
+        print(f"  {c:6d} ops  {name}")
+    ops = hlo_op_counts(txt, sim.ep.pool_factor * n)
+    log(f"pinned-op summary: {ops}")
+    return 0
+
+
+def main(argv) -> int:
+    budget = "--budget" in argv
+    argv = [a for a in argv if a != "--budget"]
+    max_sorts, max_scatters = 0, None
+    if "--max-sorts" in argv:
+        i = argv.index("--max-sorts")
+        max_sorts = int(argv[i + 1])
+        del argv[i:i + 2]
+    if "--max-scatters" in argv:
+        i = argv.index("--max-scatters")
+        max_scatters = int(argv[i + 1])
+        del argv[i:i + 2]
+    n = int(argv[1]) if len(argv) > 1 else (256 if budget else 4096)
+    overlay = argv[2] if len(argv) > 2 else "kademlia"
+    window = float(argv[3]) if len(argv) > 3 else 0.2
+    inbox = int(argv[4]) if len(argv) > 4 else 8
+    if budget:
+        return budget_main(n, overlay, window, inbox, max_sorts,
+                           max_scatters)
+    return breakdown_main(n, overlay, window, inbox)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

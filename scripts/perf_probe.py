@@ -85,6 +85,17 @@ for i in range(4):
     dt = time.perf_counter() - t
     log(f"chunk{i + 2}: {dt:.3f}s = {dt / chunk * 1e3:.1f} ms/tick")
 
+# device-resident loop: the same 4-chunk span as ONE dispatch
+# (run_until_device's lax.while_loop) — the gap vs 4x run_chunk is the
+# per-chunk host dispatch + sync overhead the bench loop no longer pays
+target_s = float(s.t_now) / 1e9 + 4 * chunk * sim.ep.window
+t = time.perf_counter()
+s = sim.run_until_device(s, target_s, chunk=chunk)
+jax.block_until_ready(s.t_now)
+dt = time.perf_counter() - t
+log(f"run_until_device (4 chunks, 1 dispatch): {dt:.3f}s = "
+    f"{dt / (4 * chunk) * 1e3:.1f} ms/tick")
+
 from oversim_tpu import profiling  # noqa: E402
 
 if profiling.enabled():

@@ -117,32 +117,33 @@ def _build(jax, overlay, n, churn, window, interval=0.2):
 
 
 def ladder_row(jax, overlay, n, measure_wall):
-    """Throughput measurement at N: warm, then measured windows."""
+    """Throughput measurement at N: warm, then measured windows — both
+    device-resident (run_until_device; one dispatch + one device_get of
+    the counter leaves per window, the bench.py round-7 loop)."""
+    from bench import _fetch_window_leaves, _summary_from_leaves
     sim, cp = _build(jax, overlay, n, "none", window=0.2)
     dev = jax.devices()[0]
     st = sim.init(seed=7)
     warm_until = cp.init_finished_time + 20.0
     t0 = time.time()
-    st = sim.run_until(st, warm_until, chunk=64)
-    jax.block_until_ready(st.t_now)
+    st = sim.run_until_device(st, warm_until, chunk=64)
+    base = _summary_from_leaves(_fetch_window_leaves(st))
     compile_wall = time.time() - t0
-    base = sim.summary(st)
     t0 = time.time()
     sim_t = warm_until
     rate = 0.0
     delivered = sent = 0
+    out = base
     while time.time() - t0 < measure_wall and _remaining() > 30:
         sim_t += 64 * 0.2
-        st = sim.run_until(st, sim_t, chunk=64)
-        jax.block_until_ready(st.t_now)
-        out = sim.summary(st)
+        st = sim.run_until_device(st, sim_t, chunk=64)
+        out = _summary_from_leaves(_fetch_window_leaves(st))
         wall = time.time() - t0
         delivered = out["kbr_delivered"] - base["kbr_delivered"]
         sent = out["kbr_sent"] - base["kbr_sent"]
         rate = delivered / wall if wall else 0.0
     if delivered == 0:
         return None   # deadline ate the measure loop — keep cached rows
-    out = sim.summary(st)
     eng = out["_engine"]
     return {
         "mode": "ladder", "overlay": overlay, "n": n,
@@ -169,7 +170,9 @@ def churn_row(jax, overlay, n, t_sim):
     sim_t = 0.0
     while sim_t < target and _remaining() > 60:
         sim_t = min(sim_t + step * 4, target)
-        st = sim.run_until(st, sim_t, chunk=64)
+        # device-resident advance; the block is the deadline guard's one
+        # host sync per outer iteration
+        st = sim.run_until_device(st, sim_t, chunk=64)
         jax.block_until_ready(st.t_now)
     from oversim_tpu import profiling
     if profiling.enabled() and _remaining() > 90:

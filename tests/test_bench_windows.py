@@ -1,0 +1,92 @@
+"""bench.run_measurement_windows dispatch-count pins (round 7).
+
+The bench measurement loop must be device-resident: per wall-clock
+window exactly ONE ``run_until_device`` dispatch and ONE host sync (a
+single ``jax.device_get`` of the counter leaves in
+``_fetch_window_leaves``).  A fake-timer fake-sim pins that contract
+without touching a backend — a regression back to per-chunk syncing
+shows up here as extra dispatch or fetch calls.
+"""
+
+import numpy as np
+
+import bench
+
+
+class FakeState:
+    """Quacks like SimState for bench's leaf fetch: numpy leaves only."""
+
+    def __init__(self, t_now=0, tick=0):
+        self.stats = {"c:fake_counter": np.int64(tick)}
+        self.counters = {"pool_overflow": np.int64(0)}
+        self.t_now = np.int64(t_now)
+        self.tick = np.int64(tick)
+        self.alive = np.ones((4,), bool)
+
+
+class FakeSim:
+    """Counts dispatches; each run_until_device jumps to the target."""
+
+    def __init__(self):
+        self.device_calls = []
+        self.host_calls = []
+
+    def run_until_device(self, s, t_sim, chunk=256):
+        self.device_calls.append((float(t_sim), chunk))
+        return FakeState(t_now=int(t_sim * 1e9), tick=s.tick + chunk)
+
+    def run_until(self, s, t_sim, chunk=256, check_invariants=None):
+        self.host_calls.append((float(t_sim), chunk, check_invariants))
+        return FakeState(t_now=int(t_sim * 1e9), tick=s.tick + chunk)
+
+
+class FakeClock:
+    """now() advances 10 fake-seconds per call — fully deterministic."""
+
+    def __init__(self, dt=10.0):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        t, self.t = self.t, self.t + self.dt
+        return t
+
+
+def test_one_dispatch_and_one_fetch_per_window(monkeypatch):
+    fetches = []
+    real_fetch = bench._fetch_window_leaves
+    monkeypatch.setattr(bench, "_fetch_window_leaves",
+                        lambda s: fetches.append(1) or real_fetch(s))
+    sim = FakeSim()
+    summaries = []
+    # clock: t0=0; cond at 10/30/50 pass, at 70 fails -> exactly 3 windows
+    s, windows = bench.run_measurement_windows(
+        sim, FakeState(), start_sim_t=100.0, window_sim_s=6.25,
+        measure_wall=55.0, chunk=32,
+        on_window=lambda out, wall: summaries.append((out, wall)),
+        now=FakeClock(dt=10.0))
+    assert windows == 3
+    assert len(sim.device_calls) == 3          # ONE dispatch per window
+    assert len(fetches) == 3                   # ONE device_get per window
+    assert sim.host_calls == []                # host loop never engaged
+    # each window advances the sim-time target by exactly one window span
+    targets = [t for t, _ in sim.device_calls]
+    assert targets == [100.0 + 6.25 * k for k in (1, 2, 3)]
+    assert all(chunk == 32 for _, chunk in sim.device_calls)
+    # the summary handed to on_window came from the fetched leaves
+    assert [out["fake_counter"] for out, _ in summaries] == [32, 64, 96]
+    assert [wall for _, wall in summaries] == [20.0, 40.0, 60.0]
+    assert s.tick == 96
+
+
+def test_host_loop_mode_uses_run_until_with_invariants():
+    """OVERSIM_INVARIANTS=1 debug tier: the per-chunk-synced run_until
+    (with the structural validator on) replaces the device loop."""
+    sim = FakeSim()
+    _, windows = bench.run_measurement_windows(
+        sim, FakeState(), start_sim_t=0.0, window_sim_s=1.0,
+        measure_wall=35.0, chunk=8, on_window=lambda out, wall: None,
+        host_loop=True, now=FakeClock(dt=10.0))
+    assert windows == 2
+    assert sim.device_calls == []
+    assert sim.host_calls == [(1.0, 8, True), (2.0, 8, True)]

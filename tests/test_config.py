@@ -50,6 +50,14 @@ INI = textwrap.dedent("""
     [Config Kad]
     **.overlayType = "oversim.overlay.kademlia.KademliaModules"
     **.overlay*.kademlia.k = 16
+
+    [Config KadSortInbox]
+    extends = Kad
+    **.inboxImpl = "sort"
+
+    [Config KadBadInbox]
+    extends = Kad
+    **.inboxImpl = "bogosort"
 """)
 
 
@@ -91,6 +99,16 @@ def test_scenario_kademlia(ini):
     assert isinstance(sim.logic, KademliaLogic)
     assert sim.logic.p.k == 16
     assert sim.logic.lcfg.merge is True
+    assert sim.ep.inbox_impl == "scatter"        # zero-sort default
+
+
+def test_scenario_inbox_impl_key(ini):
+    """``**.inboxImpl`` selects the inbox grouping implementation
+    (engine/pool.py); anything but scatter/sort is a config error."""
+    sim = scenario.build_simulation(ini, "KadSortInbox")
+    assert sim.ep.inbox_impl == "sort"
+    with pytest.raises(scenario.ScenarioError):
+        scenario.build_simulation(ini, "KadBadInbox")
 
 
 @pytest.mark.skipif(

@@ -76,6 +76,83 @@ def test_pool_alloc_inbox_free_roundtrip():
     assert ts2[0] == 9
 
 
+def test_inbox_impl_identity_randomized_pool():
+    """sort vs scatter inbox grouping must be BIT-IDENTICAL on random
+    pools — including t_deliver ties (index tie-break), dead
+    destinations and R-overflow rows."""
+    rng = np.random.default_rng(42)
+    sort_j = jax.jit(pool_mod.build_inbox_sort, static_argnames=("n", "r"))
+    scat_j = jax.jit(pool_mod.build_inbox_scatter, static_argnames=("n", "r"))
+    # fixed shapes -> ONE compile per impl; randomness lives in the data
+    n, p, r = 7, 40, 3
+    base = pool_mod.empty(p, key_lanes=5, rmax=4)
+    for trial in range(40):
+        valid = rng.random(p) < 0.7
+        t = rng.integers(0, 6, size=p).astype(np.int64)  # coarse → ties
+        dst = rng.integers(0, n, size=p).astype(np.int32)
+        pool = dataclasses.replace(
+            base,
+            valid=jnp.asarray(valid),
+            t_deliver=jnp.where(jnp.asarray(valid), jnp.asarray(t),
+                                pool_mod.T_INF),
+            blk=base.blk.at[:, pool_mod._COL["dst"]].set(jnp.asarray(dst)))
+        alive = jnp.asarray(rng.random(n) < 0.8)
+        t_end = jnp.int64(int(rng.integers(1, 8)))
+        a = sort_j(pool, n=n, r=r, t_end=t_end, alive=alive)
+        b = scat_j(pool, n=n, r=r, t_end=t_end, alive=alive)
+        for x, y, name in zip(a, b, ("inbox", "delivered", "dropped_dead")):
+            assert (np.asarray(x) == np.asarray(y)).all(), (trial, name)
+
+
+def test_inbox_overflow_keeps_earliest_r():
+    """A node with more than R due messages must receive exactly the R
+    EARLIEST (t_deliver, idx)-ordered ones this tick; the overflow stays
+    valid in the pool and delivers next tick (backpressure, not loss) —
+    pinned on both implementations."""
+    p = pool_mod.empty(16, key_lanes=5, rmax=4)
+    q = 6
+    out = {
+        # node 0 gets 6 due messages, R=2: ties at t=3 break by index
+        "t_deliver": jnp.asarray([5, 3, 3, 7, 4, 6], I64),
+        "src": jnp.arange(q, dtype=I32),
+        "dst": jnp.zeros((q,), I32),
+        "kind": jnp.full((q,), 7, I32),
+        "key": jnp.zeros((q, 5), jnp.uint32),
+        "nonce": jnp.arange(q, dtype=I32),
+        "hops": jnp.zeros((q,), I32),
+        "a": jnp.zeros((q,), I32), "b": jnp.zeros((q,), I32),
+        "c": jnp.zeros((q,), I32), "d": jnp.zeros((q,), I32),
+        "nodes": jnp.full((q, 4), -1, I32),
+        "size_b": jnp.zeros((q,), I32),
+        "stamp": jnp.zeros((q,), I64),
+    }
+    p, _ = pool_mod.alloc(p, out, jnp.ones((q,), bool))
+    alive = jnp.ones((2,), bool)
+    for impl in ("sort", "scatter"):
+        inbox, delivered, _ = pool_mod.build_inbox(
+            p, n=2, r=2, t_end=jnp.int64(10), alive=alive, impl=impl)
+        # earliest two: t=3@idx1, t=3@idx2 (tie → lower pool index first)
+        assert list(np.asarray(inbox[0])) == [1, 2], impl
+        assert int(jnp.sum(delivered)) == 2, impl
+        # the four overflow messages stay pooled for next tick
+        p2 = pool_mod.free(p, delivered)
+        assert int(jnp.sum(p2.valid)) == 4, impl
+        inbox2, delivered2, _ = pool_mod.build_inbox(
+            p2, n=2, r=2, t_end=jnp.int64(10), alive=alive, impl=impl)
+        assert list(np.asarray(inbox2[0])) == [4, 0], impl  # t=4 then t=5
+
+
+def test_build_inbox_rejects_unknown_impl():
+    p = pool_mod.empty(8, key_lanes=5, rmax=4)
+    try:
+        pool_mod.build_inbox(p, n=2, r=2, t_end=jnp.int64(1),
+                             alive=jnp.ones((2,), bool), impl="quantum")
+    except ValueError as e:
+        assert "inbox_impl" in str(e)
+    else:
+        raise AssertionError("unknown impl accepted")
+
+
 def test_pool_overflow_counted():
     p = pool_mod.empty(4, key_lanes=5, rmax=4)
     q = 6
@@ -168,11 +245,11 @@ class PingLogic:
         return st, out, events
 
 
-def make_sim(n=16, window=0.010):
+def make_sim(n=16, window=0.010, inbox_impl="scatter"):
     logic = PingLogic()
     cp = churn_mod.ChurnParams(model="none", target_num=n, init_interval=0.1)
     ep = EngineParams(window=window, inbox_slots=4, outbox_slots=8,
-                      pool_factor=8, rmax=4)
+                      pool_factor=8, rmax=4, inbox_impl=inbox_impl)
     return Simulation(logic, cp, underlay_mod.UnderlayParams(), ep)
 
 
@@ -200,13 +277,30 @@ def test_ping_pong_end_to_end():
 # hot-path structure: sort count, donation, device-resident loop
 # ---------------------------------------------------------------------------
 
-def test_tick_has_at_most_one_full_pool_sort():
-    """The sort-free allocator (engine/pool.py alloc) leaves the inbox
-    grouping as the tick's ONLY full-pool sort — pin that on the
-    compiled HLO so a regression back to sort-based allocation (or a
-    new accidental O(P log P) pass) fails loudly.  n=24 makes the pool
-    dimension P = 24*8 = 192 distinctive in shape strings."""
+def test_tick_hlo_zero_sorts_bounded_scatters():
+    """The default scatter-min inbox leaves the tick graph with ZERO
+    full-pool sorts, and the scatter count stays within the engine
+    budget (8 baseline scatters — outbox alloc, stat hists, misc — plus
+    2 per inbox round), pinned via scripts/hlo_breakdown.py's counting
+    helpers so the --budget CLI and this test share one definition.
+    n=24 makes the pool dimension P = 24*8 = 192 distinctive in shape
+    strings."""
+    from scripts.hlo_breakdown import check_budget
     sim = make_sim(n=24)
+    s = sim.init(seed=1)
+    txt = jax.jit(lambda st: sim.step(st)).lower(s).compile().as_text()
+    ok, counts = check_budget(
+        txt, pool_dim=192, max_full_pool_sorts=0,
+        max_scatters=8 + 2 * sim.ep.inbox_slots)
+    assert ok, counts
+    assert counts["full_pool_sort_count"] == 0, counts
+
+
+def test_tick_sort_impl_has_at_most_one_full_pool_sort():
+    """The legacy inbox_impl="sort" path keeps its old pin: the inbox
+    grouping is the tick's ONLY full-pool sort (the outbox allocator
+    stays sort-free)."""
+    sim = make_sim(n=24, inbox_impl="sort")
     s = sim.init(seed=1)
     txt = jax.jit(lambda st: sim.step(st)).lower(s).compile().as_text()
     full_pool_sorts = [ln for ln in txt.splitlines()
@@ -252,6 +346,61 @@ def test_run_until_device_matches_host_loop_chord64():
     assert oa.keys() == ob.keys()
     for k in oa:
         assert str(oa[k]) == str(ob[k]), k
+
+
+def _inbox_identity_run(overlay: str, n_ticks: int = 64, seed: int = 3):
+    """Run ``n_ticks`` overlay ticks under LifetimeChurn; at every tick
+    compare the sort and scatter inbox selections on the SAME pool/alive
+    snapshot (one fused scan, one dispatch).  Returns per-tick equality,
+    due-message counts and dead-destination drop counts."""
+    if overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic()
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic()
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=12,
+                               init_interval=0.2, lifetime_mean=8.0)
+    ep = EngineParams(window=0.1, inbox_slots=4, pool_factor=4)
+    sim = Simulation(logic, cp, engine_params=ep)
+    s = sim.init(seed=seed)
+
+    def body(st, _):
+        t_next, t_end, rngs = sim._phase_horizon(st)
+        _, alive, *_rest = sim._phase_churn(
+            st, t_next, t_end, rngs[1], rngs[2], rngs[3], rngs[5])
+        a = pool_mod.build_inbox_sort(
+            st.pool, sim.n, sim.ep.inbox_slots, t_end, alive)
+        b = pool_mod.build_inbox_scatter(
+            st.pool, sim.n, sim.ep.inbox_slots, t_end, alive)
+        same = jnp.array(True)
+        for x, y in zip(a, b):
+            same &= jnp.array_equal(x, y)
+        due = jnp.sum(st.pool.valid & (st.pool.t_deliver < t_end))
+        return sim.step(st), (same, due, jnp.sum(a[2]))
+
+    @jax.jit
+    def run(st):
+        return jax.lax.scan(body, st, None, length=n_ticks)
+
+    final, (same, due, to_dead) = run(s)
+    return (np.asarray(same), np.asarray(due), np.asarray(to_dead),
+            int(jnp.sum(final.alive)))
+
+
+def test_inbox_impl_identity_chord_under_churn():
+    """Satellite pin: sort vs scatter inbox selection is bit-identical
+    (inbox, delivered, dropped_dead) across 64 chord ticks with lifetime
+    churn killing/rebirthing nodes mid-run."""
+    same, due, to_dead, _alive = _inbox_identity_run("chord")
+    assert same.all(), f"first divergent tick: {int(np.argmin(same))}"
+    assert int(due.sum()) > 50, int(due.sum())   # the run carried traffic
+
+
+def test_inbox_impl_identity_kademlia_under_churn():
+    same, due, to_dead, _alive = _inbox_identity_run("kademlia")
+    assert same.all(), f"first divergent tick: {int(np.argmin(same))}"
+    assert int(due.sum()) > 50, int(due.sum())
 
 
 def test_ping_rtt_matches_analytic_delay():

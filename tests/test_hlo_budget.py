@@ -1,0 +1,49 @@
+"""Pure-text pins for scripts/hlo_breakdown.py's op-budget helpers.
+
+The helpers must count BOTH HLO spellings of a scatter: native
+`` scatter(`` ops (TPU) and the ``while`` loops XLA-CPU's
+ScatterExpander rewrites them into at -O0 (identified by the
+``.../scatter`` op_name metadata).  These tests run on synthetic HLO
+text — no backend, no compile — so they stay in the fast tier even
+when the compiled-tick pins (tests/test_engine.py) move to slow.
+"""
+
+from scripts.hlo_breakdown import check_budget, hlo_op_counts
+
+FAKE_HLO = """\
+HloModule step
+  %s0 = (s64[192]) sort(s64[192] %a, s32[192] %b), dimensions={0}
+  %s1 = s32[16,8] sort(s32[16,8] %c), dimensions={1}
+  %sc0 = s64[64] scatter(s64[64] %d, s32[10] %i, s64[10] %u)
+  %w0 = (s64[64],s32[]) while((s64[64],s32[]) %t), body=%b1, \
+metadata={op_name="jit(step)/jit(main)/scatter"}
+  %w1 = (s64[64],s32[]) while((s64[64],s32[]) %t2), body=%b2, \
+metadata={op_name="jit(step)/jit(main)/while"}
+  %w2 = (s64[64],s32[]) while((s64[64],s32[]) %t3), body=%b3, \
+metadata={op_name="jit(step)/scatter_min[update_jaxpr=None]"}
+"""
+
+
+def test_hlo_op_counts_both_scatter_spellings():
+    counts = hlo_op_counts(FAKE_HLO, pool_dim=192)
+    assert counts["sort_count"] == 2
+    assert counts["full_pool_sort_count"] == 1   # only the [192] sort
+    # 1 native scatter + 2 while-expanded (w1 is a plain while: not one)
+    assert counts["scatter_count"] == 3
+
+
+def test_hlo_op_counts_without_pool_dim():
+    counts = hlo_op_counts(FAKE_HLO)
+    assert counts["full_pool_sort_count"] == 0
+
+
+def test_check_budget_pass_and_breach():
+    ok, counts = check_budget(FAKE_HLO, pool_dim=192,
+                              max_full_pool_sorts=1, max_scatters=3)
+    assert ok, counts
+    ok, _ = check_budget(FAKE_HLO, pool_dim=192,
+                         max_full_pool_sorts=0, max_scatters=3)
+    assert not ok                                # the full-pool sort breaches
+    ok, _ = check_budget(FAKE_HLO, pool_dim=192,
+                         max_full_pool_sorts=1, max_scatters=2)
+    assert not ok                                # the scatter count breaches
