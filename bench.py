@@ -36,6 +36,19 @@ OVERSIM_BENCH_DEADLINE (orchestrator kill + exit-0 watchdog, s),
 OVERSIM_BENCH_CHUNK (scan ticks per while_loop body; default 256 TPU /
 32 CPU).
 
+OVERSIM_BENCH_REPLICAS=S (S >= 1) switches to the CAMPAIGN tier: one
+vmapped program advances S independent replicas of the same scenario
+(oversim_tpu/campaign/), replica axis sharded across the visible devices
+when S divides their count.  The emitted rate is the AGGREGATE
+lookups/s summed over replicas — the compile-amortization headline
+(PERFORMANCE.md round 8): one compile, S times the batch.
+
+OVERSIM_BENCH_ARTIFACT=path makes the orchestrator ALSO persist every
+relayed measurement record to ``path`` incrementally — the file is
+rewritten atomically (tmp + os.replace) after EVERY window, so a SIGKILL
+at any point leaves a valid, parseable JSON artifact of everything
+measured so far ({"records": [...], "final": last, "complete": bool}).
+
 OVERSIM_PROFILE=1 additionally emits a per-phase tick-time breakdown
 (oversim_tpu/profiling.py) as a ``tick_phase_breakdown`` JSON line
 before the measurement windows — see PERFORMANCE.md for the format.
@@ -90,6 +103,51 @@ CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_cache.json")
 
 
+def atomic_write_json(path: str, obj) -> None:
+    """Crash-safe JSON write: tmp file + os.replace.  A reader (or the
+    driver, after SIGKILLing us) either sees the previous complete file
+    or the new complete file — never a torn write."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+class ArtifactWriter:
+    """Incremental measurement artifact (OVERSIM_BENCH_ARTIFACT /
+    OVERSIM_SCALE_ARTIFACT): every ``add`` rewrites the whole file
+    atomically, so the artifact is valid JSON after every window and a
+    deadline SIGKILL merely truncates the record LIST, never the file."""
+
+    def __init__(self, path: str | None):
+        self.path = path
+        self.records = []
+        if path:
+            self._flush(complete=False)
+
+    def add(self, record: dict) -> None:
+        if not self.path:
+            return
+        self.records.append(record)
+        self._flush(complete=False)
+
+    def finish(self) -> None:
+        if self.path:
+            self._flush(complete=True)
+
+    def _flush(self, *, complete: bool) -> None:
+        atomic_write_json(self.path, {
+            "records": self.records,
+            "final": self.records[-1] if self.records else None,
+            "complete": complete,
+        })
+
+
 def _load_cached_tpu() -> dict | None:
     """Last committed on-chip measurement (written by the child whenever
     a HEALTHY TPU window completes; survives rounds in git).  Entries
@@ -116,12 +174,16 @@ def orchestrate() -> int:
     # an EXPLICIT cpu request means the operator wants the host number —
     # no cached-TPU substitution, no suppression
     cpu_requested = os.environ.get("OVERSIM_BENCH_PLATFORM") == "cpu"
+    artifact = ArtifactWriter(os.environ.get("OVERSIM_BENCH_ARTIFACT"))
     fallback = None if cpu_requested else _load_cached_tpu()
     if fallback is not None:
         print(json.dumps(fallback), flush=True)
+        artifact.add(fallback)
     else:
-        print(_json_line(0.0, "lookups/s (provisional: no measurement "
-                              "completed yet)"), flush=True)
+        prov = _json_line(0.0, "lookups/s (provisional: no measurement "
+                               "completed yet)")
+        print(prov, flush=True)
+        artifact.add(json.loads(prov))
     env = dict(os.environ, OVERSIM_BENCH_CHILD="1")
     child = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                              stdout=subprocess.PIPE, text=True, env=env)
@@ -154,6 +216,7 @@ def orchestrate() -> int:
             # tick_phase_breakdown) are relayed verbatim but never enter
             # the measurement-record logic below
             print(line, flush=True)
+            artifact.add(parsed)
             continue
         on_cpu = "cpu" in parsed.get("unit", "cpu")
         if on_cpu and not cpu_requested and (saw_tpu or fallback is not None):
@@ -165,6 +228,7 @@ def orchestrate() -> int:
         if not on_cpu and last_line_healthy:
             last_healthy_tpu = line
         print(line, flush=True)  # the driver parses the LAST line
+        artifact.add(parsed)     # atomic rewrite after EVERY window
     child.wait()
     if saw_tpu and not last_line_healthy:
         # the FINAL printed window failed the delivery gate — it must
@@ -175,12 +239,14 @@ def orchestrate() -> int:
         # is the honest record of a run with no valid measurement.
         if last_healthy_tpu is not None:
             print(last_healthy_tpu, flush=True)
+            artifact.add(json.loads(last_healthy_tpu))
         elif fallback is not None:
             fallback = dict(fallback)
             fallback["cached"] = True
             fallback["unit"] += (" [cached: fresh windows failed "
                                  "delivery gate]")
             print(json.dumps(fallback), flush=True)
+            artifact.add(fallback)
     if not saw_tpu and fallback is not None:
         # re-emit so the LAST line the driver parses is the chip number —
         # machine-readably marked as a cache replay (ADVICE r4)
@@ -189,6 +255,8 @@ def orchestrate() -> int:
         if "cached" not in fallback["unit"]:
             fallback["unit"] += " [cached measurement; tunnel down this run]"
         print(json.dumps(fallback), flush=True)
+        artifact.add(fallback)
+    artifact.finish()
     sys.stderr.write("bench: child rc=%s, done in %.0fs\n"
                      % (child.returncode, time.time() - _T0))
     return 0
@@ -219,9 +287,38 @@ def _summary_from_leaves(leaves) -> dict:
     return out
 
 
+def _campaign_summary_from_leaves(leaves) -> dict:
+    """Campaign tier: every leaf carries a leading [S] replica axis.
+    Aggregate ACROSS replicas first (scalar accumulators merge exactly:
+    sum n/sum/sumsq, min of mins, max of maxes; hist + counter leaves
+    just sum), then reuse the single-run ``summarize`` — so the emitted
+    record keeps the exact schema of the solo tier and ``on_window``'s
+    delivery gate needs no campaign awareness."""
+    import numpy as np
+    from oversim_tpu import stats as stats_mod
+    agg = {}
+    for key, v in leaves["stats"].items():
+        v = np.asarray(v)
+        if key.startswith("s:"):
+            agg[key] = np.concatenate(
+                [v[:, :3].sum(axis=0), [v[:, 3].min()], [v[:, 4].max()]])
+        else:
+            agg[key] = v.sum(axis=0)
+    out = stats_mod.summarize(agg)
+    out["_engine"] = {k: int(np.asarray(v).sum())
+                      for k, v in leaves["counters"].items()}
+    # replicas advance on independent event horizons — report the
+    # LAGGING clock so "simulated seconds covered" is never overstated
+    out["_t_sim"] = float(np.asarray(leaves["t_now"]).min()) / 1e9
+    out["_ticks"] = int(np.asarray(leaves["tick"]).sum())
+    out["_alive"] = int(np.asarray(leaves["alive"]).sum())
+    return out
+
+
 def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
                             measure_wall, chunk, on_window,
-                            host_loop=False, now=time.perf_counter):
+                            host_loop=False, now=time.perf_counter,
+                            summarize_leaves=_summary_from_leaves):
     """Drive wall-clock measurement windows, device-resident.
 
     Each window advances the sim by ``window_sim_s`` simulated seconds
@@ -232,6 +329,9 @@ def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
     checking — the OVERSIM_INVARIANTS=1 debug tier.  Returns
     ``(s, n_windows)``.  Tested against a fake-timer simulation in
     tests/test_bench_windows.py (exactly one dispatch per window).
+    ``summarize_leaves`` turns the fetched counter leaves into the
+    per-window summary — the campaign tier passes
+    ``_campaign_summary_from_leaves`` (leaves carry a [S] replica axis).
     """
     t0 = now()
     sim_t = start_sim_t
@@ -242,7 +342,7 @@ def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
             s = sim.run_until(s, sim_t, chunk=chunk, check_invariants=True)
         else:
             s = sim.run_until_device(s, sim_t, chunk=chunk)
-        summary = _summary_from_leaves(_fetch_window_leaves(s))
+        summary = summarize_leaves(_fetch_window_leaves(s))
         windows += 1
         on_window(summary, now() - t0)
     return s, windows
@@ -405,21 +505,54 @@ def child_main():
                               pool_factor=pool_f)
     sim = sim_mod.Simulation(logic, cp, engine_params=ep)
 
-    s = sim.init(seed=7)
+    # OVERSIM_BENCH_REPLICAS=S: campaign tier — S independent replicas
+    # as ONE vmapped program (oversim_tpu/campaign/), replica axis
+    # sharded when S divides the device count.  The campaign run loop is
+    # device-resident only (no host-synced invariant tier).
+    replicas = int(os.environ.get("OVERSIM_BENCH_REPLICAS", "0"))
+    camp = None
+    summarize_leaves = _summary_from_leaves
+    if replicas >= 1:
+        from oversim_tpu.campaign import Campaign, CampaignParams
+        camp = Campaign(sim, CampaignParams(replicas=replicas, base_seed=7))
+        summarize_leaves = _campaign_summary_from_leaves
+        if host_loop:
+            sys.stderr.write("bench: OVERSIM_INVARIANTS ignored on the "
+                             "campaign tier (device loop only)\n")
+            host_loop = False
+
     warm_until = cp.init_finished_time + warm_extra
     t0 = time.perf_counter()
+    if camp is None:
+        s = sim.init(seed=7)
+        runner = sim
+    else:
+        s = camp.init()
+        runner = camp
+        # shard over the LARGEST device count that divides S (even
+        # split keeps the replica axis collective-free)
+        avail = len(jax.devices())
+        n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                    if camp.s % d == 0)
+        if n_dev > 1:
+            from oversim_tpu.parallel import mesh as mesh_mod
+            mesh = mesh_mod.make_replica_mesh(n_dev)
+            s = mesh_mod.shard_campaign_state(s, mesh)
+        sys.stderr.write("bench: campaign S=%d over %d device(s)\n"
+                         % (camp.s, n_dev))
     if host_loop:
         s = sim.run_until(s, warm_until, chunk=chunk, check_invariants=True)
     else:
-        s = sim.run_until_device(s, warm_until, chunk=chunk)
-    base = _summary_from_leaves(_fetch_window_leaves(s))
+        s = runner.run_until_device(s, warm_until, chunk=chunk)
+    base = summarize_leaves(_fetch_window_leaves(s))
+    warm_wall = time.perf_counter() - t0
     sys.stderr.write("bench: warmup (%.0f sim-s) took %.1fs wall\n"
-                     % (warm_until, time.perf_counter() - t0))
+                     % (warm_until, warm_wall))
     sys.stderr.write("bench: post-warm counters %r alive=%d\n"
                      % (base["_engine"], base["_alive"]))
 
     from oversim_tpu import profiling
-    if profiling.enabled():
+    if camp is None and profiling.enabled():
         # OVERSIM_PROFILE=1: per-phase tick-time breakdown as a JSON
         # side-channel line (the orchestrator relays it; the driver's
         # record stays the last kbr_lookups_per_sec line).  Profiled
@@ -448,15 +581,20 @@ def child_main():
                     and v - base["_engine"].get(k, 0) > 0}
         delivery = delivered / sent if sent else 0.0
         healthy = sent > 0 and delivery >= 0.95 and not overflow
-        unit = (f"lookups/s ({overlay} {n} nodes, {dev.platform}, "
+        shape = (f"{n} nodes" if camp is None
+                 else f"{n} nodes x {camp.s} replicas")
+        unit = (f"lookups/s ({overlay} {shape}, {dev.platform}, "
                 f"delivery {delivered}/{sent}, {out['_ticks']} ticks, "
                 f"{wall:.1f}s wall)")
-        line = _json_line(rate, unit, healthy=healthy,
-                          extra={"delivery": round(delivery, 4),
-                                 "measured_utc": time.strftime(
-                                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
+        extra = {"delivery": round(delivery, 4),
+                 "measured_utc": time.strftime(
+                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        if camp is not None:
+            extra["replicas"] = camp.s
+            extra["warm_wall_s"] = round(warm_wall, 1)
+        line = _json_line(rate, unit, healthy=healthy, extra=extra)
         print(line, flush=True)
-        if not on_cpu and delivered > 0 and healthy:
+        if not on_cpu and delivered > 0 and healthy and camp is None:
             # persist the chip measurement for the cached-fallback path
             try:
                 with open(CACHE_PATH + ".tmp", "w") as f:
@@ -470,9 +608,9 @@ def child_main():
                             out["_engine"]))
 
     s, _ = run_measurement_windows(
-        sim, s, start_sim_t=warm_until, window_sim_s=chunk * window,
+        runner, s, start_sim_t=warm_until, window_sim_s=chunk * window,
         measure_wall=measure_wall, chunk=chunk, on_window=on_window,
-        host_loop=host_loop)
+        host_loop=host_loop, summarize_leaves=summarize_leaves)
 
 
 def main():

@@ -267,6 +267,16 @@ class I3App:
         # (I3.cc sendPacket "send to all friends") — which is what makes
         # a shared identifier a multicast group (i3Apps/I3Multicast.cc).
         en = m.valid & (m.kind == wire.I3_PACKET)
+        # ``c`` multiplexes chain depth (low 16 bits) with the typed
+        # payload kind (high bits, biased by +1 so 0 = "not encoded"):
+        # the cross-server KBR_ROUTE leg below needs ``d`` for the decap
+        # kind (common/route.py reads msgs.d at delivery), so the
+        # payload kind from the sample apps (i3apps.py D_*) rides c's
+        # high bits through the route and is restored here.  Direct
+        # I3_PACKET sends never set the high bits → pk == 0 → m.d wins.
+        depth = m.c & 0xFFFF
+        pk = m.c >> 16
+        d_eff = jnp.where(pk > 0, pk - 1, m.d)
         live = (app.tr_id >= 0) & (app.tr_expire > now)
         xor = jnp.bitwise_xor(app.tr_id, m.a).astype(jnp.uint32)
         # shared leading bits of two 32-bit ids = clz(xor) (32 on equal)
@@ -281,7 +291,7 @@ class I3App:
         # (per trigger — each set member carries its own stack).  Chain
         # depth rides ``c`` (``hops`` belongs to the route layer),
         # bounded by stack_hop_max; plain triggers deliver to the owner.
-        chain_v = grp & (app.tr_next >= 0) & (m.c < p.stack_hop_max)
+        chain_v = grp & (app.tr_next >= 0) & (depth < p.stack_hop_max)
         deliver_v = grp & ~chain_v
         # CROSS-SERVER continuation: when the stored stack entry carries
         # the continuation's full overlay key and the overlay processes
@@ -299,10 +309,14 @@ class I3App:
                 vis0 = vis0.at[:ew].set(0)
             have_key = jnp.any(app.tr_next_key != 0, axis=-1)      # [D]
             cross_v = chain_v & have_key
+            # the typed payload kind survives the route leg in c's high
+            # bits (route.py forwards + decapsulates ``c`` untouched);
+            # ``d`` must stay I3_PACKET — it becomes the kind at decap
             ob.send(cross_v, now, m.dst, wire.KBR_ROUTE,
                     key=app.tr_next_key,
                     d=jnp.int32(wire.I3_PACKET), a=app.tr_next, b=m.b,
-                    c=m.c + 1, hops=0, nodes=vis0, stamp=m.stamp,
+                    c=((d_eff + 1) << 16) | (depth + 1),
+                    hops=0, nodes=vis0, stamp=m.stamp,
                     size_b=p.payload_bytes + self.rcfg.overhead_b)
             chain_local = chain_v & ~have_key
         else:
@@ -310,12 +324,12 @@ class I3App:
         # local-rematch fallback (no full key / no recursive routing):
         # the packet re-enters this server's own table next tick
         ob.send(chain_local, now, m.dst, wire.I3_PACKET, a=app.tr_next,
-                b=m.b, c=m.c + 1, d=m.d, stamp=m.stamp,
+                b=m.b, c=depth + 1, d=d_eff, stamp=m.stamp,
                 size_b=p.payload_bytes)
         # ``d`` carries the sample apps' payload kind end-to-end
         # (I3SessionMessage-style typed payloads, i3Apps/*.cc)
         ob.send(deliver_v, now, jnp.maximum(app.tr_owner, 0),
-                wire.I3_DELIVER, a=m.a, b=m.b, d=m.d, stamp=m.stamp,
+                wire.I3_DELIVER, a=m.a, b=m.b, d=d_eff, stamp=m.stamp,
                 size_b=p.payload_bytes)
 
         # delivery at the trigger owner

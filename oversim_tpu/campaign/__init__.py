@@ -1,0 +1,11 @@
+"""Campaign runner: S independent replicas as ONE compiled program.
+
+See oversim_tpu/campaign/runner.py for the implementation and
+README.md / COVERAGE.md ("Campaign subsystem") for the user guide.
+"""
+
+from oversim_tpu.campaign.runner import (  # noqa: F401
+    Campaign,
+    CampaignParams,
+    expand_grid,
+)

@@ -122,18 +122,26 @@ def _with_grace(state_kw, n):
     return state_kw
 
 
-def _draw_lifetime(rng, p: ChurnParams, shape):
-    """Session/dead-time draw (LifetimeChurn::distributionFunction)."""
+def _draw_lifetime(rng, p: ChurnParams, shape, mean=None):
+    """Session/dead-time draw (LifetimeChurn::distributionFunction).
+
+    ``mean`` overrides ``p.lifetime_mean`` and may be a TRACED scalar —
+    the campaign runner sweeps churn intensity across replicas inside
+    one compiled program (oversim_tpu/campaign/).  All three
+    distributions take the mean as an array-valued scale, so the same
+    graph serves every replica."""
+    if mean is None:
+        mean = p.lifetime_mean
     if p.lifetime_dist == "weibull":
-        scale = p.lifetime_mean / math.gamma(1.0 + 1.0 / p.lifetime_par1)
+        scale = mean / math.gamma(1.0 + 1.0 / p.lifetime_par1)
         return jax.random.weibull_min(rng, scale, p.lifetime_par1, shape)
     if p.lifetime_dist == "pareto_shifted":
         k = p.lifetime_par1
-        scale = p.lifetime_mean * (k - 1.0) / k
+        scale = mean * (k - 1.0) / k
         u = jax.random.uniform(rng, shape)
         return scale * (jnp.power(u, -1.0 / k) - 1.0)
     if p.lifetime_dist == "truncnormal":
-        return _truncnormal(rng, p.lifetime_mean, p.lifetime_mean / 3.0, shape)
+        return _truncnormal(rng, mean, mean / 3.0, shape)
     raise ValueError(f"unknown lifetime distribution {p.lifetime_dist}")
 
 
@@ -145,7 +153,11 @@ def _shifted_pareto(rng, alpha: float, mean, shape=()):
     return mean * 2.0 * (jnp.power(u, -1.0 / alpha) - 1.0)
 
 
-def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
+def init(rng: jax.Array, p: ChurnParams, life_mean=None) -> ChurnState:
+    """``life_mean`` (optional, may be traced) overrides
+    ``p.lifetime_mean`` for the lifetime model's session draws — the
+    campaign sweep axis.  ``None`` keeps the static-param graph
+    bit-identical to before."""
     n = p.num_slots
     tgt = p.target_num
     # NOTE: l_mean/d_mean must be DISTINCT arrays — a shared object
@@ -176,9 +188,10 @@ def init(rng: jax.Array, p: ChurnParams) -> ChurnState:
         i = jnp.arange(tgt)
         first_create = _truncnormal(r1, p.init_interval * i,
                                     p.init_deviation, (tgt,))
-        first_kill = fin + _draw_lifetime(r2, p, (tgt,))
-        second_create = fin + _draw_lifetime(r3, p, (tgt,))
-        second_kill = second_create + _draw_lifetime(r4, p, (tgt,))
+        first_kill = fin + _draw_lifetime(r2, p, (tgt,), mean=life_mean)
+        second_create = fin + _draw_lifetime(r3, p, (tgt,), mean=life_mean)
+        second_kill = second_create + _draw_lifetime(r4, p, (tgt,),
+                                                     mean=life_mean)
         t_create = jnp.concatenate([first_create, second_create])
         t_kill = jnp.concatenate([first_kill, second_kill])
         # pre-kill (leave notification) fires gracefulLeaveDelay before
@@ -259,7 +272,8 @@ def next_event(state: ChurnState):
     return jnp.minimum(t, jnp.min(state.t_dead))
 
 
-def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
+def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng,
+         life_mean=None):
     """Fire create/pre-kill/kill events inside [t_start, t_end).
 
     Returns (state', created, killed, leaving — all [N] bool).  A pre-kill
@@ -296,8 +310,10 @@ def step(state: ChurnState, p: ChurnParams, alive, t_start, t_end, rng):
 
     if p.model == "lifetime":
         r1, r2 = jax.random.split(rng)
-        dead_time = (_draw_lifetime(r1, p, (n,)) * NS).astype(I64)
-        lifetime = (_draw_lifetime(r2, p, (n,)) * NS).astype(I64)
+        dead_time = (_draw_lifetime(r1, p, (n,), mean=life_mean)
+                     * NS).astype(I64)
+        lifetime = (_draw_lifetime(r2, p, (n,), mean=life_mean)
+                    * NS).astype(I64)
         next_create = state.t_kill + dead_time
         next_kill = jnp.maximum(next_create + lifetime - grace_ns,
                                 next_create)

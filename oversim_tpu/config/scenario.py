@@ -511,3 +511,63 @@ def build_simulation(ini: IniFile, config: str = "General",
         raise ScenarioError(f"unsupported overlayType: {overlay_type!r}")
 
     return sim_mod.Simulation(logic, cp, up, ep, underlay_module=ul_mod)
+
+
+# -- campaign (multi-replica) configuration ---------------------------------
+#
+# Framework ini extension (no reference equivalent — the reference runs
+# repetitions as separate ./OverSim -r N processes):
+#
+#   **.campaign.replicas  = 8            seed replicas per grid point
+#   **.campaign.baseSeed  = 1            replica r rng = fold_in(seed, r)
+#   **.campaign.sweep.lifetimeMean    = "5000 10000 20000"
+#   **.campaign.sweep.testMsgInterval = "10, 60"
+#   **.campaign.sweep.window          = "0.05 0.1"
+#
+# Sweep values are space/comma-separated (quotes optional); declared
+# axes form a cartesian grid, total replicas S = replicas × grid size.
+
+_SWEEP_KEYS = (
+    ("**.campaign.sweep.lifetimeMean", "churn.lifetimeMean"),
+    ("**.campaign.sweep.testMsgInterval", "app.testMsgInterval"),
+    ("**.campaign.sweep.window", "engine.window"),
+)
+
+
+def _sweep_values(raw, key):
+    s = str(raw).strip().strip('"')
+    try:
+        vals = tuple(float(x) for x in s.replace(",", " ").split())
+    except ValueError:
+        vals = ()
+    if not vals:
+        raise ScenarioError(f"bad sweep value list for {key}: {raw!r}")
+    return vals
+
+
+def build_campaign_params(ini: IniFile, config: str = "General"):
+    """``**.campaign.*`` keys → CampaignParams (see the comment above)."""
+    from oversim_tpu.campaign import CampaignParams
+    replicas = int(_value(ini.get("**.campaign.replicas", config), 1))
+    if replicas < 1:
+        raise ScenarioError(f"**.campaign.replicas must be >= 1, "
+                            f"got {replicas}")
+    base_seed = int(_value(ini.get("**.campaign.baseSeed", config), 1))
+    sweep = []
+    for ini_key, ov_name in _SWEEP_KEYS:
+        raw = _value(ini.get(ini_key, config))
+        if raw is None:
+            continue
+        sweep.append((ov_name, _sweep_values(raw, ini_key)))
+    return CampaignParams(replicas=replicas, base_seed=base_seed,
+                          sweep=tuple(sweep))
+
+
+def build_campaign(ini: IniFile, config: str = "General",
+                   engine_params: sim_mod.EngineParams | None = None,
+                   trace_events=None):
+    """build_simulation + ``**.campaign.*`` keys → a Campaign driver."""
+    from oversim_tpu.campaign import Campaign
+    sim = build_simulation(ini, config, engine_params=engine_params,
+                           trace_events=trace_events)
+    return Campaign(sim, build_campaign_params(ini, config))

@@ -86,6 +86,16 @@ class Ctx:
     node_type: object = None    # [N] i32
     conn: object = None         # [T, T] bool connectivity matrix
     ready_cum_t: object = None  # [T, N] i32 per-type ready cumsums
+    # campaign sweep overrides: {dotted-name: traced scalar} or None.
+    # Handlers opt in via ov_get(); absent keys keep the static-param
+    # code path so a no-sweep trace stays bit-identical.
+    ov: object = None
+
+    def ov_get(self, name, default=None):
+        """Traced sweep-override lookup (trace-time dict access)."""
+        if self.ov is None:
+            return default
+        return self.ov.get(name, default)
 
     def sample_ready(self, rng, me=None):
         """Draw a uniformly random READY node slot (-1 if none).

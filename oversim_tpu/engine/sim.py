@@ -135,13 +135,24 @@ class Simulation:
 
     # -- init ---------------------------------------------------------------
 
-    def init(self, seed: int = 1) -> SimState:
-        rng = jax.random.PRNGKey(seed)
+    def init(self, seed: int = 1, ov=None) -> SimState:
+        return _dedupe_buffers(
+            self.init_from_rng(jax.random.PRNGKey(seed), ov=ov))
+
+    def init_from_rng(self, rng: jax.Array, ov=None) -> SimState:
+        """Pure-JAX init from an explicit PRNG key (vmappable — the
+        campaign runner vmaps this over per-replica folded keys).  ``ov``
+        is an optional {dotted-name: scalar} sweep-override dict (values
+        may be traced); ``None`` reproduces ``init(seed)`` bit-exactly.
+        NOTE: no ``_dedupe_buffers`` here — under a trace there are no
+        device buffers to compare; callers holding concrete outputs
+        (``init``, campaign stacked init) apply it host-side."""
         (r_keys, r_ul, r_churn, r_logic, r_run,
          r_mal) = jax.random.split(rng, 6)
         n = self.n
+        life_mean = None if ov is None else ov.get("churn.lifetimeMean")
         node_keys = keys_mod.random_keys(r_keys, (n,), self.spec)
-        return _dedupe_buffers(SimState(
+        return SimState(
             t_now=jnp.int64(0),
             tick=jnp.int64(0),
             rng=r_run,
@@ -150,13 +161,13 @@ class Simulation:
             underlay=self.ul.init(r_ul, n, self.up),
             pool=pool_mod.empty(self.ep.pool_factor * n, self.spec.lanes,
                                 self.ep.rmax),
-            churn=churn_mod.init(r_churn, self.cp),
+            churn=churn_mod.init(r_churn, self.cp, life_mean=life_mean),
             malicious=(jax.random.uniform(r_mal, (n,))
                        < self.ep.malicious.probability),
             logic=self.logic.init(r_logic, n),
             stats=stats_mod.init_stats(self.logic.stat_spec()),
             counters={name: jnp.zeros((), I64) for name in ENGINE_COUNTERS},
-        ))
+        )
 
     # -- one tick -----------------------------------------------------------
     #
@@ -167,9 +178,14 @@ class Simulation:
     # split is invisible to XLA (same fused graph as the old monolithic
     # step).
 
-    def _phase_horizon(self, s: SimState):
+    def _phase_horizon(self, s: SimState, *, ov=None):
         """Phase 1/5: advance to the event horizon + per-tick rng split."""
-        window_ns = jnp.int64(int(self.ep.window * NS))
+        w = None if ov is None else ov.get("engine.window")
+        if w is None:
+            window_ns = jnp.int64(int(self.ep.window * NS))
+        else:
+            # traced sweep value (campaign grid over the tick window)
+            window_ns = (jnp.asarray(w) * NS).astype(I64)
         t_next = jnp.minimum(
             pool_mod.next_deliver_time(s.pool),
             jnp.minimum(
@@ -184,12 +200,14 @@ class Simulation:
         return t_next, t_end, rngs
 
     def _phase_churn(self, s: SimState, t_next, t_end, r_churn, r_keys,
-                     r_reset, r_mig):
+                     r_reset, r_mig, *, ov=None):
         """Phase 2/5: churn events (incl. graceful-leave grace windows)."""
         n, cp, up = self.n, self.cp, self.up
         logic = self.logic
+        life_mean = None if ov is None else ov.get("churn.lifetimeMean")
         churn_state, created, killed, _leaving = churn_mod.step(
-            s.churn, cp, s.alive, t_next, t_end, r_churn)
+            s.churn, cp, s.alive, t_next, t_end, r_churn,
+            life_mean=life_mean)
         alive = (s.alive | created) & ~killed
         # pre-killed nodes run until their final kill but leave the
         # bootstrap oracle immediately (preKillNode removePeer,
@@ -252,7 +270,7 @@ class Simulation:
 
     def _phase_node_step(self, s: SimState, t_next, t_end, alive, pre_killed,
                          churn_state, node_keys, ul_state, logic_state, msgs,
-                         r_nodes):
+                         r_nodes, *, ov=None):
         """Phase 4/5: tick context + the vmapped per-node logic step."""
         n, ep, up, cp = self.n, self.ep, self.up, self.cp
         logic = self.logic
@@ -285,7 +303,7 @@ class Simulation:
                   n_ready=ready_cumsum[-1], measuring=measuring, glob=glob,
                   leaving=pre_killed & alive,
                   graceful=pre_killed & alive & churn_state.graceful,
-                  malicious=s.malicious,
+                  malicious=s.malicious, ov=ov,
                   **part_kw)
         node_rngs = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
             jax.random.fold_in(r_nodes, s.tick), jnp.arange(n))
@@ -357,18 +375,25 @@ class Simulation:
                         logic=logic_state, stats=new_stats,
                         counters=counters)
 
-    def step(self, s: SimState) -> SimState:
-        """One tick: the five phases composed (see the phase methods)."""
-        t_next, t_end, rngs = self._phase_horizon(s)
+    def step(self, s: SimState, *, ov=None) -> SimState:
+        """One tick: the five phases composed (see the phase methods).
+
+        ``ov`` — optional {dotted-name: scalar} sweep-override dict
+        (values may be traced; see oversim_tpu/campaign/).  Recognised
+        keys: ``engine.window``, ``churn.lifetimeMean``, plus any
+        ``app.*`` key a handler reads via ``Ctx.ov_get``.  ``None``
+        (the default everywhere) keeps the trace bit-identical to the
+        pre-campaign engine."""
+        t_next, t_end, rngs = self._phase_horizon(s, ov=ov)
         (rng, r_churn, r_keys, r_reset, r_nodes, r_mig, r_send) = rngs
         (churn_state, alive, pre_killed, node_keys, ul_state,
          logic_state) = self._phase_churn(s, t_next, t_end, r_churn, r_keys,
-                                          r_reset, r_mig)
+                                          r_reset, r_mig, ov=ov)
         msgs, delivered, to_dead = self._phase_inbox(s, t_next, t_end, alive)
         (logic_state, out_fields, out_valid, out_overflow, events,
          measuring) = self._phase_node_step(
             s, t_next, t_end, alive, pre_killed, churn_state, node_keys,
-            ul_state, logic_state, msgs, r_nodes)
+            ul_state, logic_state, msgs, r_nodes, ov=ov)
         return self._phase_alloc_stats(
             s, t_end, rng, r_send, alive, pre_killed, node_keys, ul_state,
             churn_state, logic_state, delivered, to_dead, out_fields,

@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "nodes"
+REPLICA_AXIS = "replicas"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -43,6 +44,22 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices).reshape(-1), (NODE_AXIS,))
+
+
+def make_replica_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the REPLICA axis (oversim_tpu/campaign/).
+
+    Campaign state leaves are [S, ...]; sharding the leading replica
+    axis is pure data parallelism — replicas never exchange data inside
+    the tick, so the partitioned step compiles with ZERO cross-replica
+    collectives (pinned by scripts/hlo_breakdown.py --campaign and
+    tests/test_campaign.py): 4 chips run 4× replicas at solo speed.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices).reshape(-1), (REPLICA_AXIS,))
 
 
 def state_shardings(state, mesh: Mesh):
@@ -63,6 +80,60 @@ def state_shardings(state, mesh: Mesh):
 def shard_state(state, mesh: Mesh):
     """Place a SimState onto the mesh with node-axis sharding."""
     return jax.device_put(state, state_shardings(state, mesh))
+
+
+def campaign_state_shardings(cs, mesh: Mesh):
+    """NamedSharding pytree for a stacked [S, ...] campaign state:
+    shard the leading REPLICA axis of every leaf whose first dim divides
+    evenly over the mesh; replicate the rest (per-replica scalars like
+    t_now are [S] and shard too — they are one element per replica)."""
+    n_dev = mesh.devices.size
+
+    def spec(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] % n_dev == 0 and leaf.shape[0] > 0:
+            return NamedSharding(
+                mesh, P(REPLICA_AXIS, *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec, cs)
+
+
+def shard_campaign_state(cs, mesh: Mesh):
+    """Place a stacked campaign state onto the mesh, replica-sharded."""
+    return jax.device_put(cs, campaign_state_shardings(cs, mesh))
+
+
+def jit_campaign_run_until(camp, mesh: Mesh, chunk: int = 64,
+                           donate: bool = True):
+    """jit a replica-sharded ``(cs, target_ns) -> cs`` campaign runner.
+
+    The campaign analogue of ``jit_run_until``: a donated
+    ``lax.while_loop`` of ``chunk``-tick vmapped scans with cond
+    ``any(t_now < target_ns)`` (all replicas run until the slowest
+    passes).  The only cross-device op the cond needs is a reduce over
+    the [S] t_now vector — outside the tick body; the tick itself has
+    zero cross-replica collectives.
+    """
+    example = camp.init()
+    shardings = campaign_state_shardings(example, mesh)
+
+    def run(cs, target_ns):
+        def cond(carry):
+            return jnp.any(carry.t_now < target_ns)
+
+        def body(carry):
+            def sbody(c, _):
+                return camp._vstep(c), None
+            c, _ = jax.lax.scan(sbody, carry, None, length=chunk)
+            return c
+
+        return jax.lax.while_loop(cond, body, cs)
+
+    return jax.jit(run,
+                   in_shardings=(shardings, NamedSharding(mesh, P())),
+                   out_shardings=shardings,
+                   donate_argnums=(0,) if donate else ())
 
 
 def jit_step(sim, mesh: Mesh, donate: bool = True):

@@ -104,3 +104,165 @@ def summarize(stats: dict) -> dict:
         else:
             out[name] = int(v)
     return out
+
+
+# -- cross-replica ensemble layer (oversim_tpu/campaign/) -------------------
+#
+# A campaign state stacks every accumulator with a leading replica axis:
+# "s:name" -> [S, 5], "h:name" -> [S, bins], "c:name" -> [S].  The reduce
+# runs ON DEVICE (one jit, one device_get of small [S]-shaped leaves);
+# the CI half-widths (Student-t, no scipy dependency) attach host-side in
+# ``ensemble_summary``.  This is the TPU-native analogue of scripting
+# ``./OverSim -r N`` and averaging the N scalar files by hand.
+
+def ensemble_reduce(stats: dict) -> dict:
+    """Device-side: stacked accumulators -> per-replica + cross-replica
+    moments.  Returns a dict of small jnp arrays, safe to device_get.
+
+    Scalars ("s:") -> {per_mean[S], per_stddev[S], per_count[S],
+    mean, stddev, sem, k} where the cross-replica moments are over the
+    k replicas that recorded data (sample stddev, /(k-1)).
+    Histograms ("h:") -> per-replica probability mass functions and
+    their cross-replica mean/stddev/sem per bin (+ raw count sums).
+    Counters ("c:") -> per-replica values + cross-replica mean/stddev.
+    """
+    out = {}
+    for key, acc in stats.items():
+        if key.startswith("s:"):
+            n = acc[:, 0]                                    # [S]
+            has = n > 0
+            safe_n = jnp.maximum(n, 1.0)
+            per_mean = acc[:, 1] / safe_n
+            per_var = jnp.maximum(acc[:, 2] / safe_n - per_mean * per_mean,
+                                  0.0)
+            per_stddev = jnp.sqrt(per_var)
+            k = jnp.sum(has.astype(F64))
+            safe_k = jnp.maximum(k, 1.0)
+            mean = jnp.sum(jnp.where(has, per_mean, 0.0)) / safe_k
+            dev2 = jnp.where(has, (per_mean - mean) ** 2, 0.0)
+            var = jnp.sum(dev2) / jnp.maximum(k - 1.0, 1.0)
+            stddev = jnp.sqrt(var)
+            sem = stddev / jnp.sqrt(safe_k)
+            out[key] = dict(
+                per_count=n, per_mean=per_mean,
+                per_stddev=per_stddev, mean=mean, stddev=stddev,
+                sem=sem, k=k)
+        elif key.startswith("h:"):
+            counts = acc.astype(F64)                         # [S, B]
+            tot = jnp.sum(counts, axis=1, keepdims=True)     # [S, 1]
+            has = tot[:, 0] > 0
+            pmf = counts / jnp.maximum(tot, 1.0)             # [S, B]
+            k = jnp.sum(has.astype(F64))
+            safe_k = jnp.maximum(k, 1.0)
+            mean = jnp.sum(jnp.where(has[:, None], pmf, 0.0),
+                           axis=0) / safe_k                  # [B]
+            dev2 = jnp.where(has[:, None], (pmf - mean[None, :]) ** 2, 0.0)
+            var = jnp.sum(dev2, axis=0) / jnp.maximum(k - 1.0, 1.0)
+            stddev = jnp.sqrt(var)
+            sem = stddev / jnp.sqrt(safe_k)
+            out[key] = dict(
+                per_counts=acc, per_total=tot[:, 0],
+                per_pmf=pmf, mean=mean, stddev=stddev, sem=sem, k=k,
+                total=jnp.sum(acc, axis=0))
+        elif key.startswith("c:"):
+            v = acc.astype(F64)                              # [S]
+            s = v.shape[0]
+            mean = jnp.mean(v)
+            var = (jnp.sum((v - mean) ** 2) / (s - 1.0)) if s > 1 \
+                else jnp.zeros(())
+            out[key] = dict(
+                per_replica=acc, total=jnp.sum(acc),
+                mean=mean, stddev=jnp.sqrt(var),
+                sem=jnp.sqrt(var) / math.sqrt(s))
+    return out
+
+
+# two-sided Student-t critical values, t_{df, 1-alpha/2} — enough rows
+# for any sane replica count; falls back to the normal quantile past 30
+_T_TABLE = {
+    0.95: (12.706, 4.303, 3.182, 2.776, 2.571, 2.447, 2.365, 2.306, 2.262,
+           2.228, 2.201, 2.179, 2.160, 2.145, 2.131, 2.120, 2.110, 2.101,
+           2.093, 2.086, 2.080, 2.074, 2.069, 2.064, 2.060, 2.056, 2.052,
+           2.048, 2.045, 2.042),
+    0.99: (63.657, 9.925, 5.841, 4.604, 4.032, 3.707, 3.499, 3.355, 3.250,
+           3.169, 3.106, 3.055, 3.012, 2.977, 2.947, 2.921, 2.898, 2.878,
+           2.861, 2.845, 2.831, 2.819, 2.807, 2.797, 2.787, 2.779, 2.771,
+           2.763, 2.756, 2.750),
+}
+_T_NORMAL = {0.95: 1.960, 0.99: 2.576}
+
+
+def t_critical(df: int, confidence: float = 0.95) -> float:
+    """Two-sided Student-t critical value (table lookup, no scipy)."""
+    if confidence not in _T_TABLE:
+        raise ValueError(f"confidence must be one of {sorted(_T_TABLE)}")
+    if df < 1:
+        return math.nan
+    tab = _T_TABLE[confidence]
+    return tab[df - 1] if df <= len(tab) else _T_NORMAL[confidence]
+
+
+def ensemble_summary(reduced: dict, confidence: float = 0.95) -> dict:
+    """Host-side: attach Student-t CI half-widths (ci = t_{k-1} * sem)
+    to a (device_get of a) ``ensemble_reduce`` result and convert leaves
+    to plain python.  Schema per metric — scalar: {kind, k, mean, stddev,
+    sem, ci, confidence, per_replica: {count, mean, stddev}[S]};
+    hist: the same per-bin (lists of length B) plus raw counts;
+    counter: {kind, total, mean, stddev, sem, ci, per_replica[S]}."""
+    import numpy as np
+
+    out = {}
+    for key, r in reduced.items():
+        name = key[2:]
+        if key.startswith("s:"):
+            k = int(np.asarray(r["k"]))
+            t = t_critical(k - 1, confidence) if k > 1 else math.nan
+            sem = float(np.asarray(r["sem"]))
+            out[name] = {
+                "kind": "scalar", "k": k,
+                "mean": float(np.asarray(r["mean"])),
+                "stddev": float(np.asarray(r["stddev"])),
+                "sem": sem,
+                "ci": t * sem if k > 1 else math.nan,
+                "confidence": confidence,
+                "per_replica": {
+                    "count": np.asarray(r["per_count"]).astype(int).tolist(),
+                    "mean": np.asarray(r["per_mean"]).tolist(),
+                    "stddev": np.asarray(r["per_stddev"]).tolist(),
+                },
+            }
+        elif key.startswith("h:"):
+            k = int(np.asarray(r["k"]))
+            t = t_critical(k - 1, confidence) if k > 1 else math.nan
+            sem = np.asarray(r["sem"])
+            ci = (t * sem).tolist() if k > 1 \
+                else [math.nan] * sem.shape[0]
+            out[name] = {
+                "kind": "hist", "k": k,
+                "mean": np.asarray(r["mean"]).tolist(),
+                "stddev": np.asarray(r["stddev"]).tolist(),
+                "sem": sem.tolist(),
+                "ci": ci,
+                "confidence": confidence,
+                "total": np.asarray(r["total"]).astype(int).tolist(),
+                "per_replica": {
+                    "counts": np.asarray(r["per_counts"]).astype(int).tolist(),
+                    "total": np.asarray(r["per_total"]).astype(int).tolist(),
+                },
+            }
+        else:
+            pr = np.asarray(r["per_replica"])
+            s = pr.shape[0]
+            t = t_critical(s - 1, confidence) if s > 1 else math.nan
+            sem = float(np.asarray(r["sem"]))
+            out[name] = {
+                "kind": "counter",
+                "total": int(np.asarray(r["total"])),
+                "mean": float(np.asarray(r["mean"])),
+                "stddev": float(np.asarray(r["stddev"])),
+                "sem": sem,
+                "ci": t * sem if s > 1 else math.nan,
+                "confidence": confidence,
+                "per_replica": pr.astype(int).tolist(),
+            }
+    return out

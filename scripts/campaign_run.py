@@ -1,0 +1,181 @@
+"""Campaign CLI: run S replicas (seed sweep + optional parameter grid)
+as ONE compiled vmapped program and emit the ensemble report.
+
+The reference runs repetitions as separate processes (``./OverSim -r N``,
+one scalar file each) and leaves the cross-run averaging to scripts; here
+the whole campaign is a single device-resident program
+(oversim_tpu/campaign/) whose replica axis is sharded across the visible
+devices, and the report carries cross-replica mean/stddev/Student-t CI
+per metric plus the per-replica breakdown.
+
+Usage:
+  python scripts/campaign_run.py --ini simulations/my.ini [--config X]
+      Build from ``**.campaign.*`` ini keys (replicas, baseSeed,
+      sweep.lifetimeMean / sweep.testMsgInterval / sweep.window).
+  python scripts/campaign_run.py --replicas 8 [--n 256] [--overlay
+      kademlia|chord] [--seed 1] [--sweep churn.lifetimeMean=100,1000]
+      Flag-built Kademlia/Chord KBRTestApp scenario (bench.py shape).
+
+Common:  [--t 120] simulated seconds  [--chunk 64] ticks per scan
+         [--platform cpu|axon]  [--out report.json]
+
+The report JSON is written INCREMENTALLY with atomic tmp+rename
+(bench.py's ArtifactWriter): a phase record after init, one after the
+run, then the full report — a deadline SIGKILL leaves a valid partial
+artifact.  The final line on stdout is the report itself.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+_T0 = time.time()
+
+
+def _setup_jax(platform):
+    if platform and platform not in ("axon", "default"):
+        os.environ["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_backend_optimization_level" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_backend_optimization_level=0"
+                    " --xla_llvm_disable_expensive_passes=true").strip()
+    sys.modules["zstandard"] = None
+    import jax
+
+    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
+    from jax._src import compilation_cache as _cc
+    for attr in ("zstandard", "zstd"):
+        if getattr(_cc, attr, None) is not None:
+            setattr(_cc, attr, None)
+    jax.config.update("jax_enable_x64", True)
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_compilation_cache", False)
+    else:
+        jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def _parse_sweep(specs):
+    """--sweep name=v1,v2,... (repeatable) → CampaignParams.sweep tuple."""
+    out = []
+    for spec in specs or ():
+        name, _, vals = spec.partition("=")
+        vals = tuple(float(x) for x in vals.replace(",", " ").split())
+        if not name or not vals:
+            raise SystemExit(f"bad --sweep spec: {spec!r}")
+        out.append((name, vals))
+    return tuple(out)
+
+
+def _build_from_flags(args):
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.common import lookup as lk_mod
+    from oversim_tpu.engine import sim as sim_mod
+
+    app = KbrTestApp(KbrTestParams(test_interval=args.interval))
+    if args.overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app,
+                              lcfg=lk_mod.LookupConfig(slots=8, merge=True))
+    cp = churn_mod.ChurnParams(model=args.churn, target_num=args.n,
+                               lifetime_mean=args.lifetime,
+                               init_interval=10.0 / args.n)
+    ep = sim_mod.EngineParams(window=args.window, inbox_slots=8,
+                              pool_factor=8)
+    sim = sim_mod.Simulation(logic, cp, engine_params=ep)
+    return Campaign(sim, CampaignParams(replicas=args.replicas,
+                                        base_seed=args.seed,
+                                        sweep=_parse_sweep(args.sweep)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ini", default=None, help="build from ini "
+                    "**.campaign.* keys instead of flags")
+    ap.add_argument("--config", default="General")
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--sweep", action="append", default=[],
+                    metavar="NAME=V1,V2", help="grid axis (repeatable): "
+                    "churn.lifetimeMean / app.testMsgInterval / "
+                    "engine.window")
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--overlay", default="kademlia",
+                    choices=["kademlia", "chord"])
+    ap.add_argument("--churn", default="none")
+    ap.add_argument("--lifetime", type=float, default=10_000.0)
+    ap.add_argument("--interval", type=float, default=0.2)
+    ap.add_argument("--window", type=float, default=0.2)
+    ap.add_argument("--t", type=float, default=120.0)
+    ap.add_argument("--chunk", type=int, default=64)
+    ap.add_argument("--confidence", type=float, default=0.95)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--out", default=None, help="incremental atomic "
+                    "report artifact path")
+    args = ap.parse_args()
+
+    jax = _setup_jax(args.platform)
+    from bench import ArtifactWriter
+    from oversim_tpu.parallel import mesh as mesh_mod
+
+    artifact = ArtifactWriter(args.out)
+
+    if args.ini:
+        from oversim_tpu.config.ini import IniFile
+        from oversim_tpu.config.scenario import build_campaign
+        camp = build_campaign(IniFile.load(args.ini), args.config)
+    else:
+        camp = _build_from_flags(args)
+
+    t0 = time.perf_counter()
+    cs = camp.init()
+    # shard the replica axis over the largest device count dividing S
+    avail = len(jax.devices())
+    n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                if camp.s % d == 0)
+    if n_dev > 1:
+        mesh = mesh_mod.make_replica_mesh(n_dev)
+        cs = mesh_mod.shard_campaign_state(cs, mesh)
+    init_rec = {"phase": "init", "replicas": camp.p.replicas,
+                "grid": camp.grid, "s": camp.s, "devices": n_dev,
+                "init_wall_s": round(time.perf_counter() - t0, 2)}
+    print(json.dumps(init_rec), flush=True)
+    artifact.add(init_rec)
+
+    t0 = time.perf_counter()
+    cs = camp.run_until_device(cs, args.t, chunk=args.chunk)
+    jax.block_until_ready(cs.t_now)
+    run_rec = {"phase": "run", "target_t_sim": args.t,
+               "run_wall_s": round(time.perf_counter() - t0, 2)}
+    print(json.dumps(run_rec), flush=True)
+    artifact.add(run_rec)
+
+    report = camp.report(cs, confidence=args.confidence)
+    # merge the timing records WITHOUT clobbering report keys (the
+    # report's "t_sim" is the per-replica list; the run record's target
+    # is a scalar, renamed target_t_sim)
+    report["_campaign"].update(init_rec, **run_rec)
+    report["_campaign"].pop("phase", None)
+    artifact.add(report)
+    artifact.finish()
+    print(json.dumps(report), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

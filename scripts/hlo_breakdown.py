@@ -14,6 +14,11 @@ Usage:
       Wired into the fast test tier via tests/test_engine.py, which
       calls :func:`hlo_op_counts` / :func:`check_budget` on its own
       compiled tick.
+  python scripts/hlo_breakdown.py --campaign S [n] [overlay] [window] [inbox]
+      Compiles ONE vmapped campaign tick (S replicas, replica axis
+      sharded over the available devices) and additionally pins ZERO
+      cross-replica collectives — the replica axis must stay pure data
+      parallelism (oversim_tpu/campaign/; tests/test_vmap_campaign.py).
 
 The counting helpers are import-safe (no jax import at module level):
 XLA-CPU at -O0 expands scatters into ``while`` loops (ScatterExpander),
@@ -41,36 +46,64 @@ def log(msg):
 
 _SCATTER_WHILE = re.compile(r'op_name="[^"]*/scatter')
 
+# cross-device collective opcodes (GSPMD partitioning output).  The
+# campaign budget pins their count at ZERO inside the replica-sharded
+# tick: the replica axis is pure data parallelism (oversim_tpu/campaign/)
+# — any collective appearing there means the partitioner found a
+# cross-replica data dependency, i.e. replicas stopped being independent.
+_COLLECTIVE_OPS = ("all-reduce(", "all-gather(", "all-to-all(",
+                   "collective-permute(", "reduce-scatter(",
+                   "collective-broadcast(")
+
 
 def hlo_op_counts(txt: str, pool_dim: int | None = None) -> dict:
-    """Count sort/scatter ops in optimized HLO text.
+    """Count sort/scatter/collective ops in optimized HLO text.
 
-    Returns ``{"sort_count", "full_pool_sort_count", "scatter_count"}``.
+    Returns ``{"sort_count", "full_pool_sort_count", "scatter_count",
+    "collective_count"}``.
     ``full_pool_sort_count`` counts sorts whose operand shape contains
     the pool dimension ``pool_dim`` (0 when pool_dim is None).
     ``scatter_count`` = native ``scatter(`` ops + XLA-CPU's
     scatter-expanded ``while`` loops (identified by op_name metadata).
+    ``collective_count`` = cross-device collectives (all-reduce /
+    all-gather / all-to-all / collective-permute / reduce-scatter /
+    collective-broadcast, including their ``-start`` async forms).
     """
-    sorts = full = scatters = 0
+    sorts = full = scatters = collectives = 0
+    # the pool dim counts as "full-pool" wherever it sits in the shape:
+    # leading ([P,...]) in the solo step, second ([S,P,...]) under the
+    # campaign's replica vmap
+    pool_re = (re.compile(rf"\[(\d+,)?{pool_dim}[\],]")
+               if pool_dim is not None else None)
     for ln in txt.splitlines():
         if " sort(" in ln:
             sorts += 1
-            if pool_dim is not None and f"[{pool_dim}" in ln:
+            if pool_re is not None and pool_re.search(ln):
                 full += 1
         elif " scatter(" in ln:
             scatters += 1
         elif " while(" in ln and _SCATTER_WHILE.search(ln):
             scatters += 1
+        # async collectives lower to op-start/op-done pairs — counting
+        # only the -start (plus the sync form) avoids double counting
+        if any((" " + op in ln) or (" " + op[:-1] + "-start(" in ln)
+               for op in _COLLECTIVE_OPS):
+            collectives += 1
     return {"sort_count": sorts, "full_pool_sort_count": full,
-            "scatter_count": scatters}
+            "scatter_count": scatters, "collective_count": collectives}
 
 
 def check_budget(txt: str, pool_dim: int, max_full_pool_sorts: int,
-                 max_scatters: int):
-    """(ok, counts) — does the compiled tick fit the pinned op budget?"""
+                 max_scatters: int, max_collectives: int | None = None):
+    """(ok, counts) — does the compiled tick fit the pinned op budget?
+    ``max_collectives`` is only enforced when given (the campaign budget
+    pins it at 0; single-replica node-sharded steps legitimately carry
+    collectives)."""
     counts = hlo_op_counts(txt, pool_dim)
     ok = (counts["full_pool_sort_count"] <= max_full_pool_sorts
           and counts["scatter_count"] <= max_scatters)
+    if max_collectives is not None:
+        ok = ok and counts["collective_count"] <= max_collectives
     return ok, counts
 
 
@@ -140,6 +173,47 @@ def budget_main(n, overlay, window, inbox, max_sorts, max_scatters) -> int:
     return 0 if ok else 1
 
 
+def campaign_budget_main(n, overlay, window, inbox, replicas, max_sorts,
+                         max_scatters) -> int:
+    """--campaign S: compile ONE vmapped, replica-sharded campaign tick
+    and pin its budget — zero full-pool sorts, bounded scatters, and
+    ZERO cross-replica collectives (the replica axis is pure data
+    parallelism; a collective inside the tick means the partitioner
+    found a cross-replica dependency)."""
+    jax = _setup_jax()
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.parallel import mesh as mesh_mod
+
+    sim = _build_sim(n, overlay, window, inbox)
+    camp = Campaign(sim, CampaignParams(replicas=replicas, base_seed=7))
+    cs = camp.init()
+    log(f"campaign init done (S={camp.s})")
+    # shard over the largest device count that divides S (1 = unsharded
+    # single-device fallback — the vmap budget still holds there)
+    avail = len(jax.devices())
+    n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                if camp.s % d == 0)
+    mesh = mesh_mod.make_replica_mesh(n_dev)
+    sh = mesh_mod.campaign_state_shardings(cs, mesh)
+    step = jax.jit(camp._vstep, in_shardings=(sh,), out_shardings=sh)
+    txt = step.lower(cs).compile().as_text()
+    log(f"campaign-tick HLO compiled on {n_dev} device(s): "
+        f"{txt.count(chr(10))} lines")
+    pool_dim = sim.ep.pool_factor * n
+    if max_scatters is None:
+        max_scatters = 200   # same rationale as budget_main
+    ok, counts = check_budget(txt, pool_dim, max_sorts, max_scatters,
+                              max_collectives=0)
+    print(f"campaign budget (S={camp.s}, {n_dev} dev): "
+          f"full_pool_sorts {counts['full_pool_sort_count']} "
+          f"(max {max_sorts}), scatters {counts['scatter_count']} "
+          f"(max {max_scatters}), collectives "
+          f"{counts['collective_count']} (max 0), total sorts "
+          f"{counts['sort_count']} -> {'OK' if ok else 'EXCEEDED'}",
+          flush=True)
+    return 0 if ok else 1
+
+
 def breakdown_main(n, overlay, window, inbox) -> int:
     jax = _setup_jax()
     sim = _build_sim(n, overlay, window, inbox)
@@ -194,7 +268,11 @@ def breakdown_main(n, overlay, window, inbox) -> int:
 def main(argv) -> int:
     budget = "--budget" in argv
     argv = [a for a in argv if a != "--budget"]
-    max_sorts, max_scatters = 0, None
+    max_sorts, max_scatters, replicas = 0, None, None
+    if "--campaign" in argv:
+        i = argv.index("--campaign")
+        replicas = int(argv[i + 1])
+        del argv[i:i + 2]
     if "--max-sorts" in argv:
         i = argv.index("--max-sorts")
         max_sorts = int(argv[i + 1])
@@ -203,10 +281,14 @@ def main(argv) -> int:
         i = argv.index("--max-scatters")
         max_scatters = int(argv[i + 1])
         del argv[i:i + 2]
-    n = int(argv[1]) if len(argv) > 1 else (256 if budget else 4096)
+    n = int(argv[1]) if len(argv) > 1 else (
+        256 if (budget or replicas) else 4096)
     overlay = argv[2] if len(argv) > 2 else "kademlia"
     window = float(argv[3]) if len(argv) > 3 else 0.2
     inbox = int(argv[4]) if len(argv) > 4 else 8
+    if replicas is not None:
+        return campaign_budget_main(n, overlay, window, inbox, replicas,
+                                    max_sorts, max_scatters)
     if budget:
         return budget_main(n, overlay, window, inbox, max_sorts,
                            max_scatters)

@@ -4,8 +4,15 @@ Usage: python scripts/perf_probe.py [n] [chunk] [overlay]
 Prints timestamped stages so a hang is attributable to a stage.
 OVERSIM_PROFILE=1 appends a per-phase tick-time breakdown JSON line
 (oversim_tpu/profiling.py).
+
+OVERSIM_PROBE_REPLICAS="1,4,8" appends the CAMPAIGN stage: for each S it
+compiles the vmapped S-replica program (oversim_tpu/campaign/), then
+reports compile wall, time-to-first-chunk and steady ms/tick — the
+S=1-vs-S>=4 compile-amortization table for PERFORMANCE.md (vmapping the
+tick multiplies the measurement streams, not the compile count).
 """
 
+import os
 import sys
 import time
 from pathlib import Path
@@ -108,3 +115,48 @@ if profiling.enabled():
 out = sim.summary(s)
 log(f"summary: alive={out['_alive']} ticks={out['_ticks']} "
     f"sent={out.get('kbr_sent')} delivered={out.get('kbr_delivered')}")
+
+# -- campaign stage: compile amortization over the replica axis -------------
+replicas_env = os.environ.get("OVERSIM_PROBE_REPLICAS")
+if replicas_env:
+    import json
+
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.parallel import mesh as mesh_mod
+
+    rows = []
+    for s_rep in [int(x) for x in replicas_env.replace(",", " ").split()]:
+        camp = Campaign(sim, CampaignParams(replicas=s_rep, base_seed=7))
+        t = time.perf_counter()
+        cs = camp.init()
+        jax.block_until_ready(cs.t_now)
+        init_wall = time.perf_counter() - t
+        avail = len(jax.devices())
+        n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                    if camp.s % d == 0)
+        if n_dev > 1:
+            cs = mesh_mod.shard_campaign_state(
+                cs, mesh_mod.make_replica_mesh(n_dev))
+        # first chunk = compile + run (time-to-first-window); later
+        # chunks = steady state
+        t = time.perf_counter()
+        cs = camp.run_chunk(cs, chunk)
+        jax.block_until_ready(cs.t_now)
+        first_wall = time.perf_counter() - t
+        t = time.perf_counter()
+        for _ in range(3):
+            cs = camp.run_chunk(cs, chunk)
+        jax.block_until_ready(cs.t_now)
+        steady = (time.perf_counter() - t) / (3 * chunk)
+        row = {"replicas": s_rep, "devices": n_dev,
+               "init_wall_s": round(init_wall, 2),
+               "first_chunk_wall_s": round(first_wall, 2),
+               "steady_ms_per_tick": round(steady * 1e3, 2),
+               "replica_ticks_per_sec": round(s_rep / steady, 1)}
+        rows.append(row)
+        log(f"campaign S={s_rep} ({n_dev} dev): init {init_wall:.2f}s, "
+            f"first chunk {first_wall:.2f}s, steady "
+            f"{steady * 1e3:.2f} ms/tick "
+            f"({s_rep / steady:.0f} replica-ticks/s)")
+    print(json.dumps({"campaign_probe": rows, "n": n, "chunk": chunk,
+                      "overlay": overlay}), flush=True)

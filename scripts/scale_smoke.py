@@ -16,6 +16,9 @@ provisional JSON line immediately and an updated line after every
 completed row, and ALWAYS exits 0 — the last line is the result.
 Completed rows are also appended to ``scale_cache.json`` next to the
 repo root so a later stalled run still has committed evidence.
+OVERSIM_SCALE_ARTIFACT=path additionally persists every emitted record
+to ``path`` with an atomic tmp+rename after EVERY row (bench.py's
+ArtifactWriter) — a deadline SIGKILL leaves a valid partial artifact.
 
 Usage:  python scripts/scale_smoke.py [--ladder] [--n 10000]
         [--overlay kademlia|chord] [--t 600] [--platform cpu|axon]
@@ -208,11 +211,15 @@ def orchestrate() -> int:
     import subprocess
     import threading
 
+    from bench import ArtifactWriter
+    artifact = ArtifactWriter(os.environ.get("OVERSIM_SCALE_ARTIFACT"))
     try:
         rows = json.loads(CACHE.read_text()) if CACHE.exists() else []
     except ValueError:
         rows = []
-    _emit({"rows": rows, "note": "provisional (cached rows only)"})
+    prov = {"rows": rows, "note": "provisional (cached rows only)"}
+    _emit(prov)
+    artifact.add(prov)
     env = dict(os.environ, OVERSIM_SCALE_CHILD="1")
     child = subprocess.Popen([sys.executable] + sys.argv,
                              stdout=subprocess.PIPE, text=True, env=env)
@@ -232,12 +239,14 @@ def orchestrate() -> int:
         if not line:
             continue
         try:
-            json.loads(line)
+            parsed = json.loads(line)
         except ValueError:
             sys.stderr.write("scale child: %s\n" % line)
             continue
         print(line, flush=True)
+        artifact.add(parsed)   # atomic rewrite after EVERY row
     child.wait()
+    artifact.finish()
     sys.stderr.write("scale: child rc=%s after %.0fs\n"
                      % (child.returncode, time.time() - _T0))
     return 0

@@ -8,6 +8,8 @@ without touching a backend — a regression back to per-chunk syncing
 shows up here as extra dispatch or fetch calls.
 """
 
+import json
+
 import numpy as np
 
 import bench
@@ -90,3 +92,55 @@ def test_host_loop_mode_uses_run_until_with_invariants():
     assert windows == 2
     assert sim.device_calls == []
     assert sim.host_calls == [(1.0, 8, True), (2.0, 8, True)]
+
+
+# -- incremental artifact persistence (OVERSIM_BENCH_ARTIFACT) ---------------
+
+
+def test_artifact_writer_valid_after_every_add(tmp_path):
+    """The artifact file must be complete, parseable JSON after EVERY
+    add() — a SIGKILL between windows leaves a valid partial artifact
+    with complete=False and final = the last window measured."""
+    path = str(tmp_path / "bench.json")
+    w = bench.ArtifactWriter(path)
+    # the file exists (empty but valid) before any window completes
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc == {"records": [], "final": None, "complete": False}
+
+    for i in range(3):
+        w.add({"window": i, "value": 10.0 * i})
+        with open(path) as f:
+            doc = json.load(f)
+        # simulated kill here: everything measured so far is on disk
+        assert len(doc["records"]) == i + 1
+        assert doc["final"] == {"window": i, "value": 10.0 * i}
+        assert doc["complete"] is False
+
+    w.finish()
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["complete"] is True
+    assert doc["final"]["window"] == 2
+    assert [r["window"] for r in doc["records"]] == [0, 1, 2]
+    # no torn-write leftovers
+    assert not (tmp_path / "bench.json.tmp").exists()
+
+
+def test_artifact_writer_disabled_without_path(tmp_path):
+    """path=None (env var unset) must be a no-op sink."""
+    w = bench.ArtifactWriter(None)
+    w.add({"x": 1})
+    w.finish()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_atomic_write_json_replaces_whole_file(tmp_path):
+    path = str(tmp_path / "a.json")
+    bench.atomic_write_json(path, {"v": 1})
+    bench.atomic_write_json(path, {"v": 2, "w": [1, 2]})
+    with open(path) as f:
+        assert json.load(f) == {"v": 2, "w": [1, 2]}
+    # unwritable destination is swallowed, not raised (bench must never
+    # die because the artifact disk path is bad)
+    bench.atomic_write_json(str(tmp_path / "no_dir" / "b.json"), {"v": 3})

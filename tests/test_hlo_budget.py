@@ -47,3 +47,59 @@ def test_check_budget_pass_and_breach():
     ok, _ = check_budget(FAKE_HLO, pool_dim=192,
                          max_full_pool_sorts=1, max_scatters=2)
     assert not ok                                # the scatter count breaches
+
+
+# -- campaign-mode pins (scripts/hlo_breakdown.py --campaign) ----------------
+
+FAKE_VMAPPED_HLO = """\
+HloModule vstep
+  %s0 = (s64[8,192]) sort(s64[8,192] %a, s32[8,192] %b), dimensions={1}
+  %s1 = s32[8,16,8] sort(s32[8,16,8] %c), dimensions={2}
+  %s2 = s32[192,4] sort(s32[192,4] %d), dimensions={1}
+"""
+
+FAKE_COLLECTIVE_HLO = """\
+HloModule sharded
+  %ar = f64[8]{0} all-reduce(f64[8]{0} %a), replica_groups={{0,1,2,3}}
+  %ag = f64[8,4]{1,0} all-gather-start(f64[8]{0} %b), dimensions={0}
+  %cp = f64[8]{0} collective-permute(f64[8]{0} %c), \
+source_target_pairs={{0,1}}
+  %rs = f64[2]{0} reduce-scatter(f64[8]{0} %d), dimensions={0}
+  %w = (s64[64],s32[]) while((s64[64],s32[]) %t), body=%b1, \
+metadata={op_name="jit(step)/jit(main)/scatter"}
+"""
+
+
+def test_full_pool_sort_detected_under_vmap():
+    """The detection is position-independent over the first two dims: a
+    sort whose operand carries the pool extent as [P] / [S, P] / [P, k]
+    counts as full-pool (the campaign vmap puts the replica axis in
+    front), while shapes merely containing P-sized products ([S, 16, 8])
+    do not."""
+    counts = hlo_op_counts(FAKE_VMAPPED_HLO, pool_dim=192)
+    assert counts["sort_count"] == 3
+    assert counts["full_pool_sort_count"] == 2   # [8,192] and [192,4]
+
+
+def test_collective_count_sync_and_async_forms():
+    counts = hlo_op_counts(FAKE_COLLECTIVE_HLO)
+    # all-reduce + all-gather-start + collective-permute + reduce-scatter
+    assert counts["collective_count"] == 4
+    assert counts["scatter_count"] == 1          # the expanded while
+    assert hlo_op_counts(FAKE_HLO)["collective_count"] == 0
+
+
+def test_check_budget_collective_pin():
+    ok, counts = check_budget(FAKE_COLLECTIVE_HLO, pool_dim=None,
+                              max_full_pool_sorts=0, max_scatters=5,
+                              max_collectives=0)
+    assert not ok and counts["collective_count"] == 4
+    ok, _ = check_budget(FAKE_COLLECTIVE_HLO, pool_dim=None,
+                         max_full_pool_sorts=0, max_scatters=5,
+                         max_collectives=4)
+    assert ok
+    # unenforced when omitted (single-sim node-sharded steps legitimately
+    # carry collectives)
+    ok, _ = check_budget(FAKE_COLLECTIVE_HLO, pool_dim=None,
+                         max_full_pool_sorts=0, max_scatters=5)
+    assert ok
