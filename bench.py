@@ -52,6 +52,16 @@ measured so far ({"records": [...], "final": last, "complete": bool}).
 OVERSIM_PROFILE=1 additionally emits a per-phase tick-time breakdown
 (oversim_tpu/profiling.py) as a ``tick_phase_breakdown`` JSON line
 before the measurement windows — see PERFORMANCE.md for the format.
+
+Telemetry plane (oversim_tpu/telemetry.py): OVERSIM_BENCH_TELEMETRY=K
+samples the KPI ring buffers every K ticks INSIDE the device loop
+(window capacity OVERSIM_BENCH_TELEMETRY_WINDOW, default 256) and emits
+the time series as a ``telemetry_series`` side-channel line after the
+run; OVERSIM_BENCH_TRACE=path writes a Perfetto/Chrome-trace JSON of
+the per-window dispatch/fetch spans (+ profiling phase spans under
+OVERSIM_PROFILE=1).  Every run emits a ``run_manifest`` line (config
+hash, mesh layout, git rev) that the orchestrator attaches to the
+artifact's top-level ``manifest`` key.
 """
 
 import json
@@ -127,6 +137,7 @@ class ArtifactWriter:
     def __init__(self, path: str | None):
         self.path = path
         self.records = []
+        self.manifest = None
         if path:
             self._flush(complete=False)
 
@@ -136,16 +147,27 @@ class ArtifactWriter:
         self.records.append(record)
         self._flush(complete=False)
 
+    def set_manifest(self, manifest: dict) -> None:
+        """Attach a RunManifest (oversim_tpu/telemetry.py run_manifest):
+        kept OUT of the record list under its own top-level key, and
+        re-flushed atomically like any add."""
+        self.manifest = manifest
+        if self.path:
+            self._flush(complete=False)
+
     def finish(self) -> None:
         if self.path:
             self._flush(complete=True)
 
     def _flush(self, *, complete: bool) -> None:
-        atomic_write_json(self.path, {
+        doc = {
             "records": self.records,
             "final": self.records[-1] if self.records else None,
             "complete": complete,
-        })
+        }
+        if self.manifest is not None:
+            doc["manifest"] = self.manifest
+        atomic_write_json(self.path, doc)
 
 
 def _load_cached_tpu() -> dict | None:
@@ -213,10 +235,15 @@ def orchestrate() -> int:
             continue
         if parsed.get("metric") not in (None, "kbr_lookups_per_sec"):
             # diagnostic side-channel lines (e.g. the OVERSIM_PROFILE=1
-            # tick_phase_breakdown) are relayed verbatim but never enter
-            # the measurement-record logic below
+            # tick_phase_breakdown, the telemetry_series record) are
+            # relayed verbatim but never enter the measurement-record
+            # logic below; the child's run_manifest line attaches as
+            # the artifact's top-level manifest instead of a record
             print(line, flush=True)
-            artifact.add(parsed)
+            if parsed.get("metric") == "run_manifest":
+                artifact.set_manifest(parsed)
+            else:
+                artifact.add(parsed)
             continue
         on_cpu = "cpu" in parsed.get("unit", "cpu")
         if on_cpu and not cpu_requested and (saw_tpu or fallback is not None):
@@ -268,11 +295,16 @@ def orchestrate() -> int:
 
 def _fetch_window_leaves(s):
     """ONE host sync: a single jax.device_get of the counter leaves
-    (stats accumulators, engine counters, clock, alive mask)."""
+    (stats accumulators, engine counters, clock, alive mask) — plus the
+    telemetry ring buffers when the state carries them (still the same
+    single device_get; the rings are small [W]-shaped leaves)."""
     import jax
-    return jax.device_get({"stats": s.stats, "counters": s.counters,
-                           "t_now": s.t_now, "tick": s.tick,
-                           "alive": s.alive})
+    leaves = {"stats": s.stats, "counters": s.counters,
+              "t_now": s.t_now, "tick": s.tick, "alive": s.alive}
+    tel = getattr(s, "telemetry", None)
+    if tel is not None:
+        leaves["telemetry"] = tel
+    return jax.device_get(leaves)
 
 
 def _summary_from_leaves(leaves) -> dict:
@@ -318,7 +350,8 @@ def _campaign_summary_from_leaves(leaves) -> dict:
 def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
                             measure_wall, chunk, on_window,
                             host_loop=False, now=time.perf_counter,
-                            summarize_leaves=_summary_from_leaves):
+                            summarize_leaves=_summary_from_leaves,
+                            trace=None):
     """Drive wall-clock measurement windows, device-resident.
 
     Each window advances the sim by ``window_sim_s`` simulated seconds
@@ -332,17 +365,30 @@ def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
     ``summarize_leaves`` turns the fetched counter leaves into the
     per-window summary — the campaign tier passes
     ``_campaign_summary_from_leaves`` (leaves carry a [S] replica axis).
+    ``trace`` (a telemetry.PerfettoTrace) records a ``window_dispatch``
+    and a ``window_fetch`` span per window — exactly one of each, the
+    Perfetto view of the one-dispatch-one-fetch contract.  The extra
+    ``now()`` reads happen only with a trace, so the fake-timer pins of
+    the untraced loop are unchanged.
     """
     t0 = now()
     sim_t = start_sim_t
     windows = 0
     while now() - t0 < measure_wall:
         sim_t += window_sim_s
+        t_d0 = now() if trace is not None else None
         if host_loop:
             s = sim.run_until(s, sim_t, chunk=chunk, check_invariants=True)
         else:
             s = sim.run_until_device(s, sim_t, chunk=chunk)
+        if trace is not None:
+            t_d1 = now()
+            trace.span("window_dispatch", t_d0, t_d1 - t_d0,
+                       args={"window": windows, "target_sim_t": sim_t})
         summary = summarize_leaves(_fetch_window_leaves(s))
+        if trace is not None:
+            trace.span("window_fetch", t_d1, now() - t_d1,
+                       args={"window": windows})
         windows += 1
         on_window(summary, now() - t0)
     return s, windows
@@ -501,9 +547,37 @@ def child_main():
     # ~4-6 per node; factor 4 overflowed (tens of thousands of drops →
     # RPC timeouts → failed lookups at 64% delivery)
     pool_f = int(os.environ.get("OVERSIM_BENCH_POOL", 8))
+    # OVERSIM_BENCH_TELEMETRY=K: sample the KPI ring buffers every K
+    # ticks inside the device loop (oversim_tpu/telemetry.py) — the
+    # window loop still does ONE dispatch + ONE device_get; the series
+    # is emitted as a telemetry_series side-channel line after the run
+    tel_ticks = int(os.environ.get("OVERSIM_BENCH_TELEMETRY", "0"))
+    tel_window = int(os.environ.get("OVERSIM_BENCH_TELEMETRY_WINDOW", "256"))
+    from oversim_tpu import telemetry as telemetry_mod
     ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
-                              pool_factor=pool_f)
+                              pool_factor=pool_f,
+                              telemetry=telemetry_mod.TelemetryParams(
+                                  sample_ticks=tel_ticks,
+                                  window=tel_window))
     sim = sim_mod.Simulation(logic, cp, engine_params=ep)
+
+    # OVERSIM_BENCH_TRACE=path: Perfetto/Chrome-trace JSON of the
+    # window dispatch/fetch spans (+ profiling phase spans when
+    # OVERSIM_PROFILE=1), rewritten atomically after every window
+    trace_path = os.environ.get("OVERSIM_BENCH_TRACE")
+    trace = telemetry_mod.PerfettoTrace("bench") if trace_path else None
+
+    # RunManifest side-channel line — the orchestrator attaches it to
+    # the artifact's top-level "manifest" key
+    print(json.dumps(telemetry_mod.run_manifest(
+        config={"n": n, "overlay": overlay, "interval": interval,
+                "window": window, "inbox": inbox, "pool_factor": pool_f,
+                "chunk": chunk, "slots": slots,
+                "telemetry_sample_ticks": tel_ticks,
+                "telemetry_window": tel_window,
+                "replicas": os.environ.get("OVERSIM_BENCH_REPLICAS", "0")},
+        artifacts={"artifact": os.environ.get("OVERSIM_BENCH_ARTIFACT"),
+                   "trace": trace_path})), flush=True)
 
     # OVERSIM_BENCH_REPLICAS=S: campaign tier — S independent replicas
     # as ONE vmapped program (oversim_tpu/campaign/), replica axis
@@ -560,6 +634,8 @@ def child_main():
         report, s = profiling.profile_ticks(
             sim, s, n_ticks=int(os.environ.get("OVERSIM_PROFILE_TICKS", 3)))
         print(json.dumps(report), flush=True)
+        if trace is not None:
+            trace.add_profile(report)
         sys.stderr.write("bench: phase ms/tick %r (fused %.3f)\n"
                          % (report["phase_ms_per_tick"],
                             report.get("fused_ms_per_tick", -1.0)))
@@ -606,11 +682,30 @@ def child_main():
                          "healthy=%s counters=%r\n"
                          % (rate, wall, delivered, sent, healthy,
                             out["_engine"]))
+        if trace is not None:
+            # atomic rewrite per window: a deadline SIGKILL leaves the
+            # trace of every completed window
+            trace.write(trace_path)
 
     s, _ = run_measurement_windows(
         runner, s, start_sim_t=warm_until, window_sim_s=chunk * window,
         measure_wall=measure_wall, chunk=chunk, on_window=on_window,
-        host_loop=host_loop, summarize_leaves=summarize_leaves)
+        host_loop=host_loop, summarize_leaves=summarize_leaves,
+        trace=trace)
+
+    if tel_ticks > 0 and getattr(s, "telemetry", None) is not None:
+        # KPI time series off the ring buffers — for the campaign tier
+        # the stacked [S, W, ...] rings become per-replica series with
+        # cross-replica CI bands (stats.series_summary)
+        if camp is None:
+            print(json.dumps(telemetry_mod.series_report(s.telemetry)),
+                  flush=True)
+        else:
+            rec = telemetry_mod.ensemble_series(jax.device_get(s.telemetry))
+            rec["metric"] = "telemetry_series"
+            print(json.dumps(rec), flush=True)
+    if trace is not None:
+        trace.write(trace_path)
 
 
 def main():

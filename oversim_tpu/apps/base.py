@@ -29,6 +29,13 @@ the overlay logic drives from inside its vmapped per-node step:
 
 Optional hooks (overlays probe with hasattr; absent = zero graph cost):
 
+  kpi_spec() -> tuple of stat names (no "s:"/"h:"/"c:" class prefix)
+      # telemetry tap registry (oversim_tpu/telemetry.py resolve_taps):
+      # the subset of stat_spec() worth a device-resident time-series
+      # ring track when **.telemetry.sampleTicks is set.  Absent (or
+      # matching nothing) = every stat is tapped;
+      # **.telemetry.include substring filters override the registry.
+
   forward(state_n, msgs, ctx) -> veto bool (same shape as msgs.valid)
       # Common API forward() (BaseApp.h:214, BaseOverlay::callForward
       # :523): inspect messages being recursively routed THROUGH this

@@ -136,6 +136,17 @@ class KbrTestApp:
             rpc_t0=jnp.where(fired, now, app.rpc_t0),
             rpc_nonce=jnp.where(fired, tag, app.rpc_nonce))
 
+    def kpi_spec(self):
+        """Telemetry tap registry (apps/base.py; oversim_tpu/telemetry.py
+        ``resolve_taps``): the KPI subset of ``stat_spec`` worth a
+        time-resolved ring-buffer track — the headline parity metrics
+        (hop count + its histogram, one-way latency) and the counters
+        the derived delivery ratio needs.  The remaining stats stay
+        end-of-run accumulators (``**.telemetry.include`` overrides)."""
+        return ("kbr_hopcount", "kbr_latency_s", "kbr_hop_hist",
+                "kbr_sent", "kbr_delivered", "kbr_wrong_node",
+                "kbr_lookup_failed")
+
     def stat_spec(self):
         return dict(
             scalars=("kbr_hopcount", "kbr_latency_s", "kbr_rpc_rtt_s",

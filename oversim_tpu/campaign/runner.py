@@ -222,6 +222,19 @@ class Campaign:
         }
         return out
 
+    def telemetry_report(self, cs: SimState,
+                         confidence: float = 0.95) -> dict:
+        """Per-replica KPI time series + cross-replica CI bands off the
+        stacked ``[S, W, ...]`` telemetry rings (oversim_tpu/telemetry.py
+        ``ensemble_series``; bands via ``stats.series_summary``).  ONE
+        device_get of the ring leaves; {"enabled": False} when the sim
+        was built without ``telemetry.sample_ticks``."""
+        if cs.telemetry is None:
+            return {"enabled": False}
+        from oversim_tpu import telemetry as telemetry_mod
+        return telemetry_mod.ensemble_series(
+            jax.device_get(cs.telemetry), confidence=confidence)
+
     def replica_state(self, cs: SimState, r: int) -> SimState:
         """Slice replica r out of the stacked state (host-side copy) —
         handy for ``sim.summary`` on one replica or debugging."""

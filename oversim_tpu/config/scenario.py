@@ -276,6 +276,33 @@ def build_lookup_config(ini: IniFile, config: str, proto: str,
     )
 
 
+def build_telemetry(ini: IniFile, config: str):
+    """``**.telemetry.*`` keys → TelemetryParams (framework ini
+    extension — the device-resident KPI time-series plane,
+    oversim_tpu/telemetry.py):
+
+      **.telemetry.sampleTicks = 16       snapshot cadence (0 = off)
+      **.telemetry.window      = 256      ring-buffer capacity W
+      **.telemetry.include     = "kbr_hopcount kbr_hop_hist"
+                                          substring tap filter (optional;
+                                          overrides the app's kpi_spec)
+    """
+    from oversim_tpu import telemetry as telemetry_mod
+    sample_ticks = int(_value(
+        ini.get("**.telemetry.sampleTicks", config), 0))
+    if sample_ticks < 0:
+        raise ScenarioError(f"**.telemetry.sampleTicks must be >= 0, "
+                            f"got {sample_ticks}")
+    window = int(_value(ini.get("**.telemetry.window", config), 256))
+    if sample_ticks > 0 and window < 1:
+        raise ScenarioError(f"**.telemetry.window must be >= 1, "
+                            f"got {window}")
+    raw = _value(ini.get("**.telemetry.include", config), "")
+    include = tuple(str(raw).strip().strip('"').replace(",", " ").split())
+    return telemetry_mod.TelemetryParams(
+        sample_ticks=sample_ticks, window=window, include=include)
+
+
 def build_simulation(ini: IniFile, config: str = "General",
                      engine_params: sim_mod.EngineParams | None = None,
                      trace_events=None):
@@ -323,6 +350,7 @@ def build_simulation(ini: IniFile, config: str = "General",
         # this framework's ini extension, engine/pool.py build_inbox
         inbox_impl=inbox_impl,
         malicious=mp,
+        telemetry=build_telemetry(ini, config),
     )
 
     if "chord" in overlay_type.lower():

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from oversim_tpu import churn as churn_mod
 from oversim_tpu import stats as stats_mod
+from oversim_tpu import telemetry as telemetry_mod
 from oversim_tpu.common.malicious import MaliciousParams
 from oversim_tpu.core import keys as keys_mod
 from oversim_tpu.engine import pool as pool_mod
@@ -67,6 +68,10 @@ class EngineParams:
     measurement_time: float = -1.0  # default.ini:492 (-1 = unbounded)
     # byzantine fault injection (common/malicious.py; default.ini:529-536)
     malicious: MaliciousParams = MaliciousParams()
+    # device-resident KPI time-series rings (oversim_tpu/telemetry.py;
+    # **.telemetry.* ini keys).  sample_ticks=0 (default) disables them:
+    # SimState.telemetry stays None and the tick graph is unchanged.
+    telemetry: telemetry_mod.TelemetryParams = telemetry_mod.TelemetryParams()
 
 
 @jax.tree_util.register_dataclass
@@ -85,6 +90,10 @@ class SimState:
     logic: object             # per-node logic state pytree
     stats: dict
     counters: dict            # engine drop/overflow counters
+    # telemetry ring buffers (telemetry.TelemetryState) or None when
+    # telemetry.sample_ticks == 0 — None is an empty pytree, so the
+    # disabled layout is leaf-identical to the pre-telemetry engine
+    telemetry: object = None
 
 
 ENGINE_COUNTERS = ("queue_lost", "bit_error_lost", "dest_unavailable_lost",
@@ -152,6 +161,7 @@ class Simulation:
         n = self.n
         life_mean = None if ov is None else ov.get("churn.lifetimeMean")
         node_keys = keys_mod.random_keys(r_keys, (n,), self.spec)
+        stats = stats_mod.init_stats(self.logic.stat_spec())
         return SimState(
             t_now=jnp.int64(0),
             tick=jnp.int64(0),
@@ -165,8 +175,11 @@ class Simulation:
             malicious=(jax.random.uniform(r_mal, (n,))
                        < self.ep.malicious.probability),
             logic=self.logic.init(r_logic, n),
-            stats=stats_mod.init_stats(self.logic.stat_spec()),
+            stats=stats,
             counters={name: jnp.zeros((), I64) for name in ENGINE_COUNTERS},
+            telemetry=telemetry_mod.init(
+                stats, ENGINE_COUNTERS, self.ep.telemetry,
+                app=getattr(self.logic, "app", None)),
         )
 
     # -- one tick -----------------------------------------------------------
@@ -361,6 +374,15 @@ class Simulation:
             (jnp.sum(s.pool.valid & (s.pool.t_deliver < t_end)) -
              jnp.sum(delivered | to_dead)).astype(jnp.int64))
 
+        # telemetry sample point (telemetry.py): END-of-tick snapshot of
+        # the accumulators into the ring buffers, gated on the sampling
+        # cadence via an out-of-bounds-dropped scatter index — no rng,
+        # no sorts, and every non-telemetry leaf above is untouched
+        # (the tests/test_zz_telemetry_identity.py bit-identity pin)
+        tel = telemetry_mod.fold(
+            s.telemetry, self.ep.telemetry, t_end=t_end, tick=s.tick + 1,
+            alive=alive, stats=new_stats, counters=counters)
+
         # advance to the window END: anything generated during this tick
         # with a due time inside the window is delivered next tick with
         # its original timestamp (build_inbox consumes `t_deliver <
@@ -373,7 +395,7 @@ class Simulation:
                         node_keys=node_keys, underlay=ul_state, pool=new_pool,
                         churn=churn_state, malicious=s.malicious,
                         logic=logic_state, stats=new_stats,
-                        counters=counters)
+                        counters=counters, telemetry=tel)
 
     def step(self, s: SimState, *, ov=None) -> SimState:
         """One tick: the five phases composed (see the phase methods).

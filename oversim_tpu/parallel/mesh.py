@@ -65,16 +65,25 @@ def make_replica_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 def state_shardings(state, mesh: Mesh):
     """NamedSharding pytree for a SimState: leading axis of every array
     whose first dim divides evenly over the mesh is sharded; scalars and
-    ragged leaves are replicated."""
+    ragged leaves are replicated.  Telemetry ring buffers (leading axis
+    = the sample window W, not a node dimension) are always replicated —
+    a W that happens to divide the device count must not turn the gated
+    ring scatter into a cross-shard update."""
     n_dev = mesh.devices.size
+    replicated = NamedSharding(mesh, P())
 
     def spec(leaf):
         leaf = jnp.asarray(leaf)
         if leaf.ndim >= 1 and leaf.shape[0] % n_dev == 0 and leaf.shape[0] > 0:
             return NamedSharding(mesh, P(NODE_AXIS, *([None] * (leaf.ndim - 1))))
-        return NamedSharding(mesh, P())
+        return replicated
 
-    return jax.tree.map(spec, state)
+    sh = jax.tree.map(spec, state)
+    if getattr(state, "telemetry", None) is not None:
+        import dataclasses
+        sh = dataclasses.replace(
+            sh, telemetry=jax.tree.map(lambda _: replicated, state.telemetry))
+    return sh
 
 
 def shard_state(state, mesh: Mesh):

@@ -105,6 +105,7 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
     totals = {p: 0.0 for p in PHASES}
     compile_s = 0.0
     measured = 0
+    tick_rows = []    # per measured tick: {phase: ms} — Perfetto feed
 
     for tick in range(n_ticks + 1):
         first = tick == 0
@@ -152,8 +153,11 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
             compile_s = time.perf_counter() - t_tick0
             continue
         measured += 1
+        row = {}
         for p, dt in zip(PHASES, (dt_h, dt_c, dt_is, dt_ig, dt_n, dt_a)):
             totals[p] += dt
+            row[p] = round(dt * 1e3, 3)
+        tick_rows.append(row)
 
     denom = max(measured, 1)
     phase_ms = {p: round(totals[p] / denom * 1e3, 3) for p in PHASES}
@@ -166,6 +170,9 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
         "phase_frac": {p: round(totals[p] / max(sum(totals.values()), 1e-12),
                                 4) for p in PHASES},
         "split_sum_ms_per_tick": round(split_sum * 1e3, 3),
+        # per-tick phase rows (ms) — telemetry.PerfettoTrace.add_profile
+        # lays them out as back-to-back tick.<phase> spans
+        "phase_ticks_ms": tick_rows,
         "phase_compile_s": round(compile_s, 2),
     }
 

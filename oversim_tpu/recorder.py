@@ -66,6 +66,14 @@ def _load():
             return _lib
         if not _build():
             _failed = True
+            # one-line warning, once: the fallback is byte-identical but
+            # ~50x slower on million-row vectors — a silent downgrade
+            # would look like a perf regression with no cause
+            import sys
+            sys.stderr.write(
+                "oversim_tpu.recorder: native vecwriter build failed — "
+                "using the pure-Python .vec writer (byte-identical "
+                "output, slower on large vectors)\n")
             return None
         lib = ctypes.CDLL(str(_SO))
         lib.vw_open.restype = ctypes.c_void_p

@@ -202,6 +202,41 @@ def t_critical(df: int, confidence: float = 0.95) -> float:
     return tab[df - 1] if df <= len(tab) else _T_NORMAL[confidence]
 
 
+def series_summary(values, confidence: float = 0.95) -> dict:
+    """Cross-replica CI bands for a stacked time series (host-side).
+
+    ``values`` is a ``[S, K]`` array: S replica series over K aligned
+    sample points (the telemetry plane's ring samples — replicas share
+    the tick-based sampling cadence, see oversim_tpu/telemetry.py).
+    NaN entries (e.g. a scalar mean before its first event) are
+    excluded per sample point.  Returns JSON-ready lists — {kind, k,
+    mean[K], stddev[K], sem[K], ci[K], confidence} with the Student-t
+    half-width over the replicas that carry data at each point (None
+    where fewer than two do)."""
+    import numpy as np
+
+    v = np.asarray(values, float)
+    if v.ndim != 2:
+        raise ValueError(f"series_summary wants [S, K], got {v.shape}")
+    s, _ = v.shape
+    has = ~np.isnan(v)
+    k = has.sum(axis=0)                                   # [K]
+    safe_k = np.maximum(k, 1)
+    mean = np.where(k > 0, np.nansum(v, axis=0) / safe_k, np.nan)
+    dev2 = np.where(has, (v - mean[None, :]) ** 2, 0.0)
+    var = dev2.sum(axis=0) / np.maximum(k - 1, 1)
+    stddev = np.sqrt(var)
+    sem = stddev / np.sqrt(safe_k)
+    t = np.array([t_critical(int(ki) - 1, confidence) if ki > 1
+                  else math.nan for ki in k])
+    ci = t * sem
+    clean = lambda a: [None if x != x else float(x)  # noqa: E731
+                       for x in np.asarray(a, float)]
+    return {"kind": "series", "replicas": s, "k": k.astype(int).tolist(),
+            "mean": clean(mean), "stddev": clean(stddev),
+            "sem": clean(sem), "ci": clean(ci), "confidence": confidence}
+
+
 def ensemble_summary(reduced: dict, confidence: float = 0.95) -> dict:
     """Host-side: attach Student-t CI half-widths (ci = t_{k-1} * sem)
     to a (device_get of a) ``ensemble_reduce`` result and convert leaves

@@ -118,3 +118,107 @@ def to_dot(state) -> str:
 
 def to_json(state) -> str:
     return json.dumps(snapshot(state), indent=1)
+
+
+# ---------------------------------------------------------------------------
+# telemetry time-series plots (dependency-free SVG)
+# ---------------------------------------------------------------------------
+
+_PALETTE = ("#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e",
+            "#17becf", "#8c564b", "#e377c2")
+
+
+def _finite_pairs(t, v):
+    return [(float(ti), float(vi)) for ti, vi in zip(t, v)
+            if ti is not None and vi is not None
+            and float(vi) == float(vi)]
+
+
+def series_svg(rec, names=None, width=720, height=320) -> str:
+    """Render telemetry KPI time series as a standalone SVG string.
+
+    ``rec`` is either a solo ``telemetry.kpi_series`` dict (``t_s`` +
+    ``series``) or a campaign ``telemetry.ensemble_series`` record
+    (``t_s`` per replica + ``bands``) — the ensemble form draws the
+    cross-replica mean line with a translucent ±CI band behind it.
+    ``names`` selects tracks (default: up to 8, sorted).  No plotting
+    dependency: write the string to a ``.svg`` and open it anywhere.
+    """
+    ensemble = "bands" in rec
+    if ensemble:
+        t = rec["t_s"][0] if rec.get("t_s") else []
+        tracks = rec["bands"]
+    else:
+        t = list(np.asarray(rec["t_s"], float))
+        tracks = rec["series"]
+    names = list(names or sorted(tracks))[:len(_PALETTE)]
+
+    # data extent over every plotted track (CI band edges included)
+    pts_all, band_all = {}, {}
+    for name in names:
+        if ensemble:
+            b = tracks[name]
+            mean = b["mean"]
+            ci = b.get("ci") or [None] * len(mean)
+            pts_all[name] = _finite_pairs(t, mean)
+            band_all[name] = [
+                (float(ti), float(m) - float(c), float(m) + float(c))
+                for ti, m, c in zip(t, mean, ci)
+                if ti is not None and m is not None and c is not None]
+        else:
+            pts_all[name] = _finite_pairs(t, tracks[name])
+    xs = [p[0] for ps in pts_all.values() for p in ps]
+    ys = ([p[1] for ps in pts_all.values() for p in ps]
+          + [y for bs in band_all.values() for b in bs for y in b[1:]])
+    if not xs or not ys:
+        return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+                f'height="{height}"><text x="10" y="20">no telemetry '
+                f'samples</text></svg>')
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    xr = (x1 - x0) or 1.0
+    yr = (y1 - y0) or 1.0
+    ml, mr, mt, mb = 50, 160, 10, 30            # margins (legend right)
+    px = lambda x: ml + (x - x0) / xr * (width - ml - mr)  # noqa: E731
+    py = lambda y: (height - mb                             # noqa: E731
+                    - (y - y0) / yr * (height - mt - mb))
+
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="sans-serif" font-size="10">',
+           f'<rect x="{ml}" y="{mt}" width="{width - ml - mr}" '
+           f'height="{height - mt - mb}" fill="none" stroke="#999"/>']
+    for frac in (0.0, 0.5, 1.0):                # axis labels
+        out.append(f'<text x="{ml - 4}" y="{py(y0 + frac * yr) + 3:.0f}" '
+                   f'text-anchor="end">{y0 + frac * yr:.4g}</text>')
+        out.append(f'<text x="{px(x0 + frac * xr):.0f}" '
+                   f'y="{height - mb + 14}" text-anchor="middle">'
+                   f'{x0 + frac * xr:.4g}s</text>')
+    for i, name in enumerate(names):
+        color = _PALETTE[i % len(_PALETTE)]
+        band = band_all.get(name)
+        if band:
+            top = " ".join(f"{px(ti):.1f},{py(hi):.1f}"
+                           for ti, _, hi in band)
+            bot = " ".join(f"{px(ti):.1f},{py(lo):.1f}"
+                           for ti, lo, _ in reversed(band))
+            out.append(f'<polygon points="{top} {bot}" fill="{color}" '
+                       f'fill-opacity="0.15" stroke="none"/>')
+        pts = " ".join(f"{px(xi):.1f},{py(yi):.1f}"
+                       for xi, yi in pts_all[name])
+        if pts:
+            out.append(f'<polyline points="{pts}" fill="none" '
+                       f'stroke="{color}" stroke-width="1.5"/>')
+        ly = mt + 12 + i * 14                   # legend entry
+        out.append(f'<rect x="{width - mr + 8}" y="{ly - 8}" width="10" '
+                   f'height="10" fill="{color}"/>')
+        out.append(f'<text x="{width - mr + 22}" y="{ly}">{name}</text>')
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def write_series_svg(rec, path, names=None, **kw) -> str:
+    """series_svg to a file; returns the path."""
+    svg = series_svg(rec, names=names, **kw)
+    with open(path, "w") as f:
+        f.write(svg)
+    return str(path)

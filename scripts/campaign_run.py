@@ -18,6 +18,14 @@ Usage:
 
 Common:  [--t 120] simulated seconds  [--chunk 64] ticks per scan
          [--platform cpu|axon]  [--out report.json]
+         [--telemetry K] in-graph KPI sampling every K ticks (emits a
+         telemetry_series record: per-replica series + CI bands)
+         [--telemetry-window W] ring capacity   [--trace trace.json]
+         Perfetto host-phase spans + ensemble KPI counter tracks
+
+Every artifact carries a top-level ``manifest`` (config hash, mesh
+layout, git rev, artifact paths — oversim_tpu/telemetry.py
+``run_manifest``).
 
 The report JSON is written INCREMENTALLY with atomic tmp+rename
 (bench.py's ArtifactWriter): a phase record after init, one after the
@@ -95,8 +103,12 @@ def _build_from_flags(args):
     cp = churn_mod.ChurnParams(model=args.churn, target_num=args.n,
                                lifetime_mean=args.lifetime,
                                init_interval=10.0 / args.n)
-    ep = sim_mod.EngineParams(window=args.window, inbox_slots=8,
-                              pool_factor=8)
+    from oversim_tpu import telemetry as telemetry_mod
+    ep = sim_mod.EngineParams(
+        window=args.window, inbox_slots=8, pool_factor=8,
+        telemetry=telemetry_mod.TelemetryParams(
+            sample_ticks=args.telemetry,
+            window=args.telemetry_window))
     sim = sim_mod.Simulation(logic, cp, engine_params=ep)
     return Campaign(sim, CampaignParams(replicas=args.replicas,
                                         base_seed=args.seed,
@@ -127,13 +139,24 @@ def main():
     ap.add_argument("--platform", default=None)
     ap.add_argument("--out", default=None, help="incremental atomic "
                     "report artifact path")
+    ap.add_argument("--telemetry", type=int, default=0, metavar="K",
+                    help="device-resident KPI time-series sampling every "
+                    "K ticks (0 = off; oversim_tpu/telemetry.py)")
+    ap.add_argument("--telemetry-window", type=int, default=256,
+                    metavar="W", help="telemetry ring-buffer capacity")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write a Perfetto/Chrome trace JSON of the "
+                    "host phases + sampled KPI counter tracks")
     args = ap.parse_args()
 
     jax = _setup_jax(args.platform)
     from bench import ArtifactWriter
+    from oversim_tpu import telemetry as telemetry_mod
     from oversim_tpu.parallel import mesh as mesh_mod
 
     artifact = ArtifactWriter(args.out)
+    trace = (telemetry_mod.PerfettoTrace("campaign_run")
+             if args.trace else None)
 
     if args.ini:
         from oversim_tpu.config.ini import IniFile
@@ -148,6 +171,7 @@ def main():
     avail = len(jax.devices())
     n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
                 if camp.s % d == 0)
+    mesh = None
     if n_dev > 1:
         mesh = mesh_mod.make_replica_mesh(n_dev)
         cs = mesh_mod.shard_campaign_state(cs, mesh)
@@ -156,6 +180,22 @@ def main():
                 "init_wall_s": round(time.perf_counter() - t0, 2)}
     print(json.dumps(init_rec), flush=True)
     artifact.add(init_rec)
+    if trace:
+        trace.span("init", t0, time.perf_counter() - t0,
+                   args={"s": camp.s, "devices": n_dev})
+
+    # run manifest: config hash + mesh layout + artifact paths attached
+    # to the artifact as its top-level "manifest" key
+    manifest = telemetry_mod.run_manifest(
+        config={"ini": args.ini, "config": args.config,
+                "replicas": camp.p.replicas, "base_seed": camp.p.base_seed,
+                "grid": camp.grid, "n": getattr(args, "n", None),
+                "overlay": args.overlay, "t": args.t, "chunk": args.chunk,
+                "telemetry": {"sampleTicks": args.telemetry,
+                              "window": args.telemetry_window}},
+        mesh=mesh,
+        artifacts={"report": args.out, "trace": args.trace})
+    artifact.set_manifest(manifest)
 
     t0 = time.perf_counter()
     cs = camp.run_until_device(cs, args.t, chunk=args.chunk)
@@ -164,7 +204,11 @@ def main():
                "run_wall_s": round(time.perf_counter() - t0, 2)}
     print(json.dumps(run_rec), flush=True)
     artifact.add(run_rec)
+    if trace:
+        trace.span("run", t0, time.perf_counter() - t0,
+                   args={"target_t_sim": args.t, "chunk": args.chunk})
 
+    t0 = time.perf_counter()
     report = camp.report(cs, confidence=args.confidence)
     # merge the timing records WITHOUT clobbering report keys (the
     # report's "t_sim" is the per-replica list; the run record's target
@@ -172,6 +216,24 @@ def main():
     report["_campaign"].update(init_rec, **run_rec)
     report["_campaign"].pop("phase", None)
     artifact.add(report)
+
+    # per-replica KPI time series + cross-replica CI bands (telemetry
+    # rings sampled in-graph; one extra device_get)
+    tel_rec = camp.telemetry_report(cs, confidence=args.confidence)
+    if tel_rec.get("enabled", True):
+        tel_rec["metric"] = "telemetry_series"
+        artifact.add(tel_rec)
+        print(json.dumps(tel_rec), flush=True)
+        if trace and tel_rec.get("bands"):
+            # ensemble-mean KPI tracks over SIM time (counter events)
+            t_s = tel_rec["t_s"][0] if tel_rec.get("t_s") else []
+            for name, band in sorted(tel_rec["bands"].items()):
+                for t, v in zip(t_s, band["mean"]):
+                    if v is not None:
+                        trace.counter(name, t, v, pid=2)
+    if trace:
+        trace.span("report", t0, time.perf_counter() - t0)
+        trace.write(args.trace)
     artifact.finish()
     print(json.dumps(report), flush=True)
     return 0

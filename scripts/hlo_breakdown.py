@@ -19,6 +19,14 @@ Usage:
       sharded over the available devices) and additionally pins ZERO
       cross-replica collectives — the replica axis must stay pure data
       parallelism (oversim_tpu/campaign/; tests/test_vmap_campaign.py).
+  python scripts/hlo_breakdown.py --telemetry K [--campaign S] [n] ...
+      Compiles the tick telemetry-off AND telemetry-on (sampleTicks=K)
+      and pins the DELTA: zero full-pool sorts, no new sorts, scatter
+      delta bounded by --max-scatter-delta (default 64 — one gated
+      mode="drop" scatter per ring buffer, oversim_tpu/telemetry.py),
+      zero new collectives.  With --campaign S the compare runs on the
+      replica-sharded campaign tick (replicated [W] rings must add no
+      cross-device traffic).  Helper: :func:`check_telemetry_budget`.
 
 The counting helpers are import-safe (no jax import at module level):
 XLA-CPU at -O0 expands scatters into ``while`` loops (ScatterExpander),
@@ -107,6 +115,37 @@ def check_budget(txt: str, pool_dim: int, max_full_pool_sorts: int,
     return ok, counts
 
 
+def check_telemetry_budget(base_counts: dict, tel_counts: dict,
+                           max_full_pool_sorts: int = 0,
+                           max_scatter_delta: int = 64,
+                           max_new_collectives: int = 0):
+    """(ok, delta) — the telemetry-enabled tick vs the telemetry-off tick.
+
+    The telemetry plane's entire graph cost is one gated ``mode="drop"``
+    scatter per ring buffer (oversim_tpu/telemetry.py fold), so the
+    pinned contract is: still ZERO full-pool sorts (no sort may appear
+    anywhere — the rings never sort), a BOUNDED scatter delta (one per
+    ring; KBRTest taps + engine counters + time/tick/alive meta fit well
+    under 64), and ZERO new collectives (the [W] rings are replicated /
+    per-replica — sampling must not create cross-device traffic).
+    ``base_counts``/``tel_counts`` are :func:`hlo_op_counts` dicts.
+    """
+    delta = {
+        "full_pool_sort_count": tel_counts["full_pool_sort_count"],
+        "sort_delta": (tel_counts["sort_count"]
+                       - base_counts["sort_count"]),
+        "scatter_delta": (tel_counts["scatter_count"]
+                          - base_counts["scatter_count"]),
+        "collective_delta": (tel_counts["collective_count"]
+                             - base_counts["collective_count"]),
+    }
+    ok = (delta["full_pool_sort_count"] <= max_full_pool_sorts
+          and delta["sort_delta"] <= 0
+          and delta["scatter_delta"] <= max_scatter_delta
+          and delta["collective_delta"] <= max_new_collectives)
+    return ok, delta
+
+
 # ---------------------------------------------------------------------------
 # CLI: compile + report / budget-check
 # ---------------------------------------------------------------------------
@@ -127,8 +166,10 @@ def _setup_jax():
     return jax
 
 
-def _build_sim(n, overlay, window, inbox, pool_factor=4, inbox_impl="scatter"):
+def _build_sim(n, overlay, window, inbox, pool_factor=4, inbox_impl="scatter",
+               telemetry_ticks=0):
     from oversim_tpu import churn as churn_mod
+    from oversim_tpu import telemetry as telemetry_mod
     from oversim_tpu.apps import kbrtest
     from oversim_tpu.apps.kbrtest import KbrTestApp
     from oversim_tpu.common import lookup as lk_mod
@@ -145,8 +186,11 @@ def _build_sim(n, overlay, window, inbox, pool_factor=4, inbox_impl="scatter"):
     cp = churn_mod.ChurnParams(model="none", target_num=n,
                                init_interval=20.0 / n,
                                init_deviation=2.0 / n)
-    ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
-                              pool_factor=pool_factor, inbox_impl=inbox_impl)
+    ep = sim_mod.EngineParams(
+        window=window, inbox_slots=inbox,
+        pool_factor=pool_factor, inbox_impl=inbox_impl,
+        telemetry=telemetry_mod.TelemetryParams(
+            sample_ticks=telemetry_ticks))
     return sim_mod.Simulation(logic, cp, engine_params=ep)
 
 
@@ -214,6 +258,64 @@ def campaign_budget_main(n, overlay, window, inbox, replicas, max_sorts,
     return 0 if ok else 1
 
 
+def telemetry_budget_main(n, overlay, window, inbox, tel_ticks, replicas,
+                          max_sorts, max_scatter_delta) -> int:
+    """--telemetry K: compile the tick TWICE — telemetry off and
+    telemetry on (sampleTicks=K) — and pin the delta: zero full-pool
+    sorts and no new sorts anywhere, a bounded scatter delta (one gated
+    mode="drop" scatter per ring buffer), and zero new collectives.
+    With --campaign S the comparison runs on the vmapped replica-sharded
+    campaign tick instead, where the zero-new-collectives pin proves the
+    replicated [W] rings add no cross-device traffic."""
+    jax = _setup_jax()
+    sim_off = _build_sim(n, overlay, window, inbox)
+    sim_on = _build_sim(n, overlay, window, inbox, telemetry_ticks=tel_ticks)
+    pool_dim = sim_off.ep.pool_factor * n
+
+    if replicas is not None:
+        from oversim_tpu.campaign import Campaign, CampaignParams
+        from oversim_tpu.parallel import mesh as mesh_mod
+        texts = []
+        for sim in (sim_off, sim_on):
+            camp = Campaign(sim, CampaignParams(replicas=replicas,
+                                                base_seed=7))
+            cs = camp.init()
+            avail = len(jax.devices())
+            n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                        if camp.s % d == 0)
+            mesh = mesh_mod.make_replica_mesh(n_dev)
+            sh = mesh_mod.campaign_state_shardings(cs, mesh)
+            step = jax.jit(camp._vstep, in_shardings=(sh,),
+                           out_shardings=sh)
+            texts.append(step.lower(cs).compile().as_text())
+            log(f"campaign tick compiled "
+                f"(telemetry={'on' if sim is sim_on else 'off'}, "
+                f"S={camp.s}, {n_dev} dev)")
+        what = f"campaign S={replicas}"
+    else:
+        texts = []
+        for sim in (sim_off, sim_on):
+            s = sim.init(seed=7)
+            texts.append(jax.jit(sim.step).lower(s).compile().as_text())
+            log(f"one-tick HLO compiled "
+                f"(telemetry={'on' if sim is sim_on else 'off'})")
+        what = "solo tick"
+
+    base = hlo_op_counts(texts[0], pool_dim)
+    tel = hlo_op_counts(texts[1], pool_dim)
+    ok, delta = check_telemetry_budget(
+        base, tel, max_full_pool_sorts=max_sorts,
+        max_scatter_delta=max_scatter_delta)
+    print(f"telemetry budget ({what}, sampleTicks={tel_ticks}): "
+          f"full_pool_sorts {delta['full_pool_sort_count']} "
+          f"(max {max_sorts}), sort delta {delta['sort_delta']} (max 0), "
+          f"scatter delta {delta['scatter_delta']} "
+          f"(max {max_scatter_delta}), collective delta "
+          f"{delta['collective_delta']} (max 0) "
+          f"-> {'OK' if ok else 'EXCEEDED'}", flush=True)
+    return 0 if ok else 1
+
+
 def breakdown_main(n, overlay, window, inbox) -> int:
     jax = _setup_jax()
     sim = _build_sim(n, overlay, window, inbox)
@@ -269,9 +371,14 @@ def main(argv) -> int:
     budget = "--budget" in argv
     argv = [a for a in argv if a != "--budget"]
     max_sorts, max_scatters, replicas = 0, None, None
+    tel_ticks, max_scatter_delta = None, 64
     if "--campaign" in argv:
         i = argv.index("--campaign")
         replicas = int(argv[i + 1])
+        del argv[i:i + 2]
+    if "--telemetry" in argv:
+        i = argv.index("--telemetry")
+        tel_ticks = int(argv[i + 1])
         del argv[i:i + 2]
     if "--max-sorts" in argv:
         i = argv.index("--max-sorts")
@@ -281,11 +388,18 @@ def main(argv) -> int:
         i = argv.index("--max-scatters")
         max_scatters = int(argv[i + 1])
         del argv[i:i + 2]
+    if "--max-scatter-delta" in argv:
+        i = argv.index("--max-scatter-delta")
+        max_scatter_delta = int(argv[i + 1])
+        del argv[i:i + 2]
     n = int(argv[1]) if len(argv) > 1 else (
-        256 if (budget or replicas) else 4096)
+        256 if (budget or replicas or tel_ticks) else 4096)
     overlay = argv[2] if len(argv) > 2 else "kademlia"
     window = float(argv[3]) if len(argv) > 3 else 0.2
     inbox = int(argv[4]) if len(argv) > 4 else 8
+    if tel_ticks is not None:
+        return telemetry_budget_main(n, overlay, window, inbox, tel_ticks,
+                                     replicas, max_sorts, max_scatter_delta)
     if replicas is not None:
         return campaign_budget_main(n, overlay, window, inbox, replicas,
                                     max_sorts, max_scatters)

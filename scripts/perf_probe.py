@@ -5,6 +5,11 @@ Prints timestamped stages so a hang is attributable to a stage.
 OVERSIM_PROFILE=1 appends a per-phase tick-time breakdown JSON line
 (oversim_tpu/profiling.py).
 
+OVERSIM_PROBE_ARTIFACT=path persists every stage record to ``path``
+through bench.py's ArtifactWriter (atomic tmp+rename after every add,
+with a run_manifest attached) — a hang or SIGKILL mid-probe leaves a
+valid partial artifact naming the last completed stage.
+
 OVERSIM_PROBE_REPLICAS="1,4,8" appends the CAMPAIGN stage: for each S it
 compiles the vmapped S-replica program (oversim_tpu/campaign/), then
 reports compile wall, time-to-first-chunk and steady ms/tick — the
@@ -47,6 +52,15 @@ overlay = sys.argv[3] if len(sys.argv) > 3 else "kademlia"
 dev = jax.devices()[0]
 log(f"backend up: {dev} platform={dev.platform}")
 
+from bench import ArtifactWriter  # noqa: E402
+from oversim_tpu import telemetry as telemetry_mod  # noqa: E402
+
+artifact = ArtifactWriter(os.environ.get("OVERSIM_PROBE_ARTIFACT"))
+artifact.set_manifest(telemetry_mod.run_manifest(
+    config={"probe": "perf_probe", "n": n, "chunk": chunk,
+            "overlay": overlay, "platform": dev.platform},
+    artifacts={"report": os.environ.get("OVERSIM_PROBE_ARTIFACT")}))
+
 from oversim_tpu import churn as churn_mod
 from oversim_tpu.apps import kbrtest
 from oversim_tpu.apps.kbrtest import KbrTestApp
@@ -84,13 +98,18 @@ except Exception as e:  # axon may not expose text
 t = time.perf_counter()
 s = sim.run_chunk(s, chunk)
 jax.block_until_ready(s.t_now)
-log(f"chunk1 ({chunk} ticks): {time.perf_counter() - t:.3f}s")
+first_chunk_s = time.perf_counter() - t
+log(f"chunk1 ({chunk} ticks): {first_chunk_s:.3f}s")
+artifact.add({"stage": "first_chunk", "wall_s": round(first_chunk_s, 3)})
+steady = []
 for i in range(4):
     t = time.perf_counter()
     s = sim.run_chunk(s, chunk)
     jax.block_until_ready(s.t_now)
     dt = time.perf_counter() - t
+    steady.append(round(dt / chunk * 1e3, 2))
     log(f"chunk{i + 2}: {dt:.3f}s = {dt / chunk * 1e3:.1f} ms/tick")
+artifact.add({"stage": "steady_chunks", "ms_per_tick": steady})
 
 # device-resident loop: the same 4-chunk span as ONE dispatch
 # (run_until_device's lax.while_loop) — the gap vs 4x run_chunk is the
@@ -102,6 +121,8 @@ jax.block_until_ready(s.t_now)
 dt = time.perf_counter() - t
 log(f"run_until_device (4 chunks, 1 dispatch): {dt:.3f}s = "
     f"{dt / (4 * chunk) * 1e3:.1f} ms/tick")
+artifact.add({"stage": "run_until_device",
+              "ms_per_tick": round(dt / (4 * chunk) * 1e3, 2)})
 
 from oversim_tpu import profiling  # noqa: E402
 
@@ -111,10 +132,15 @@ if profiling.enabled():
     import json
 
     print(json.dumps(report), flush=True)
+    artifact.add(report)
 
 out = sim.summary(s)
 log(f"summary: alive={out['_alive']} ticks={out['_ticks']} "
     f"sent={out.get('kbr_sent')} delivered={out.get('kbr_delivered')}")
+artifact.add({"stage": "summary", "alive": out["_alive"],
+              "ticks": out["_ticks"],
+              "sent": int(out.get("kbr_sent", 0)),
+              "delivered": int(out.get("kbr_delivered", 0))})
 
 # -- campaign stage: compile amortization over the replica axis -------------
 replicas_env = os.environ.get("OVERSIM_PROBE_REPLICAS")
@@ -160,3 +186,6 @@ if replicas_env:
             f"({s_rep / steady:.0f} replica-ticks/s)")
     print(json.dumps({"campaign_probe": rows, "n": n, "chunk": chunk,
                       "overlay": overlay}), flush=True)
+    artifact.add({"stage": "campaign_probe", "rows": rows})
+
+artifact.finish()

@@ -244,7 +244,10 @@ def orchestrate() -> int:
             sys.stderr.write("scale child: %s\n" % line)
             continue
         print(line, flush=True)
-        artifact.add(parsed)   # atomic rewrite after EVERY row
+        if parsed.get("metric") == "run_manifest":
+            artifact.set_manifest(parsed)   # top-level "manifest" key
+        else:
+            artifact.add(parsed)   # atomic rewrite after EVERY row
     child.wait()
     artifact.finish()
     sys.stderr.write("scale: child rc=%s after %.0fs\n"
@@ -275,6 +278,16 @@ def main():
 
     try:
         jax = _setup_jax(args.platform)
+        # run manifest — the orchestrator routes this line to the
+        # artifact's top-level "manifest" key (telemetry.run_manifest)
+        from oversim_tpu import telemetry as telemetry_mod
+        _emit(telemetry_mod.run_manifest(
+            config={"mode": "ladder" if args.ladder else "churn_smoke",
+                    "ns": args.ns if args.ladder else None, "n": args.n,
+                    "overlay": args.overlay, "t": args.t,
+                    "measure": args.measure, "platform": args.platform},
+            artifacts={"artifact":
+                       os.environ.get("OVERSIM_SCALE_ARTIFACT")}))
         if args.ladder:
             for n in [int(x) for x in args.ns.split(",") if x]:
                 if _remaining() < 120:

@@ -81,6 +81,67 @@ def test_one_dispatch_and_one_fetch_per_window(monkeypatch):
     assert s.tick == 96
 
 
+class FakeTelemetry:
+    """Quacks like telemetry.TelemetryState ring leaves (numpy only)."""
+
+    def __init__(self, tick=0):
+        self.n = np.int64(tick)
+        self.alive = np.ones((4,), np.int64)
+
+
+class FakeStateTel(FakeState):
+    def __init__(self, t_now=0, tick=0):
+        super().__init__(t_now, tick)
+        self.telemetry = FakeTelemetry(tick)
+
+
+class FakeSimTel(FakeSim):
+    def run_until_device(self, s, t_sim, chunk=256):
+        self.device_calls.append((float(t_sim), chunk))
+        return FakeStateTel(t_now=int(t_sim * 1e9), tick=s.tick + chunk)
+
+
+def test_one_dispatch_one_fetch_with_telemetry_and_trace(monkeypatch):
+    """Telemetry riding in SimState must NOT add a second sync: the ring
+    leaves come back inside the same single ``_fetch_window_leaves``
+    device_get, and the Perfetto trace records exactly one
+    window_dispatch + one window_fetch span per window."""
+    from oversim_tpu import telemetry as telemetry_mod
+    fetched = []
+    real_fetch = bench._fetch_window_leaves
+    monkeypatch.setattr(bench, "_fetch_window_leaves",
+                        lambda s: fetched.append(real_fetch(s))
+                        or fetched[-1])
+    trace = telemetry_mod.PerfettoTrace("test")
+    sim = FakeSimTel()
+    # with a trace the loop reads now() 5x per window (cond, dispatch
+    # start/end, fetch end, on_window wall): windows end at wall 50/100/
+    # 150, the cond at 160 stops -> exactly 3 windows
+    s, windows = bench.run_measurement_windows(
+        sim, FakeStateTel(), start_sim_t=100.0, window_sim_s=6.25,
+        measure_wall=150.0, chunk=32, on_window=lambda out, wall: None,
+        now=FakeClock(dt=10.0), trace=trace)
+    assert windows == 3
+    assert len(sim.device_calls) == 3          # ONE dispatch per window
+    assert len(fetched) == 3                   # ONE device_get per window
+    # the rings came along inside that one fetch
+    assert all("telemetry" in leaves for leaves in fetched)
+    assert fetched[-1]["telemetry"].n == s.telemetry.n
+    spans = [e for e in trace.to_dict()["traceEvents"]
+             if e.get("ph") == "X"]
+    disp = [e for e in spans if e["name"] == "window_dispatch"]
+    fetch = [e for e in spans if e["name"] == "window_fetch"]
+    assert len(disp) == 3 and len(fetch) == 3
+    assert [e["args"]["window"] for e in disp] == [0, 1, 2]
+    assert all(e["dur"] == 10.0e6 for e in disp + fetch)  # one clock step
+
+
+def test_untelemetried_fake_state_fetch_has_no_telemetry_key():
+    leaves = bench._fetch_window_leaves(FakeState(tick=5))
+    assert "telemetry" not in leaves
+    assert leaves["tick"] == 5
+
+
 def test_host_loop_mode_uses_run_until_with_invariants():
     """OVERSIM_INVARIANTS=1 debug tier: the per-chunk-synced run_until
     (with the structural validator on) replaces the device loop."""

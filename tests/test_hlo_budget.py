@@ -8,7 +8,8 @@ text — no backend, no compile — so they stay in the fast tier even
 when the compiled-tick pins (tests/test_engine.py) move to slow.
 """
 
-from scripts.hlo_breakdown import check_budget, hlo_op_counts
+from scripts.hlo_breakdown import (check_budget, check_telemetry_budget,
+                                   hlo_op_counts)
 
 FAKE_HLO = """\
 HloModule step
@@ -103,3 +104,59 @@ def test_check_budget_collective_pin():
     ok, _ = check_budget(FAKE_COLLECTIVE_HLO, pool_dim=None,
                          max_full_pool_sorts=0, max_scatters=5)
     assert ok
+
+
+# -- telemetry-delta pins (scripts/hlo_breakdown.py --telemetry) -------------
+
+# telemetry-off tick: 1 sort (none full-pool), 2 scatters, 0 collectives
+FAKE_BASE_HLO = """\
+HloModule step_off
+  %s1 = s32[16,8] sort(s32[16,8] %c), dimensions={1}
+  %sc0 = s64[64] scatter(s64[64] %d, s32[10] %i, s64[10] %u)
+  %w0 = (s64[64],s32[]) while((s64[64],s32[]) %t), body=%b1, \
+metadata={op_name="jit(step)/jit(main)/scatter"}
+"""
+
+# telemetry-on tick: same graph + ring-buffer scatters (the gated
+# mode="drop" writes telemetry.fold adds — one per ring)
+FAKE_TEL_HLO = FAKE_BASE_HLO.replace("step_off", "step_on") + """\
+  %r0 = s64[256] scatter(s64[256] %t0, s32[1] %i0, s64[1] %v0)
+  %r1 = s64[256] scatter(s64[256] %t1, s32[1] %i1, s64[1] %v1)
+  %r2 = f64[256,5] scatter(f64[256,5] %t2, s32[1] %i2, f64[1,5] %v2)
+"""
+
+# a REGRESSED telemetry tick: the ring writes came back as a full-pool
+# sort plus a cross-device collective
+FAKE_TEL_BAD_HLO = FAKE_BASE_HLO.replace("step_off", "step_bad") + """\
+  %s9 = (s64[192]) sort(s64[192] %a, s32[192] %b), dimensions={0}
+  %ar = f64[256]{0} all-reduce(f64[256]{0} %r), replica_groups={{0,1}}
+  %r0 = s64[256] scatter(s64[256] %t0, s32[1] %i0, s64[1] %v0)
+"""
+
+
+def test_telemetry_budget_bounded_delta_passes():
+    base = hlo_op_counts(FAKE_BASE_HLO, pool_dim=192)
+    tel = hlo_op_counts(FAKE_TEL_HLO, pool_dim=192)
+    ok, delta = check_telemetry_budget(base, tel)
+    assert ok, delta
+    assert delta["scatter_delta"] == 3
+    assert delta["sort_delta"] == 0
+    assert delta["collective_delta"] == 0
+    assert delta["full_pool_sort_count"] == 0
+
+
+def test_telemetry_budget_scatter_delta_breach():
+    base = hlo_op_counts(FAKE_BASE_HLO, pool_dim=192)
+    tel = hlo_op_counts(FAKE_TEL_HLO, pool_dim=192)
+    ok, delta = check_telemetry_budget(base, tel, max_scatter_delta=2)
+    assert not ok and delta["scatter_delta"] == 3
+
+
+def test_telemetry_budget_sort_and_collective_breach():
+    base = hlo_op_counts(FAKE_BASE_HLO, pool_dim=192)
+    bad = hlo_op_counts(FAKE_TEL_BAD_HLO, pool_dim=192)
+    ok, delta = check_telemetry_budget(base, bad)
+    assert not ok
+    assert delta["full_pool_sort_count"] == 1    # the [192] sort
+    assert delta["sort_delta"] == 1
+    assert delta["collective_delta"] == 1

@@ -72,6 +72,25 @@ def test_vec_and_sca_roundtrip(tmp_path):
                for line in sca_lines)
 
 
+def test_fallback_warns_once_and_uses_py_writer(tmp_path, monkeypatch,
+                                                capsys):
+    """A failed native build must NOT be silent: one stderr line, once,
+    then every writer request gets the pure-Python fallback."""
+    monkeypatch.setattr(recorder, "_build", lambda: False)
+    monkeypatch.setattr(recorder, "_lib", None)
+    monkeypatch.setattr(recorder, "_failed", False)
+    w1 = recorder._writer(tmp_path / "w1.vec", "r")
+    w2 = recorder._writer(tmp_path / "w2.vec", "r")
+    w1.close()
+    w2.close()
+    assert isinstance(w1, recorder._PyWriter)
+    assert isinstance(w2, recorder._PyWriter)
+    err = capsys.readouterr().err
+    assert err.count("native vecwriter build failed") == 1
+    # monkeypatch restores _lib/_failed afterwards — later tests still
+    # see the real native writer
+
+
 def test_python_fallback_identical_format(tmp_path):
     a = tmp_path / "a.vec"
     b = tmp_path / "b.vec"

@@ -1,0 +1,309 @@
+"""Host-side pins for the device-resident telemetry plane
+(oversim_tpu/telemetry.py): tap resolution, ring fold/wrap semantics,
+the KPI series/vec/Perfetto/manifest exporters, the cross-replica
+ensemble banding, and the ArtifactWriter manifest attachment.
+
+Everything here is numpy/eager-level (no sim compile) except the one
+PingLogic end-to-end fold test — the heavy bit-identity pins live in
+tests/test_zz_telemetry_identity.py (alphabetically last so the tier-1
+time budget keeps cutting where it did before this plane existed).
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oversim_tpu import stats as stats_mod
+from oversim_tpu import telemetry
+from oversim_tpu import vis
+
+I64 = jnp.int64
+
+
+# ---------------------------------------------------------------------------
+# tap resolution
+# ---------------------------------------------------------------------------
+
+STATS = {"s:kbr_hopcount": jnp.zeros((5,)), "h:kbr_hop_hist":
+         jnp.zeros((8,), I64), "c:kbr_sent": jnp.zeros((), I64),
+         "s:kbr_rpc_rtt_s": jnp.zeros((5,))}
+
+
+class _App:
+    def kpi_spec(self):
+        return ("kbr_hopcount", "kbr_hop_hist")
+
+
+def test_resolve_taps_priority():
+    tp = telemetry.TelemetryParams(sample_ticks=1)
+    # no filter, no app registry -> every key
+    assert set(telemetry.resolve_taps(STATS, tp)) == set(STATS)
+    # app registry picks its subset (class prefixes stripped)
+    assert set(telemetry.resolve_taps(STATS, tp, app=_App())) == {
+        "s:kbr_hopcount", "h:kbr_hop_hist"}
+    # include substring filters override the registry
+    tp = telemetry.TelemetryParams(sample_ticks=1, include=("rpc",))
+    assert telemetry.resolve_taps(STATS, tp, app=_App()) == (
+        "s:kbr_rpc_rtt_s",)
+    # a filter matching nothing falls back to every key
+    tp = telemetry.TelemetryParams(sample_ticks=1, include=("zzz",))
+    assert set(telemetry.resolve_taps(STATS, tp)) == set(STATS)
+
+
+def test_init_disabled_and_window_validation():
+    assert telemetry.init(STATS, ("queue_lost",),
+                          telemetry.TelemetryParams()) is None
+    assert telemetry.init(STATS, (), None) is None
+    with pytest.raises(ValueError):
+        telemetry.init(STATS, (), telemetry.TelemetryParams(
+            sample_ticks=1, window=0))
+
+
+# ---------------------------------------------------------------------------
+# ring fold / wrap semantics (eager jnp — no sim, no compile)
+# ---------------------------------------------------------------------------
+
+def test_fold_cadence_and_ring_wrap():
+    tp = telemetry.TelemetryParams(sample_ticks=2, window=3)
+    stats = {"c:kbr_sent": jnp.zeros((), I64)}
+    tel = telemetry.init(stats, ("queue_lost",), tp)
+    assert tel.t_ns.shape == (3,)
+    alive = jnp.ones((4,), bool)
+    for tick in range(1, 9):
+        tel = telemetry.fold(
+            tel, tp, t_end=jnp.int64(tick * 100), tick=jnp.int64(tick),
+            alive=alive, stats={"c:kbr_sent": jnp.int64(tick * 10)},
+            counters={"queue_lost": jnp.int64(tick)})
+    # ticks 2,4,6,8 sampled; ring of 3 keeps the last three (4,6,8)
+    assert int(tel.n) == 4
+    u = telemetry.unwrap(tel)
+    assert u["k"] == 3 and u["n"] == 4
+    assert u["tick"].tolist() == [4, 6, 8]          # oldest first
+    assert u["t_ns"].tolist() == [400, 600, 800]
+    assert u["alive"].tolist() == [4, 4, 4]
+    assert u["series"]["c:kbr_sent"].tolist() == [40, 60, 80]
+    assert u["counters"]["queue_lost"].tolist() == [4, 6, 8]
+
+
+def test_fold_non_sample_tick_only_touches_n():
+    tp = telemetry.TelemetryParams(sample_ticks=4, window=2)
+    tel = telemetry.init({"c:x": jnp.zeros((), I64)}, (), tp)
+    t2 = telemetry.fold(tel, tp, t_end=jnp.int64(7), tick=jnp.int64(3),
+                        alive=jnp.ones((2,), bool),
+                        stats={"c:x": jnp.int64(99)}, counters={})
+    assert int(t2.n) == 0
+    np.testing.assert_array_equal(np.asarray(t2.t_ns),
+                                  np.asarray(tel.t_ns))
+    np.testing.assert_array_equal(np.asarray(t2.series["c:x"]),
+                                  np.asarray(tel.series["c:x"]))
+
+
+def test_ring_order_helper():
+    assert telemetry._ring_order(2, 4).tolist() == [0, 1]
+    assert telemetry._ring_order(4, 4).tolist() == [0, 1, 2, 3]
+    assert telemetry._ring_order(6, 4).tolist() == [2, 3, 0, 1]
+
+
+# ---------------------------------------------------------------------------
+# KPI series + exporters (fabricated numpy TelemetryState)
+# ---------------------------------------------------------------------------
+
+def _fake_tel(k=3, w=4):
+    """A ring that has taken k samples (k <= w): KBRTest-shaped taps."""
+    z = lambda shape, fill: np.full(shape, 0.0) + np.asarray(fill)  # noqa: E731
+    acc = np.zeros((w, 5))
+    # cumulative (n, sum, sumsq, min, max): no events at sample 0,
+    # then 2 events summing to 6, then 4 summing to 16
+    acc[1] = [2, 6, 20, 2, 4]
+    acc[2] = [4, 16, 80, 2, 6]
+    hist = np.zeros((w, 8), np.int64)
+    hist[2, 3] = 4
+    return telemetry.TelemetryState(
+        n=np.int64(k),
+        t_ns=np.array([1e9, 2e9, 3e9, 0]).astype(np.int64),
+        tick=np.arange(w).astype(np.int64) * 10,
+        alive=z((w,), 16).astype(np.int64),
+        series={"s:kbr_hopcount": acc, "h:kbr_hop_hist": hist,
+                "c:kbr_sent": np.array([0, 10, 20, 0], np.int64),
+                "c:kbr_delivered": np.array([0, 9, 19, 0], np.int64)},
+        counters={"queue_lost": np.array([0, 1, 2, 0], np.int64)},
+    )
+
+
+def test_kpi_series_names_and_derived_ratio():
+    ks = telemetry.kpi_series(_fake_tel())
+    assert ks["k"] == 3 and ks["n"] == 3
+    s = ks["series"]
+    assert s["aliveNodes"].tolist() == [16.0, 16.0, 16.0]
+    # cumulative-mean track: NaN before the first event
+    m = s["kbr_hopcount.mean"]
+    assert np.isnan(m[0]) and m[1] == 3.0 and m[2] == 4.0
+    assert s["kbr_hopcount.count"].tolist() == [0, 2, 4]
+    assert s["engine.queue_lost"].tolist() == [0.0, 1.0, 2.0]
+    r = s["kbr_delivery_ratio"]
+    assert np.isnan(r[0]) and r[1] == 0.9 and r[2] == 0.95
+    assert ks["hists"]["kbr_hop_hist"].shape == (3, 8)
+    np.testing.assert_allclose(ks["t_s"], [1.0, 2.0, 3.0])
+
+
+def test_series_report_is_json_safe():
+    rep = telemetry.series_report(_fake_tel())
+    txt = json.dumps(rep)                        # must not raise
+    assert rep["metric"] == "telemetry_series"
+    assert rep["samples"] == 3
+    assert rep["series"]["kbr_hopcount.mean"][0] is None   # NaN -> None
+    assert "NaN" not in txt
+
+
+def test_write_vec_rows(tmp_path):
+    p = tmp_path / "tel.vec"
+    nvec = telemetry.write_vec(_fake_tel(), p, run_id="tel-1")
+    txt = p.read_text()
+    lines = txt.splitlines()
+    assert lines[0] == "version 2" and lines[1] == "run tel-1"
+    decls = [ln for ln in lines if ln.startswith("vector ")]
+    assert len(decls) == nvec
+    names = {ln.split()[3] for ln in decls}
+    assert {"aliveNodes", "kbr_delivery_ratio",
+            "engine.queue_lost"} <= names
+    # 3 samples per vector
+    data = [ln for ln in lines if ln and ln[0].isdigit()]
+    assert len(data) == 3 * nvec
+
+
+def test_series_svg_solo_and_ensemble():
+    ks = telemetry.kpi_series(_fake_tel())
+    svg = vis.series_svg(ks, names=("aliveNodes", "kbr_delivery_ratio"))
+    assert svg.startswith("<svg") and "polyline" in svg
+    assert "aliveNodes" in svg
+    # empty series degrade to a placeholder, not an exception
+    assert "no telemetry" in vis.series_svg(
+        {"t_s": [], "series": {"x": []}}, names=("x",))
+
+
+# ---------------------------------------------------------------------------
+# cross-replica ensemble banding
+# ---------------------------------------------------------------------------
+
+def test_series_summary_banding():
+    vals = np.array([[1.0, 2.0, 3.0],
+                     [3.0, 4.0, np.nan],
+                     [2.0, 3.0, 4.0]])
+    out = stats_mod.series_summary(vals, confidence=0.95)
+    assert out["kind"] == "series" and out["replicas"] == 3
+    assert out["k"] == [3, 3, 2]
+    np.testing.assert_allclose(out["mean"], [2.0, 3.0, 3.5])
+    assert len(out["ci"]) == 3 and out["ci"][0] > 0
+
+
+def test_series_summary_validates_shape():
+    with pytest.raises(ValueError):
+        stats_mod.series_summary(np.zeros((4,)))
+
+
+def test_ensemble_series_shapes():
+    tel = _fake_tel()
+    stacked = telemetry.TelemetryState(
+        n=np.array([3, 3], np.int64),
+        t_ns=np.stack([tel.t_ns, tel.t_ns]),
+        tick=np.stack([tel.tick, tel.tick]),
+        alive=np.stack([tel.alive, tel.alive * 2]),
+        series={k: np.stack([v, v]) for k, v in tel.series.items()},
+        counters={k: np.stack([v, v]) for k, v in tel.counters.items()},
+    )
+    rec = telemetry.ensemble_series(stacked, confidence=0.99)
+    assert rec["enabled"] and rec["replicas"] == 2
+    assert rec["samples"] == 3
+    assert len(rec["t_s"]) == 2 and len(rec["t_s"][0]) == 3
+    assert rec["per_replica"]["aliveNodes"] == [[16.0] * 3, [32.0] * 3]
+    band = rec["bands"]["aliveNodes"]
+    assert band["mean"] == [24.0, 24.0, 24.0]
+    json.dumps(rec)                              # JSON-safe end to end
+
+
+# ---------------------------------------------------------------------------
+# Perfetto trace + run manifest
+# ---------------------------------------------------------------------------
+
+def test_perfetto_trace_structure(tmp_path):
+    tr = telemetry.PerfettoTrace("t")
+    tr.span("window_dispatch", 10.0, 0.5, args={"window": 1})
+    tr.span("window_fetch", 10.5, 0.1)
+    tr.counter("kbr_delivery_ratio", 1.0, 0.95, pid=2)
+    tr.add_profile({"phase_ticks_ms": [{"horizon": 1.0, "churn": 2.0}]},
+                   t0_s=10.0)
+    d = tr.to_dict()
+    assert d["displayTimeUnit"] == "ms"
+    evs = d["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert {e["name"] for e in spans} >= {
+        "window_dispatch", "window_fetch", "tick.horizon", "tick.churn"}
+    assert min(e["ts"] for e in evs if "ts" in e) == 0.0   # rebased
+    disp = next(e for e in spans if e["name"] == "window_dispatch")
+    assert disp["dur"] == 0.5e6 and disp["args"]["window"] == 1
+    meta = [e for e in evs if e.get("ph") == "M"]
+    assert any(e["pid"] == 2 for e in meta)      # sim-time KPI process
+    p = tmp_path / "trace.json"
+    tr.write(p)
+    assert json.loads(p.read_text())["traceEvents"]
+
+
+def test_run_manifest_and_config_hash():
+    cfg = {"n": 64, "overlay": "chord"}
+    h1 = telemetry.config_hash(cfg)
+    assert h1 == telemetry.config_hash({"overlay": "chord", "n": 64})
+    assert h1 != telemetry.config_hash({"n": 65, "overlay": "chord"})
+    man = telemetry.run_manifest(config=cfg,
+                                 artifacts={"report": "r.json"},
+                                 hlo_budget={"full_pool_sort_count": 0})
+    assert man["metric"] == "run_manifest"
+    assert man["config_hash"] == h1
+    assert man["artifacts"]["report"] == "r.json"
+    assert man["git_rev"] is None or len(man["git_rev"]) == 40
+    assert "python" in man["versions"]
+    json.dumps(man)
+
+
+def test_artifact_writer_manifest_key(tmp_path):
+    from bench import ArtifactWriter
+    p = tmp_path / "a.json"
+    w = ArtifactWriter(str(p))
+    w.add({"x": 1})
+    doc = json.loads(p.read_text())
+    assert "manifest" not in doc                 # only present when set
+    w.set_manifest({"metric": "run_manifest", "config_hash": "abc"})
+    doc = json.loads(p.read_text())
+    assert doc["manifest"]["config_hash"] == "abc"
+    assert doc["records"] == [{"x": 1}]
+    w.finish()
+    doc = json.loads(p.read_text())
+    assert doc["complete"] and doc["manifest"]["config_hash"] == "abc"
+
+
+# ---------------------------------------------------------------------------
+# ini keys (**.telemetry.*)
+# ---------------------------------------------------------------------------
+
+def test_build_telemetry_ini_keys():
+    from oversim_tpu.config.ini import IniFile
+    from oversim_tpu.config.scenario import ScenarioError, build_telemetry
+    ini = IniFile.loads(
+        "**.telemetry.sampleTicks = 16\n"
+        "**.telemetry.window = 64\n"
+        "**.telemetry.include = \"kbr_hopcount kbr_hop_hist\"\n")
+    tp = build_telemetry(ini, "General")
+    assert tp.sample_ticks == 16 and tp.window == 64
+    assert tp.include == ("kbr_hopcount", "kbr_hop_hist")
+    # defaults: disabled
+    tp = build_telemetry(IniFile.loads(""), "General")
+    assert tp.sample_ticks == 0 and tp.window == 256
+    assert tp.include == ()
+    with pytest.raises(ScenarioError):
+        build_telemetry(IniFile.loads(
+            "**.telemetry.sampleTicks = -1\n"), "General")
+    with pytest.raises(ScenarioError):
+        build_telemetry(IniFile.loads(
+            "**.telemetry.sampleTicks = 4\n**.telemetry.window = 0\n"),
+            "General")
