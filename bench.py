@@ -309,42 +309,19 @@ def _fetch_window_leaves(s):
 
 def _summary_from_leaves(leaves) -> dict:
     """Host-side summary off already-fetched leaves (no device access —
-    the per-window sync stays the one device_get above)."""
-    from oversim_tpu import stats as stats_mod
-    out = stats_mod.summarize(leaves["stats"])
-    out["_engine"] = {k: int(v) for k, v in leaves["counters"].items()}
-    out["_t_sim"] = float(leaves["t_now"]) / 1e9
-    out["_ticks"] = int(leaves["tick"])
-    out["_alive"] = int(leaves["alive"].sum())
-    return out
+    the per-window sync stays the one device_get above).  The body
+    lives in oversim_tpu/service/loop.py (the serving loop shares it);
+    imported lazily — bench must not import the package at module
+    scope (the child sets jax config first)."""
+    from oversim_tpu.service.loop import summarize_counter_leaves
+    return summarize_counter_leaves(leaves)
 
 
 def _campaign_summary_from_leaves(leaves) -> dict:
-    """Campaign tier: every leaf carries a leading [S] replica axis.
-    Aggregate ACROSS replicas first (scalar accumulators merge exactly:
-    sum n/sum/sumsq, min of mins, max of maxes; hist + counter leaves
-    just sum), then reuse the single-run ``summarize`` — so the emitted
-    record keeps the exact schema of the solo tier and ``on_window``'s
-    delivery gate needs no campaign awareness."""
-    import numpy as np
-    from oversim_tpu import stats as stats_mod
-    agg = {}
-    for key, v in leaves["stats"].items():
-        v = np.asarray(v)
-        if key.startswith("s:"):
-            agg[key] = np.concatenate(
-                [v[:, :3].sum(axis=0), [v[:, 3].min()], [v[:, 4].max()]])
-        else:
-            agg[key] = v.sum(axis=0)
-    out = stats_mod.summarize(agg)
-    out["_engine"] = {k: int(np.asarray(v).sum())
-                      for k, v in leaves["counters"].items()}
-    # replicas advance on independent event horizons — report the
-    # LAGGING clock so "simulated seconds covered" is never overstated
-    out["_t_sim"] = float(np.asarray(leaves["t_now"]).min()) / 1e9
-    out["_ticks"] = int(np.asarray(leaves["tick"]).sum())
-    out["_alive"] = int(np.asarray(leaves["alive"]).sum())
-    return out
+    """Campaign tier: leaves carry a leading [S] replica axis; the
+    cross-replica merge lives in oversim_tpu/service/loop.py."""
+    from oversim_tpu.service.loop import campaign_summarize_leaves
+    return campaign_summarize_leaves(leaves)
 
 
 def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,

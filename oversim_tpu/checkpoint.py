@@ -7,22 +7,34 @@ of device arrays (engine/sim.py SimState), so a checkpoint is a flat
 array dump and resume is exact: a restored run continues bit-identically
 (same RNG key, same pool contents, same timers).
 
-Format: one ``.npz`` with the pytree leaves in flatten order plus a
-structure fingerprint.  Restoring requires a structurally identical
-state (same Simulation configuration — logic type, N, engine params);
-the fingerprint check turns mismatches into clear errors instead of
-silent corruption.
+Format ``oversim-tpu-ckpt-v2``: one ``.npz`` with the pytree leaves in
+flatten order, a structure fingerprint, and a JSON ``__meta__`` manifest
+(tick / t_now, config sha256, git rev, plus caller extras such as the
+service loop's window bookkeeping).  Restoring requires a structurally
+identical state (same Simulation configuration — logic type, N, engine
+params); the fingerprint check turns shape mismatches into clear errors
+instead of silent corruption, and ``expect_config`` additionally refuses
+a checkpoint whose recorded config hash names a DIFFERENT scenario that
+happens to share the array layout.  v1 checkpoints (no meta) still load.
+
+Writes are KILL-SAFE: the ``.npz`` is written to ``path + ".tmp"``,
+fsynced, then ``os.replace``d — a SIGKILL at any point leaves either the
+previous complete checkpoint or the new complete one, never a torn file
+(the ArtifactWriter discipline from bench.py).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-FORMAT = "oversim-tpu-ckpt-v1"
+FORMAT = "oversim-tpu-ckpt-v2"
+FORMAT_V1 = "oversim-tpu-ckpt-v1"
 
 
 def _fingerprint(leaves) -> str:
@@ -30,25 +42,82 @@ def _fingerprint(leaves) -> str:
     return hashlib.sha1(sig.encode()).hexdigest()
 
 
-def save(path: str, state) -> None:
-    """Write ``state`` (any pytree of arrays, e.g. SimState) to ``path``."""
+def _git_rev() -> str | None:
+    from oversim_tpu import telemetry as telemetry_mod
+    return telemetry_mod.git_rev()
+
+
+def save(path: str, state, meta: dict | None = None) -> None:
+    """Atomically write ``state`` (any pytree of arrays, e.g. SimState)
+    to ``path``.
+
+    ``meta`` is an optional JSON-serializable manifest merged into the
+    checkpoint's ``__meta__`` record; ``tick``/``t_now`` (read off the
+    state when it carries those attributes — scalars solo, lists for
+    stacked campaign state), ``git_rev`` and ``format`` are filled in
+    automatically when absent.  The write is tmp+rename atomic: a kill
+    mid-write never clobbers an existing checkpoint.
+    """
     leaves = jax.tree.leaves(state)
     arrays = {f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    np.savez_compressed(
-        path, __format__=np.asarray(FORMAT),
-        __fingerprint__=np.asarray(_fingerprint(leaves)), **arrays)
+    m = dict(meta or {})
+    m.setdefault("format", FORMAT)
+    for name in ("tick", "t_now"):
+        v = getattr(state, name, None)
+        if v is not None and name not in m:
+            m[name] = np.asarray(v).tolist()
+    if "git_rev" not in m:
+        m["git_rev"] = _git_rev()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f, __format__=np.asarray(FORMAT),
+            __fingerprint__=np.asarray(_fingerprint(leaves)),
+            __meta__=np.asarray(json.dumps(m, sort_keys=True)),
+            **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
-def load(path: str, example):
+def read_meta(path: str) -> dict:
+    """The checkpoint's ``__meta__`` manifest without touching the array
+    payload ({"format": "oversim-tpu-ckpt-v1"} for v1 checkpoints)."""
+    with np.load(path, allow_pickle=False) as data:
+        fmt = str(data["__format__"])
+        if fmt == FORMAT_V1:
+            return {"format": FORMAT_V1}
+        if fmt != FORMAT:
+            raise ValueError(f"not an oversim-tpu checkpoint: {path}")
+        return json.loads(str(data["__meta__"]))
+
+
+def load(path: str, example, *, expect_config: str | None = None):
     """Restore a checkpoint into the structure of ``example``.
 
     ``example`` is a state with the same configuration (typically
     ``sim.init()``); its values are discarded, only the pytree structure
     and array shapes/dtypes are used.
+
+    ``expect_config`` — a ``telemetry.config_hash`` of the scenario the
+    caller is about to resume.  A v2 checkpoint recording a DIFFERENT
+    ``config_hash`` is refused even when the array layout matches (two
+    scenarios can share shapes yet disagree on every static parameter);
+    v1 checkpoints carry no hash and pass the check on fingerprint alone.
     """
     data = np.load(path, allow_pickle=False)
-    if str(data["__format__"]) != FORMAT:
+    fmt = str(data["__format__"])
+    if fmt not in (FORMAT, FORMAT_V1):
         raise ValueError(f"not an oversim-tpu checkpoint: {path}")
+    meta = ({} if fmt == FORMAT_V1
+            else json.loads(str(data["__meta__"])))
+    if expect_config is not None:
+        got = meta.get("config_hash")
+        if got is not None and got != expect_config:
+            raise ValueError(
+                "checkpoint scenario mismatch: checkpoint was written by "
+                f"config {got} but this run is config {expect_config} "
+                f"({path})")
     leaves, treedef = jax.tree.flatten(example)
     want = _fingerprint(leaves)
     got = str(data["__fingerprint__"])

@@ -599,3 +599,50 @@ def build_campaign(ini: IniFile, config: str = "General",
     sim = build_simulation(ini, config, engine_params=engine_params,
                            trace_events=trace_events)
     return Campaign(sim, build_campaign_params(ini, config))
+
+
+def build_service(ini: IniFile, config: str = "General"):
+    """``**.service.*`` keys → ServiceParams (framework ini extension —
+    the resident serving loop, oversim_tpu/service/):
+
+      **.service.windowSimS      = 1.0    simulated seconds per window
+      **.service.chunk           = 32     ticks per device scan chunk
+      **.service.checkpointEvery = 0      windows between checkpoints
+      **.service.checkpointPath  = "x.npz"
+      **.service.maxWindows      = 0      absolute window count (0 = ∞)
+      **.service.maxWallS        = 0      wall budget per run() (0 = ∞)
+      **.service.doubleBuffer    = true   pipeline fetch k / dispatch k+1
+      **.service.realtime        = false  pace windows to wall clock
+    """
+    from oversim_tpu.service import ServiceParams
+    window_sim_s = float(_value(
+        ini.get("**.service.windowSimS", config), 1.0))
+    if window_sim_s <= 0:
+        raise ScenarioError(f"**.service.windowSimS must be > 0, "
+                            f"got {window_sim_s}")
+    chunk = int(_value(ini.get("**.service.chunk", config), 32))
+    if chunk < 1:
+        raise ScenarioError(f"**.service.chunk must be >= 1, got {chunk}")
+    ckpt_every = int(_value(
+        ini.get("**.service.checkpointEvery", config), 0))
+    if ckpt_every < 0:
+        raise ScenarioError(f"**.service.checkpointEvery must be >= 0, "
+                            f"got {ckpt_every}")
+    raw_path = _value(ini.get("**.service.checkpointPath", config))
+    ckpt_path = (None if raw_path is None
+                 else str(raw_path).strip().strip('"') or None)
+    if ckpt_every > 0 and ckpt_path is None:
+        raise ScenarioError("**.service.checkpointEvery set without a "
+                            "**.service.checkpointPath")
+    max_windows = int(_value(ini.get("**.service.maxWindows", config), 0))
+    if max_windows < 0:
+        raise ScenarioError(f"**.service.maxWindows must be >= 0, "
+                            f"got {max_windows}")
+    max_wall_s = float(_value(ini.get("**.service.maxWallS", config), 0.0))
+    dbuf = bool(_value(ini.get("**.service.doubleBuffer", config), True))
+    realtime = bool(_value(ini.get("**.service.realtime", config), False))
+    return ServiceParams(
+        window_sim_s=window_sim_s, chunk=chunk,
+        checkpoint_every=ckpt_every, checkpoint_path=ckpt_path,
+        max_windows=max_windows, max_wall_s=max_wall_s,
+        double_buffer=dbuf, realtime=realtime)

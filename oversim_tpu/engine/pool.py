@@ -165,19 +165,27 @@ def next_deliver_time(pool: MsgPool):
     return jnp.min(jnp.where(pool.valid, pool.t_deliver, T_INF))
 
 
-def _due_masks(pool: MsgPool, n: int, t_end, alive):
-    """(due, to_dead) masks shared by both inbox implementations."""
+def _due_masks(pool: MsgPool, n: int, t_end, alive, hold=None):
+    """(due, to_dead) masks shared by both inbox implementations.
+
+    ``hold`` ([P] bool or None) marks messages that are NEVER due: the
+    service/gateway plane parks ``EXT_OUT`` responses in the pool until
+    a host drain frees them, instead of having the engine re-deliver
+    (and thereby consume) them on the next tick."""
     due = pool.valid & (pool.t_deliver < t_end)
+    if hold is not None:
+        due = due & ~hold
     to_dead = due & ~alive[jnp.clip(pool.dst, 0, n - 1)]
     return due & ~to_dead, to_dead
 
 
-def build_inbox_sort(pool: MsgPool, n: int, r: int, t_end, alive):
+def build_inbox_sort(pool: MsgPool, n: int, r: int, t_end, alive,
+                     hold=None):
     """Legacy inbox grouping: one lexicographic (dst, t_deliver) full-pool
     stable sort, O(P log P).  Kept selectable (``inbox_impl="sort"``) so
     the scatter path stays identity-testable against it."""
     p = pool.capacity
-    due, to_dead = _due_masks(pool, n, t_end, alive)
+    due, to_dead = _due_masks(pool, n, t_end, alive, hold)
 
     dst_k = jnp.where(due, pool.dst, n).astype(I32)
     t_k = jnp.where(due, pool.t_deliver, T_INF)
@@ -196,7 +204,8 @@ def build_inbox_sort(pool: MsgPool, n: int, r: int, t_end, alive):
     return inbox, delivered, to_dead
 
 
-def build_inbox_scatter(pool: MsgPool, n: int, r: int, t_end, alive):
+def build_inbox_scatter(pool: MsgPool, n: int, r: int, t_end, alive,
+                        hold=None):
     """Zero-sort inbox grouping: R rounds of deterministic scatter-min.
 
     Round k scatter-mins t_deliver over the destination axis to find each
@@ -210,7 +219,7 @@ def build_inbox_scatter(pool: MsgPool, n: int, r: int, t_end, alive):
     tests in tests/test_engine.py).
     """
     p = pool.capacity
-    due, to_dead = _due_masks(pool, n, t_end, alive)
+    due, to_dead = _due_masks(pool, n, t_end, alive, hold)
 
     idx = jnp.arange(p, dtype=I32)
     dstc = jnp.clip(pool.dst, 0, n - 1)
@@ -229,12 +238,14 @@ def build_inbox_scatter(pool: MsgPool, n: int, r: int, t_end, alive):
 
 
 def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive,
-                impl: str = "scatter"):
+                impl: str = "scatter", hold=None):
     """Group due messages by destination into an index table.
 
     ``impl`` selects the grouping algorithm: ``"scatter"`` (default,
     zero-sort scatter-min rounds) or ``"sort"`` (legacy full-pool
     lexicographic sort).  Both return bit-identical results.
+    ``hold`` ([P] bool) excludes messages from delivery entirely — see
+    :func:`_due_masks`.
 
     Returns:
       inbox: [N, R] i32 pool indices, -1 for empty slots, ordered by
@@ -244,9 +255,9 @@ def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive,
              reference drops these as "dest unavailable", SimpleUDP.cc:307).
     """
     if impl == "sort":
-        return build_inbox_sort(pool, n, r, t_end, alive)
+        return build_inbox_sort(pool, n, r, t_end, alive, hold)
     if impl == "scatter":
-        return build_inbox_scatter(pool, n, r, t_end, alive)
+        return build_inbox_scatter(pool, n, r, t_end, alive, hold)
     raise ValueError(f"unknown inbox_impl: {impl!r} "
                      "(expected 'scatter' or 'sort')")
 

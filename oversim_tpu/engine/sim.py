@@ -50,6 +50,9 @@ I32 = jnp.int32
 I64 = jnp.int64
 NS = 1_000_000_000
 T_INF = pool_mod.T_INF
+# gateway.EXT_OUT mirrored here — the engine must not import the gateway
+# (layering: gateway builds on engine); consistency pinned by a test
+EXT_OUT_KIND = 151
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +75,12 @@ class EngineParams:
     # **.telemetry.* ini keys).  sample_ticks=0 (default) disables them:
     # SimState.telemetry stays None and the tick graph is unchanged.
     telemetry: telemetry_mod.TelemetryParams = telemetry_mod.TelemetryParams()
+    # service/gateway plane: EXT_OUT messages addressed to this node slot
+    # are HELD in the pool (never inbox-selected) until a host drain
+    # frees them — required for window-granular response draining, where
+    # the device runs many ticks between drains (oversim_tpu/service/).
+    # -1 (default) disables the hold: tick graph unchanged.
+    ext_hold_slot: int = -1
 
 
 @jax.tree_util.register_dataclass
@@ -248,9 +257,13 @@ class Simulation:
         """Phase 3a: pick each destination's R earliest due messages
         (scatter-min rounds by default — zero full-pool sorts; see
         engine/pool.py and ``EngineParams.inbox_impl``)."""
+        hold = None
+        if self.ep.ext_hold_slot >= 0:
+            hold = ((s.pool.kind == EXT_OUT_KIND)
+                    & (s.pool.dst == self.ep.ext_hold_slot))
         return pool_mod.build_inbox(
             s.pool, self.n, self.ep.inbox_slots, t_end, alive,
-            impl=self.ep.inbox_impl)
+            impl=self.ep.inbox_impl, hold=hold)
 
     def _phase_inbox_gather(self, s: SimState, t_next, inbox):
         """Phase 3b: ONE gather of the packed [P, W] block for all the

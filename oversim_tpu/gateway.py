@@ -32,8 +32,10 @@ Wire format of an external frame (network byte order):
 from __future__ import annotations
 
 import dataclasses
+import errno
 import socket
 import struct
+import sys
 import time
 
 import jax
@@ -51,6 +53,11 @@ EXT_IN = 150    # real network → gateway node (a=session, b=tag, c=word)
 EXT_OUT = 151   # gateway node → real network (same fields echoed)
 
 _HDR = struct.Struct("!IIII")
+
+# a 4-byte length prefix larger than this means the TCP byte stream is
+# desynced (garbage where a prefix should be): the connection can never
+# produce a complete frame again and is dropped
+_MAX_TCP_FRAME = 1 << 20
 
 
 class GenericPacketParser:
@@ -102,6 +109,69 @@ def drain_ext_out(state, gw_slot: int, handler):
     mask = jnp.zeros(pool.valid.shape, bool).at[
         jnp.asarray(done, I32)].set(True)
     return dataclasses.replace(state, pool=pool_mod.free(pool, mask))
+
+
+@dataclasses.dataclass
+class ExtFrame:
+    """One externally arriving frame awaiting batched injection."""
+
+    a: int = 0
+    b: int = 0
+    c: int = 0
+    kind: int = EXT_IN
+    dst: int | None = None
+    src: int | None = None
+    key: object = None       # uint32 key lanes, or None for zeros
+
+
+def inject_ext_batch(state, frames, gw_slot: int, t_deliver=None):
+    """Write ``frames`` into the pool as ONE batched alloc.
+
+    The per-packet ``inject`` path costs one ``pool.alloc`` dispatch per
+    datagram; a service window boundary injects the whole accumulated
+    batch in a single allocation instead.  All frames share one deliver
+    time — the next tick (``t_now + 1``) by default, or ``t_deliver``
+    (absolute ns; the service loop schedules its batch into the
+    window's final tick) — in list order (pool.alloc's cumsum ranking
+    preserves batch order among equal ``t_deliver``).
+
+    Returns ``(state', overflow)`` where ``overflow`` is the alloc's
+    device scalar of frames that did NOT fit in the pool — kept as a lazy
+    handle so callers on the service hot path don't force a host sync;
+    ``None`` when ``frames`` is empty (state returned unchanged).
+    """
+    if not frames:
+        return state, None
+    n = len(frames)
+    rmax = state.pool.nodes.shape[1]
+    lanes = state.pool.key.shape[1]
+    key_rows = np.zeros((n, lanes), np.uint32)
+    for i, f in enumerate(frames):
+        if f.key is not None:
+            key_rows[i] = np.asarray(f.key, np.uint32)
+    when = (state.t_now + 1 if t_deliver is None
+            else jnp.maximum(jnp.asarray(t_deliver, I64), state.t_now + 1))
+    out = dict(
+        t_deliver=jnp.broadcast_to(when, (n,)).astype(I64),
+        src=jnp.asarray([gw_slot if f.src is None else f.src
+                         for f in frames], I32),
+        dst=jnp.asarray([gw_slot if f.dst is None else f.dst
+                         for f in frames], I32),
+        kind=jnp.asarray([f.kind for f in frames], I32),
+        key=jnp.asarray(key_rows),
+        nonce=jnp.zeros((n,), I32),
+        hops=jnp.zeros((n,), I32),
+        a=jnp.asarray([f.a for f in frames], I32),
+        b=jnp.asarray([f.b for f in frames], I32),
+        c=jnp.asarray([f.c for f in frames], I32),
+        d=jnp.zeros((n,), I32),
+        nodes=jnp.full((n, rmax), NO_NODE, I32),
+        size_b=jnp.full((n,), _HDR.size, I32),
+        stamp=jnp.broadcast_to(state.t_now, (n,)).astype(I64),
+    )
+    new_pool, overflow = pool_mod.alloc(state.pool, out,
+                                        jnp.ones((n,), bool))
+    return dataclasses.replace(state, pool=new_pool), overflow
 
 
 class RealtimeGateway:
@@ -160,53 +230,109 @@ class RealtimeGateway:
         self._sessions: dict = {}       # session id -> (addr | conn key)
         self._next_session = 1
         self._seen_pool = None          # pool validity snapshot
+        # RX hardening/batching state: frames accumulate host-side in
+        # _rx and enter the pool as ONE alloc per flush_rx (the service
+        # loop flushes at window boundaries, pump() per slice)
+        self._rx: list = []
+        self._rx_overflow: list = []    # lazy device scalars, see rx_overflow
+        self.rx_frames = 0              # frames injected (post-parse)
+        self.rx_batches = 0             # batched pool writes performed
+        self.rx_dropped = 0             # malformed/unauthenticated frames
+        self.rx_socket_errors = 0       # transient socket-level errors
+        self._warned: set = set()       # one stderr warning per category
 
     # ------------------------------------------------ injection --------
     def inject(self, kind: int, a: int = 0, b: int = 0, c: int = 0,
                key=None, dst: int | None = None, src: int | None = None):
         """Write one message into the pool, delivered immediately."""
-        s = self.state
-        rmax = s.pool.nodes.shape[1]
-        lanes = s.pool.key.shape[1]
-        out = dict(
-            t_deliver=jnp.asarray([s.t_now + 1], I64),
-            src=jnp.asarray([self.gw if src is None else src], I32),
-            dst=jnp.asarray([self.gw if dst is None else dst], I32),
-            kind=jnp.asarray([kind], I32),
-            key=(jnp.zeros((1, lanes), jnp.uint32) if key is None
-                 else jnp.asarray(key, jnp.uint32)[None, :]),
-            nonce=jnp.zeros((1,), I32),
-            hops=jnp.zeros((1,), I32),
-            a=jnp.asarray([a], I32), b=jnp.asarray([b], I32),
-            c=jnp.asarray([c], I32), d=jnp.zeros((1,), I32),
-            nodes=jnp.full((1, rmax), NO_NODE, I32),
-            size_b=jnp.asarray([_HDR.size], I32),
-            stamp=jnp.asarray([s.t_now], I64),
-        )
-        new_pool, _ = pool_mod.alloc(s.pool, out, jnp.asarray([True]))
-        self.state = dataclasses.replace(s, pool=new_pool)
+        self.state, _ = inject_ext_batch(
+            self.state, [ExtFrame(a=a, b=b, c=c, kind=kind,
+                                  dst=dst, src=src, key=key)], self.gw)
+
+    def flush_rx(self, t_deliver=None):
+        """Inject every accumulated RX frame as ONE batched pool write."""
+        if not self._rx:
+            return
+        frames, self._rx = self._rx, []
+        self.state, overflow = inject_ext_batch(self.state, frames,
+                                                self.gw,
+                                                t_deliver=t_deliver)
+        self._rx_overflow.append(overflow)
+        self.rx_batches += 1
+        self.rx_frames += len(frames)
+
+    def rx_overflow(self) -> int:
+        """Frames lost to pool overflow across all flushed batches.
+
+        The per-batch overflow counts stay on device as lazy scalars
+        (an ``int()`` right after ``flush_rx`` would force a host sync
+        on the service hot path); summing here blocks on them."""
+        total = sum(int(np.asarray(h)) for h in self._rx_overflow)
+        self._rx_overflow = [np.int64(total)] if total else []
+        return total
 
     # ------------------------------------------------ socket pumps -----
+    def _rx_warn(self, category: str, detail: str):
+        """One stderr warning per error category; the rx_* counters
+        count every occurrence."""
+        if category not in self._warned:
+            self._warned.add(category)
+            print(f"oversim-tpu gateway: dropping {category} ({detail});"
+                  " counted in rx_dropped/rx_socket_errors, further"
+                  " occurrences silent", file=sys.stderr)
+
+    def _decode_frame(self, data: bytes, what: str):
+        """Verify + parse one frame; None (counted + warned) on ANY
+        decode failure — one malformed packet from the real network
+        must never unwind run_realtime."""
+        try:
+            if self.crypto is not None:
+                data = self.crypto.verify_frame(data)
+                if data is None:
+                    self.rx_dropped += 1
+                    self._rx_warn(f"unauthenticated {what}",
+                                  "bad auth block")
+                    return None
+            parsed = self.parser.decapsulate(data)
+            if parsed is None:
+                self.rx_dropped += 1
+                self._rx_warn(f"rejected {what}", "parser returned None")
+                return None
+            return parsed
+        except Exception as e:  # noqa: BLE001 — any parser/crypto crash
+            self.rx_dropped += 1
+            self._rx_warn(f"malformed {what}", repr(e))
+            return None
+
     def _poll_udp(self):
+        socket_errs = 0
         while True:
             try:
                 data, addr = self.udp.recvfrom(65536)
             except BlockingIOError:
                 return
-            except OSError:
+            except InterruptedError:
+                continue
+            except OSError as e:
+                # an earlier sendto to a dead peer surfaces here as
+                # ECONNREFUSED/ECONNRESET (ICMP port-unreachable): drop
+                # it and keep draining the queue — bounded, so a truly
+                # broken socket (e.g. EBADF) cannot spin forever
+                self.rx_socket_errors += 1
+                self._rx_warn("udp socket error", repr(e))
+                socket_errs += 1
+                if (e.errno in (errno.ECONNREFUSED, errno.ECONNRESET)
+                        and socket_errs < 64):
+                    continue
                 return
-            if self.crypto is not None:
-                data = self.crypto.verify_frame(data)
-                if data is None:
-                    continue          # unauthenticated datagram: drop
-            parsed = self.parser.decapsulate(data)
+            parsed = self._decode_frame(data, "udp datagram")
             if parsed is None:
-                continue              # parser rejected the packet
+                continue
             b, c = parsed
             sid = self._next_session
             self._next_session += 1
             self._sessions[sid] = ("udp", addr)
-            self.inject(EXT_IN, a=sid, b=b, c=c)
+            self._rx.append(ExtFrame(a=sid, b=b, c=c))
 
     def _poll_tcp(self):
         if self.tcp is None:
@@ -231,27 +357,34 @@ class RealtimeGateway:
                 buf.extend(chunk)
             except BlockingIOError:
                 pass
-            except OSError:
+            except OSError as e:
+                self.rx_socket_errors += 1
+                self._rx_warn("tcp socket error", repr(e))
                 dead.append(sid)
                 continue
             # length-prefixed frames (SimpleTCP stream framing)
             while len(buf) >= 4:
                 ln = int.from_bytes(buf[:4], "big")
+                if ln > _MAX_TCP_FRAME:
+                    # garbage where the prefix should be: the stream is
+                    # desynced and would wait forever for a frame that
+                    # never completes — the connection is unrecoverable
+                    self.rx_dropped += 1
+                    self._rx_warn("desynced tcp stream",
+                                  f"length prefix {ln}")
+                    dead.append(sid)
+                    break
                 if len(buf) < 4 + ln:
                     break             # incomplete frame: wait for more
                 # undersized frames fall through to the parser, which
                 # rejects them (custom parsers may use smaller framing)
                 frame = bytes(buf[4:4 + ln])
                 del buf[:4 + ln]
-                if self.crypto is not None:
-                    frame = self.crypto.verify_frame(frame)
-                    if frame is None:
-                        continue      # unauthenticated frame: drop
-                parsed = self.parser.decapsulate(frame)
+                parsed = self._decode_frame(frame, "tcp frame")
                 if parsed is None:
-                    continue          # parser rejected the frame
+                    continue
                 b, c = parsed
-                self.inject(EXT_IN, a=sid, b=b, c=c)
+                self._rx.append(ExtFrame(a=sid, b=b, c=c))
         for sid in dead:
             self._tcp_conns.pop(sid, None)
             self._sessions.pop(sid, None)
@@ -296,6 +429,7 @@ class RealtimeGateway:
         gateway node's inbox (and consumed) on the very next tick."""
         self._poll_udp()
         self._poll_tcp()
+        self.flush_rx()
         target = int(self.state.t_now) + int(sim_seconds * NS)
         while int(self.state.t_now) < target:
             prev = int(self.state.t_now)

@@ -1,0 +1,20 @@
+"""Service plane: resident, checkpointed, double-buffered serving loop.
+
+Composes the pieces the batch tiers already have — ``run_until_device``
+windows (bench.py), exact checkpoint/restore (checkpoint.py), real-socket
+ingestion (gateway.py), telemetry exporters — into a long-running service
+(ROADMAP item 5).  See service/loop.py for the pipeline and
+service/ingest.py for the request sources.
+"""
+
+from oversim_tpu.service.loop import (  # noqa: F401
+    ServiceLoop,
+    ServiceParams,
+    campaign_summarize_leaves,
+    counter_leaf_refs,
+    summarize_counter_leaves,
+)
+from oversim_tpu.service.ingest import (  # noqa: F401
+    GatewayIngest,
+    InProcessIngest,
+)

@@ -1,0 +1,116 @@
+"""Request sources for the serving loop.
+
+The ingest protocol the loop drives (service/loop.py):
+
+    before_window(state, target_ns) -> state'
+        called at the window boundary BEFORE dispatch; inject every
+        accumulated request as ONE batched ``EXT_IN`` pool write
+        (gateway.inject_ext_batch), delivered at the start of the
+        window about to run.
+    after_window(state) -> state'
+        called after the window's drain; collect ``EXT_OUT`` responses
+        (gateway.drain_ext_out — a host read of the pool, which is why
+        ingest mode runs single-buffered).
+
+The served Simulation MUST be built with
+``EngineParams(ext_hold_slot=<gw_slot>)``: a window runs many ticks
+between drains, and without the hold the engine re-delivers each
+``EXT_OUT`` response into the gateway node's inbox on the tick after it
+is sent — consuming it long before the boundary drain runs.  With the
+hold, responses park in the pool until ``after_window`` frees them.
+(The per-tick ``pump()``/``run_realtime`` path drains between ticks and
+works either way.)
+
+``InProcessIngest`` is the test/program-embedding source (a plain
+submit() queue); ``GatewayIngest`` adapts a RealtimeGateway so real
+UDP/TCP clients are served at window granularity.  Both attach to a
+SOLO Simulation state; the stacked campaign state has no per-replica
+session plumbing and is served without ingest.
+"""
+
+from __future__ import annotations
+
+from oversim_tpu import gateway as gateway_mod
+
+
+class InProcessIngest:
+    """In-process request queue (the test stand-in for real sockets).
+
+    ``submit`` assigns a session id and buffers the frame;
+    ``responses[sid]`` holds the drained ``(b, c)`` answer after the
+    window that served it."""
+
+    def __init__(self, gw_slot: int = 0, collect_responses: bool = True):
+        self.gw = gw_slot
+        self.collect = collect_responses
+        self.responses: dict = {}     # sid -> (b, c)
+        self.num_batches = 0          # batched pool writes performed
+        self.num_injected = 0         # frames injected across batches
+        self._pending: list = []
+        self._overflow: list = []     # lazy device scalars (no hot sync)
+        self._next_sid = 1
+
+    def submit(self, b: int = 0, c: int = 0, *,
+               kind: int = gateway_mod.EXT_IN,
+               dst: int | None = None, key=None) -> int:
+        sid = self._next_sid
+        self._next_sid += 1
+        self._pending.append(gateway_mod.ExtFrame(
+            a=sid, b=b, c=c, kind=kind, dst=dst, key=key))
+        return sid
+
+    def overflow(self) -> int:
+        """Frames lost to pool overflow so far (forces a host sync)."""
+        import numpy as np
+        total = sum(int(np.asarray(h)) for h in self._overflow)
+        self._overflow = []
+        return total
+
+    def before_window(self, state, target_ns: int):
+        if not self._pending:
+            return state
+        frames, self._pending = self._pending, []
+        state, overflow = gateway_mod.inject_ext_batch(
+            state, frames, self.gw)
+        self._overflow.append(overflow)
+        self.num_batches += 1
+        self.num_injected += len(frames)
+        return state
+
+    def after_window(self, state):
+        if not self.collect:
+            return state
+
+        def handler(sid, b, c):
+            self.responses[sid] = (b, c)
+            return True
+
+        return gateway_mod.drain_ext_out(state, self.gw, handler)
+
+
+class GatewayIngest:
+    """Serve a RealtimeGateway's sockets at window granularity.
+
+    The gateway object keeps owning the sockets, session table and
+    crypto; this adapter only moves its poll → batch-inject → drain
+    cycle onto the service loop's boundaries (state flows through the
+    loop, ``gateway.state`` is kept in step for the drain helpers)."""
+
+    def __init__(self, gateway):
+        self.gateway = gateway
+
+    def before_window(self, state, target_ns: int):
+        gw = self.gateway
+        gw.state = state
+        gw._poll_udp()
+        gw._poll_tcp()
+        gw.flush_rx()
+        return gw.state
+
+    def after_window(self, state):
+        gw = self.gateway
+        gw.state = state
+        gw._drain_ext_out()
+        for fn in gw.ext_drains:
+            fn()
+        return gw.state

@@ -1,0 +1,383 @@
+"""The double-buffered serving loop with preemption-safe checkpointing.
+
+The batch tiers drive windows strictly serially: dispatch window k,
+block on its counter fetch, report, dispatch k+1
+(bench.run_measurement_windows).  The host-side fetch + summary +
+artifact write all happen while the device sits idle.  The service loop
+pipelines them instead:
+
+      device   |  win k   |  win k+1  |  win k+2  |
+      host          | fetch k-1 | fetch k  | fetch k+1 |
+                      ckpt? report          ckpt? report
+
+  * dispatch window k+1 (``run_until_device`` — async under jax's
+    dispatch model, the host returns as soon as the computation is
+    enqueued), THEN block on window k's fetch.  The device never idles
+    between windows; the host drains k while k+1 computes.  Pinned by
+    the fake-timer harness in tests/test_service.py (dispatch k+1
+    strictly before fetch k, exactly ONE host sync per window) and
+    visible as overlapping ``window_dispatch``/``window_fetch`` spans in
+    the PerfettoTrace export.
+  * every ``checkpoint_every`` windows the FULL state is device-copied
+    right after dispatch and written through checkpoint.py during the
+    next window's compute — the npz write rides the non-critical path.
+    The write is tmp+rename atomic, so a SIGKILL at any instant leaves a
+    complete previous checkpoint; ``ServiceLoop.resume`` restores it and
+    continues BIT-IDENTICALLY (window targets are computed as
+    ``start + (k+1)*window_sim_s`` from the checkpointed bookkeeping,
+    never accumulated, so resumed targets equal uninterrupted ones
+    exactly).
+  * donation safety: ``run_until_device`` donates the state buffers, so
+    the counter snapshot (and the checkpoint snapshot) are real device
+    copies (``jnp.array(x, copy=True)``, the _dedupe_buffers idiom)
+    enqueued BEFORE the next dispatch — stream order guarantees they
+    read window k's values before window k+1 overwrites the donated
+    buffers.
+
+``runner`` is anything with the ``run_until_device(state, t_sim,
+chunk=)`` contract: a Simulation (solo SimState) or a Campaign (stacked
+[S] CampaignState) — checkpointing and summaries handle both.
+
+With an ``ingest`` source attached (service/ingest.py) the loop runs
+single-buffered: requests are batch-injected at the window boundary
+(one ``inject_ext_batch`` pool write), served inside the window, and
+their ``EXT_OUT`` responses — parked in the pool by the engine's
+``ext_hold_slot`` hold (EngineParams) — are drained synchronously on
+the fresh state.  The drain is a host read of the pool, which forces
+the sync the double-buffer mode avoids: throughput mode and serving
+mode are explicit park positions, not a silent middle ground.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+NS = 1_000_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceParams:
+    """Knobs of the serving loop (``**.service.*`` ini keys)."""
+
+    window_sim_s: float = 1.0     # simulated seconds per window
+    chunk: int = 32               # ticks per device-resident scan chunk
+    checkpoint_every: int = 0     # windows between checkpoints (0 = off)
+    checkpoint_path: str | None = None
+    max_windows: int = 0          # absolute window count to serve (0 = ∞)
+    max_wall_s: float = 0.0       # wall-clock budget per run() (0 = ∞)
+    double_buffer: bool = True    # pipeline fetch k under dispatch k+1
+    realtime: bool = False        # pace windows to wall clock (gateway)
+
+
+@dataclasses.dataclass
+class _Pending:
+    """An in-flight window: dispatched, not yet drained."""
+
+    window: int                   # 0-based window index
+    target_sim_t: float
+    t_d0: float                   # dispatch span (host clock)
+    t_d1: float
+    snap: dict                    # device COPIES of the counter leaves
+    ckpt: object = None           # device copy of the full state, or None
+
+
+def counter_leaf_refs(s):
+    """The per-window counter leaves as device REFERENCES — no fetch.
+
+    Same selection as bench's ``_fetch_window_leaves`` (stats
+    accumulators, engine counters, clock, alive mask, telemetry rings
+    when present); the service loop copies these before the next
+    dispatch and fetches the copy one window later."""
+    leaves = {"stats": s.stats, "counters": s.counters,
+              "t_now": s.t_now, "tick": s.tick, "alive": s.alive}
+    tel = getattr(s, "telemetry", None)
+    if tel is not None:
+        leaves["telemetry"] = tel
+    return leaves
+
+
+def summarize_counter_leaves(leaves) -> dict:
+    """Host-side summary off already-fetched leaves (no device access —
+    the per-window sync stays the loop's single fetch)."""
+    from oversim_tpu import stats as stats_mod
+    out = stats_mod.summarize(leaves["stats"])
+    out["_engine"] = {k: int(v) for k, v in leaves["counters"].items()}
+    out["_t_sim"] = float(leaves["t_now"]) / 1e9
+    out["_ticks"] = int(leaves["tick"])
+    out["_alive"] = int(leaves["alive"].sum())
+    return out
+
+
+def campaign_summarize_leaves(leaves) -> dict:
+    """Campaign tier: every leaf carries a leading [S] replica axis.
+    Aggregate ACROSS replicas first (scalar accumulators merge exactly:
+    sum n/sum/sumsq, min of mins, max of maxes; hist + counter leaves
+    just sum), then reuse the single-run ``summarize`` — so the emitted
+    record keeps the exact schema of the solo tier and ``on_window``'s
+    consumers need no campaign awareness."""
+    from oversim_tpu import stats as stats_mod
+    agg = {}
+    for key, v in leaves["stats"].items():
+        v = np.asarray(v)
+        if key.startswith("s:"):
+            agg[key] = np.concatenate(
+                [v[:, :3].sum(axis=0), [v[:, 3].min()], [v[:, 4].max()]])
+        else:
+            agg[key] = v.sum(axis=0)
+    out = stats_mod.summarize(agg)
+    out["_engine"] = {k: int(np.asarray(v).sum())
+                      for k, v in leaves["counters"].items()}
+    # replicas advance on independent event horizons — report the
+    # LAGGING clock so "simulated seconds covered" is never overstated
+    out["_t_sim"] = float(np.asarray(leaves["t_now"]).min()) / 1e9
+    out["_ticks"] = int(np.asarray(leaves["tick"]).sum())
+    out["_alive"] = int(np.asarray(leaves["alive"]).sum())
+    return out
+
+
+def _default_fetch(tree):
+    import jax
+    return jax.device_get(tree)
+
+
+def _default_copy(tree):
+    # REAL device copies: jnp.array(copy=True), never a jitted identity
+    # (jax returns the input alias for those) — the copies must outlive
+    # the next dispatch's donation of the originals
+    import jax
+    import jax.numpy as jnp
+    return jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
+
+
+def _min_sim_t(t_now) -> float:
+    # solo state: i64 scalar; campaign state: [S] vector — the lagging
+    # replica clock is the campaign's window position
+    return float(np.asarray(t_now).min()) / NS
+
+
+class ServiceLoop:
+    """Resident serving loop over a Simulation or Campaign runner.
+
+    Parameters beyond ``(runner, state, params)``:
+
+    config          scenario description dict; its telemetry.config_hash
+                    is embedded in checkpoints and enforced on resume
+    on_window       ``f(window, summary, wall_s)`` per drained window
+    ingest          request source (service/ingest.py protocol:
+                    ``before_window(state, target_ns)`` /
+                    ``after_window(state)``); forces single-buffering
+    trace           telemetry.PerfettoTrace — window_dispatch /
+                    window_fetch / checkpoint_write spans
+    summarize       fetched-leaves → dict (campaign_summarize_leaves for
+                    a Campaign runner)
+    fetch / copy    host-sync and device-copy hooks (fake harnesses)
+    checkpoint_meta extra keys merged into the checkpoint manifest
+    now             host clock (fake-timer tests)
+    windows_done / start_sim_t
+                    resume bookkeeping — use :meth:`resume` instead of
+                    passing these directly
+    """
+
+    def __init__(self, runner, state, params: ServiceParams, *,
+                 config=None, on_window=None, ingest=None, trace=None,
+                 summarize=None, fetch=None, copy=None,
+                 checkpoint_meta=None, now=time.perf_counter,
+                 windows_done: int = 0, start_sim_t: float | None = None):
+        self.runner = runner
+        self.state = state
+        self.p = params
+        self.config = config
+        self.config_hash = None
+        if config is not None:
+            from oversim_tpu import telemetry as telemetry_mod
+            self.config_hash = telemetry_mod.config_hash(config)
+        self.on_window = on_window
+        self.ingest = ingest
+        self.trace = trace
+        self.now = now
+        self.checkpoint_meta = dict(checkpoint_meta or {})
+        self.summarize = summarize or summarize_counter_leaves
+        self.fetch = fetch or _default_fetch
+        self.copy = copy or _default_copy
+        self.windows_done = windows_done
+        self.checkpoints_written = 0
+        self.last_checkpoint = None   # windows_done of the newest ckpt
+        if start_sim_t is None:
+            # fresh start: the window origin is the state's current
+            # clock (resume paths get the ORIGINAL origin from the
+            # checkpoint manifest instead — t_now overshoots targets)
+            start_sim_t = _min_sim_t(self.fetch(state.t_now))
+        self.start_sim_t = float(start_sim_t)
+        self._launched = windows_done  # next window index to dispatch
+        self._pending: _Pending | None = None
+        self._stop = False
+        self._t0 = None
+
+    # ---------------------------------------------------- lifecycle ----
+    @classmethod
+    def resume(cls, runner, example_state, params: ServiceParams, *,
+               path: str | None = None, config=None, **kw):
+        """Restore the last checkpoint and continue bit-identically.
+
+        ``example_state`` supplies the pytree structure (``sim.init()``
+        / ``campaign.init()``); ``config`` (when given) must hash to the
+        checkpoint's recorded ``config_hash`` — a checkpoint from a
+        different scenario is refused (checkpoint.load ``expect_config``).
+        The checkpointed window cadence must match ``params``: a changed
+        ``window_sim_s``/``chunk`` would move every subsequent window
+        target and silently break the bit-identity guarantee, so it
+        raises instead."""
+        from oversim_tpu import checkpoint as ckpt_mod
+        path = path or params.checkpoint_path
+        if path is None:
+            raise ValueError("resume needs a checkpoint path")
+        expect = None
+        if config is not None:
+            from oversim_tpu import telemetry as telemetry_mod
+            expect = telemetry_mod.config_hash(config)
+        state = ckpt_mod.load(path, example_state, expect_config=expect)
+        svc = ckpt_mod.read_meta(path).get("service") or {}
+        for name in ("window_sim_s", "chunk"):
+            have = svc.get(name)
+            if have is not None and have != getattr(params, name):
+                raise ValueError(
+                    f"resume cadence mismatch: checkpoint ran with "
+                    f"{name}={have} but params say {getattr(params, name)}"
+                    " — window targets would diverge from the"
+                    " uninterrupted run")
+        return cls(runner, state, params, config=config,
+                   windows_done=int(svc.get("windows_done", 0)),
+                   start_sim_t=svc.get("start_sim_t"), **kw)
+
+    def stop(self):
+        """Request a graceful stop after the current window drains."""
+        self._stop = True
+
+    # ---------------------------------------------------- the loop -----
+    def run(self, n_windows: int | None = None):
+        """Serve windows until a limit hits: ``n_windows`` more from
+        here, the absolute ``params.max_windows``, the per-call
+        ``params.max_wall_s`` wall budget, or :meth:`stop`.  Returns
+        ``(state, windows_done)``; always drains the trailing in-flight
+        window before returning."""
+        p = self.p
+        limit = None
+        if n_windows is not None:
+            limit = self.windows_done + n_windows
+        elif p.max_windows:
+            limit = p.max_windows
+        self._t0 = self.now()
+        self._stop = False
+        rt0 = time.monotonic()
+        # realtime pacing origin: sim offset of this run()'s first window
+        self._rt_sim0 = self.start_sim_t + self._launched * p.window_sim_s
+        while not self._stop:
+            if limit is not None and self._launched >= limit:
+                break
+            if p.max_wall_s and self.now() - self._t0 >= p.max_wall_s:
+                break
+            self._step_window(rt0)
+        if self._pending is not None:
+            rec, self._pending = self._pending, None
+            self._drain(rec)
+        return self.state, self.windows_done
+
+    def _step_window(self, rt0):
+        p = self.p
+        k = self._launched
+        target = self.start_sim_t + (k + 1) * p.window_sim_s
+        if self.ingest is not None:
+            # serving windows track the ACTUAL clock: event-driven ticks
+            # and whole-chunk dispatch can overshoot the grid by many
+            # windows, and a grid target below t_now would run ZERO
+            # ticks — leaving just-injected requests undelivered.  The
+            # ingest tier already syncs per window, so the extra t_now
+            # read costs nothing; the fixed grid (and with it the
+            # resume bit-identity pin) is the no-ingest tiers' contract.
+            cur = _min_sim_t(self.fetch(self.state.t_now))
+            target = max(target, cur + p.window_sim_s)
+        if p.realtime:
+            # simulated time must not run ahead of wall clock
+            # (realtimescheduler.cc pacing, at window granularity): the
+            # window about to be served ends at sim offset target-_rt_sim0
+            ahead = (target - self._rt_sim0
+                     - (time.monotonic() - rt0))
+            if ahead > 0:
+                time.sleep(ahead)
+        if self.ingest is not None:
+            # batched request injection at the boundary — one pool
+            # write, delivered at the start of the window about to run
+            s = self.ingest.before_window(self.state,
+                                          int(target * NS))
+            if s is not None:
+                self.state = s
+        t_d0 = self.now()
+        self.state = self.runner.run_until_device(self.state, target,
+                                                  chunk=p.chunk)
+        t_d1 = self.now()
+        self._launched = k + 1
+        # device copies enqueued behind the dispatch, ahead of the NEXT
+        # dispatch's donation — snapshot without a host sync
+        snap = self.copy(counter_leaf_refs(self.state))
+        ckpt = None
+        if (p.checkpoint_every and p.checkpoint_path
+                and (k + 1) % p.checkpoint_every == 0):
+            ckpt = self.copy(self.state)
+        rec = _Pending(window=k, target_sim_t=target, t_d0=t_d0,
+                       t_d1=t_d1, snap=snap, ckpt=ckpt)
+        if p.double_buffer and self.ingest is None:
+            prev, self._pending = self._pending, rec
+            if prev is not None:
+                self._drain(prev)     # fetch k-1 AFTER dispatching k
+        else:
+            self._drain(rec)
+            if self.ingest is not None:
+                s = self.ingest.after_window(self.state)
+                if s is not None:
+                    self.state = s
+
+    def _drain(self, rec: _Pending):
+        """Window k's host side: the ONE sync (fetch of the snapshot
+        copies), trace spans, the non-critical-path checkpoint write,
+        and the report callback."""
+        t_f0 = self.now()
+        leaves = self.fetch(rec.snap)
+        t_f1 = self.now()
+        if self.trace is not None:
+            self.trace.span("window_dispatch", rec.t_d0,
+                            rec.t_d1 - rec.t_d0,
+                            args={"window": rec.window,
+                                  "target_sim_t": rec.target_sim_t})
+            self.trace.span("window_fetch", t_f0, t_f1 - t_f0,
+                            args={"window": rec.window})
+        summary = self.summarize(leaves)
+        self.windows_done = rec.window + 1
+        if rec.ckpt is not None:
+            t_c0 = self.now()
+            self._write_checkpoint(rec.ckpt)
+            if self.trace is not None:
+                self.trace.span("checkpoint_write", t_c0,
+                                self.now() - t_c0,
+                                args={"windows_done": self.windows_done})
+        if self.on_window is not None:
+            self.on_window(rec.window, summary, self.now() - self._t0)
+
+    def _write_checkpoint(self, snapshot):
+        from oversim_tpu import checkpoint as ckpt_mod
+        p = self.p
+        meta = dict(self.checkpoint_meta)
+        if self.config_hash is not None:
+            meta.setdefault("config_hash", self.config_hash)
+        meta["service"] = {
+            "windows_done": self.windows_done,
+            "start_sim_t": self.start_sim_t,
+            "window_sim_s": p.window_sim_s,
+            "chunk": p.chunk,
+            "checkpoint_every": p.checkpoint_every,
+        }
+        ckpt_mod.save(p.checkpoint_path, snapshot, meta=meta)
+        self.checkpoints_written += 1
+        self.last_checkpoint = self.windows_done

@@ -26,4 +26,18 @@ for f in tests/test_*.py; do
     echo "FAIL  $name  $(tail -1 "$STATE/$name.log")"
   fi
 done
+# service-plane kill-and-resume smoke (scripts/service_smoke.py): a
+# real SIGKILL mid-run, resume from the atomic checkpoint, final state
+# bit-identical — same marker/timeout discipline as the test modules
+smoke_marker="$STATE/service_smoke.ok"
+if [ -f "$smoke_marker" ]; then
+  echo "skip  service_smoke (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/service_smoke.py > "$STATE/service_smoke.log" 2>&1; then
+  touch "$smoke_marker"
+  echo "PASS  service_smoke  $(tail -1 "$STATE/service_smoke.log")"
+else
+  status=1
+  echo "FAIL  service_smoke  $(tail -1 "$STATE/service_smoke.log")"
+fi
 exit $status

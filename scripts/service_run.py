@@ -1,0 +1,230 @@
+"""Service CLI: run the resident double-buffered serving loop.
+
+Turns a scenario into a long-running service (oversim_tpu/service/):
+windows are dispatched device-resident with the NEXT window enqueued
+before the previous window's fetch (the device never idles), the full
+state is checkpointed atomically every C windows (kill-safe: SIGKILL at
+any instant leaves a complete checkpoint), and ``--resume`` continues a
+killed run bit-identically from the last checkpoint.
+
+Usage:
+  python scripts/service_run.py --ini simulations/my.ini [--config X]
+      Build from the ini; ``**.service.*`` keys (windowSimS, chunk,
+      checkpointEvery, checkpointPath, maxWindows, maxWallS,
+      doubleBuffer, realtime) select the loop parameters
+      (config/scenario.py build_service).
+  python scripts/service_run.py --windows 100 [--n 256] [--overlay
+      kademlia|chord] [--seed 1] [--churn lifetime --lifetime 1000]
+      Flag-built KBRTestApp scenario (bench.py shape).
+
+Common:  [--window-sim-s 1.0] [--chunk 32] [--checkpoint ck.npz]
+         [--checkpoint-every 10] [--resume] [--replicas S]
+         [--platform cpu|axon] [--out artifact.json] [--trace t.json]
+         [--telemetry K] [--telemetry-window W] [--single-buffer]
+
+``--replicas S`` serves the stacked campaign state (S replicas as one
+vmapped program, cross-replica summaries per window); checkpoints then
+snapshot the whole [S]-stacked state, and resume restores every
+replica.
+
+The artifact (bench.py ArtifactWriter) is written incrementally with
+atomic tmp+rename — one record per window plus a run manifest
+(oversim_tpu/telemetry.py run_manifest) — so a deadline SIGKILL leaves
+a valid partial artifact next to a resumable checkpoint.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def _setup_jax(platform):
+    if platform and platform not in ("axon", "default"):
+        os.environ["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_backend_optimization_level" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_backend_optimization_level=0"
+                    " --xla_llvm_disable_expensive_passes=true").strip()
+    sys.modules["zstandard"] = None
+    import jax
+
+    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
+    from jax._src import compilation_cache as _cc
+    for attr in ("zstandard", "zstd"):
+        if getattr(_cc, attr, None) is not None:
+            setattr(_cc, attr, None)
+    jax.config.update("jax_enable_x64", True)
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_compilation_cache", False)
+    else:
+        jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
+def _build_sim(args):
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+    from oversim_tpu.common import lookup as lk_mod
+    from oversim_tpu.engine import sim as sim_mod
+
+    app = KbrTestApp(KbrTestParams(test_interval=args.interval))
+    if args.overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app,
+                              lcfg=lk_mod.LookupConfig(slots=8, merge=True))
+    cp = churn_mod.ChurnParams(model=args.churn, target_num=args.n,
+                               lifetime_mean=args.lifetime,
+                               init_interval=10.0 / args.n)
+    ep = sim_mod.EngineParams(
+        window=args.engine_window, inbox_slots=8, pool_factor=8,
+        telemetry=telemetry_mod.TelemetryParams(
+            sample_ticks=args.telemetry,
+            window=args.telemetry_window))
+    return sim_mod.Simulation(logic, cp, engine_params=ep)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ini", default=None, help="build from ini "
+                    "(**.service.* keys) instead of flags")
+    ap.add_argument("--config", default="General")
+    ap.add_argument("--windows", type=int, default=10, metavar="W",
+                    help="windows to serve this invocation")
+    ap.add_argument("--window-sim-s", type=float, default=1.0)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--checkpoint", default=None, metavar="PATH",
+                    help="checkpoint file (atomic tmp+rename npz)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    metavar="C", help="windows between checkpoints")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the checkpoint and continue "
+                    "bit-identically")
+    ap.add_argument("--single-buffer", action="store_true",
+                    help="disable the dispatch/fetch pipeline")
+    ap.add_argument("--replicas", type=int, default=0, metavar="S",
+                    help="serve the S-replica stacked campaign state")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--overlay", default="kademlia",
+                    choices=["kademlia", "chord"])
+    ap.add_argument("--churn", default="none")
+    ap.add_argument("--lifetime", type=float, default=10_000.0)
+    ap.add_argument("--interval", type=float, default=0.2)
+    ap.add_argument("--engine-window", type=float, default=0.2)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--out", default=None, help="incremental atomic "
+                    "artifact path")
+    ap.add_argument("--telemetry", type=int, default=0, metavar="K")
+    ap.add_argument("--telemetry-window", type=int, default=256)
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="Perfetto trace: window_dispatch/window_fetch/"
+                    "checkpoint_write spans (overlap = pipelining)")
+    args = ap.parse_args()
+
+    _setup_jax(args.platform)
+    from bench import ArtifactWriter
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.service import (ServiceLoop, ServiceParams,
+                                     campaign_summarize_leaves)
+
+    # the scenario-defining config (hashed into checkpoints; resume
+    # refuses a checkpoint whose hash differs) — run-shape flags like
+    # --windows/--out/--resume deliberately excluded
+    config = {"ini": args.ini, "config": args.config,
+              "overlay": args.overlay, "n": args.n, "seed": args.seed,
+              "churn": args.churn, "lifetime": args.lifetime,
+              "interval": args.interval,
+              "engine_window": args.engine_window,
+              "replicas": args.replicas,
+              "telemetry": {"sampleTicks": args.telemetry,
+                            "window": args.telemetry_window}}
+
+    if args.ini:
+        from oversim_tpu.config.ini import IniFile
+        from oversim_tpu.config.scenario import (build_service,
+                                                 build_simulation)
+        ini = IniFile.load(args.ini)
+        sim = build_simulation(ini, args.config)
+        params = build_service(ini, args.config)
+    else:
+        sim = _build_sim(args)
+        params = ServiceParams(
+            window_sim_s=args.window_sim_s, chunk=args.chunk,
+            checkpoint_every=args.checkpoint_every,
+            checkpoint_path=args.checkpoint,
+            double_buffer=not args.single_buffer)
+
+    summarize = None
+    if args.replicas:
+        from oversim_tpu.campaign import Campaign, CampaignParams
+        runner = Campaign(sim, CampaignParams(replicas=args.replicas,
+                                              base_seed=args.seed))
+        summarize = campaign_summarize_leaves
+    else:
+        runner = sim
+
+    artifact = ArtifactWriter(args.out)
+    trace = (telemetry_mod.PerfettoTrace("service_run")
+             if args.trace else None)
+
+    t0 = time.perf_counter()
+    example = (runner.init() if args.replicas
+               else runner.init(seed=args.seed))
+    init_rec = {"phase": "init", "resume": bool(args.resume),
+                "replicas": args.replicas,
+                "init_wall_s": round(time.perf_counter() - t0, 2)}
+    print(json.dumps(init_rec), flush=True)
+    artifact.add(init_rec)
+
+    manifest = telemetry_mod.run_manifest(
+        config=config,
+        artifacts={"artifact": args.out, "trace": args.trace,
+                   "checkpoint": params.checkpoint_path})
+    artifact.set_manifest(manifest)
+
+    def on_window(window, summary, wall):
+        rec = {"window": window, "wall_s": round(wall, 3), **summary}
+        print(json.dumps(rec), flush=True)
+        artifact.add(rec)
+        if trace is not None:
+            trace.write(args.trace)  # atomic: valid trace after every window
+
+    kw = dict(config=config, on_window=on_window, trace=trace,
+              summarize=summarize)
+    if args.resume:
+        loop = ServiceLoop.resume(runner, example, params, **kw)
+        print(json.dumps({"phase": "resume",
+                          "windows_done": loop.windows_done,
+                          "start_sim_t": loop.start_sim_t}), flush=True)
+    else:
+        loop = ServiceLoop(runner, example, params, **kw)
+
+    state, done = loop.run(n_windows=args.windows)
+    final = {"phase": "final", "windows_done": done,
+             "checkpoints_written": loop.checkpoints_written,
+             "last_checkpoint": loop.last_checkpoint,
+             "wall_s": round(time.perf_counter() - t0, 2)}
+    artifact.add(final)
+    if trace is not None:
+        trace.write(args.trace)
+    artifact.finish()
+    print(json.dumps(final), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

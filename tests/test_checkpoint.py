@@ -1,4 +1,11 @@
-"""Checkpoint/resume: restored runs continue bit-identically."""
+"""Checkpoint/resume: restored runs continue bit-identically.
+
+The format-level tests (v2 manifest, atomic write, v1 back-compat,
+scenario-hash refusal) run on tiny dict pytrees — cheap, no sim
+compile; the end-to-end resume pin compiles one small chord sim."""
+
+import json
+import os
 
 import numpy as np
 import pytest
@@ -36,6 +43,79 @@ def test_roundtrip_and_exact_resume(tmp_path):
     assert len(la) == len(lb)
     for x, y in zip(la, lb):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_v2_meta_and_atomic_write(tmp_path):
+    """v2 checkpoints embed a JSON manifest (format, git rev, caller
+    extras) and the write is tmp+rename atomic: no torn .tmp survives,
+    and an existing checkpoint is only ever replaced whole."""
+    path = str(tmp_path / "ck.npz")
+    state = {"a": np.arange(4, dtype=np.int64),
+             "b": np.ones((2, 2), np.float32)}
+    ckpt.save(path, state, meta={"config_hash": "abc123",
+                                 "note": "hello"})
+    assert not os.path.exists(path + ".tmp"), "tmp file must not remain"
+
+    meta = ckpt.read_meta(path)
+    assert meta["format"] == ckpt.FORMAT
+    assert meta["config_hash"] == "abc123"
+    assert meta["note"] == "hello"
+    assert "git_rev" in meta
+
+    example = {"a": np.zeros(4, np.int64), "b": np.zeros((2, 2),
+                                                         np.float32)}
+    out = ckpt.load(path, example, expect_config="abc123")
+    np.testing.assert_array_equal(np.asarray(out["a"]), state["a"])
+
+    # same arrays, different recorded scenario -> refused
+    with pytest.raises(ValueError, match="scenario mismatch"):
+        ckpt.load(path, example, expect_config="zzz999")
+
+
+def test_v1_checkpoint_still_loads(tmp_path):
+    """A hand-written v1 file (no __meta__) must load, report its
+    format from read_meta, and pass expect_config (v1 has no hash)."""
+    import jax
+    path = str(tmp_path / "v1.npz")
+    state = {"x": np.arange(3, dtype=np.int32),
+             "y": np.full((2,), 7.0, np.float64)}
+    leaves = jax.tree.leaves(state)
+    with open(path, "wb") as f:
+        np.savez_compressed(
+            f, __format__=np.asarray(ckpt.FORMAT_V1),
+            __fingerprint__=np.asarray(ckpt._fingerprint(
+                [np.asarray(x) for x in leaves])),
+            **{f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+    assert ckpt.read_meta(path) == {"format": ckpt.FORMAT_V1}
+    out = ckpt.load(path, jax.tree.map(np.zeros_like, state),
+                    expect_config="whatever")
+    np.testing.assert_array_equal(np.asarray(out["y"]), state["y"])
+
+
+def test_meta_auto_fills_tick_and_service_extras(tmp_path):
+    """tick/t_now are read off states that carry them; caller extras
+    (the service loop's window bookkeeping) round-trip via JSON."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.tree_util.register_dataclass
+    @dataclasses.dataclass
+    class S:
+        tick: jnp.ndarray
+        t_now: jnp.ndarray
+
+    path = str(tmp_path / "s.npz")
+    svc = {"windows_done": 3, "start_sim_t": 0.0,
+           "window_sim_s": 0.5, "chunk": 8, "checkpoint_every": 1}
+    ckpt.save(path, S(tick=jnp.int64(42), t_now=jnp.int64(9 * 10**9)),
+              meta={"service": svc})
+    meta = ckpt.read_meta(path)
+    assert meta["tick"] == 42
+    assert meta["t_now"] == 9 * 10**9
+    assert meta["service"] == json.loads(json.dumps(svc))
 
 
 def test_structure_mismatch_rejected(tmp_path):

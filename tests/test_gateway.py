@@ -16,7 +16,9 @@ from oversim_tpu import churn as churn_mod
 from oversim_tpu.apps.dht import DhtApp, DhtParams
 from oversim_tpu.apps.realworld import RealworldEchoApp, TcpEchoApp
 from oversim_tpu.engine import sim as sim_mod
-from oversim_tpu.gateway import EXT_IN, RealtimeGateway, _HDR
+from oversim_tpu.gateway import (EXT_IN, EXT_OUT, ExtFrame,
+                                 GenericPacketParser, RealtimeGateway,
+                                 _HDR, inject_ext_batch)
 from oversim_tpu.overlay.chord import ChordLogic
 from oversim_tpu.overlay.myoverlay import MyOverlayLogic, MyOverlayParams
 from oversim_tpu.xmlrpcif import XmlRpcInterface, serve
@@ -239,6 +241,133 @@ def test_pluggable_packet_parser():
                 continue
         assert data is not None, "no ascii echo from the gateway"
         assert data == b"6:911", data   # 900 + transform 11
+    finally:
+        client.close()
+        gw.close()
+
+
+# ---------------------------------------------------------------------------
+# RX hardening + batching: sockets only, no simulation needed (the
+# gateway's poll/flush half runs against a bare state) — keep these
+# CHEAP, they sort before the tier-1 timeout cut
+# ---------------------------------------------------------------------------
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu.engine import pool as pool_mod
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class _PoolOnlyState:
+    pool: pool_mod.MsgPool
+    t_now: jnp.ndarray
+
+
+def _pool_state(p=16):
+    return _PoolOnlyState(pool=pool_mod.empty(p, key_lanes=2, rmax=2),
+                          t_now=jnp.int64(1000))
+
+
+def _poll_until(gw, cond, timeout_s=3.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        gw._poll_udp()
+        gw._poll_tcp()
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_udp_garbage_datagram_dropped_not_fatal():
+    """A malformed datagram from the real network must be dropped and
+    COUNTED — never unwind the poll loop; a good frame right after it
+    still gets through."""
+    gw = RealtimeGateway(None, None)   # sockets only
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        client.sendto(b"\x01", ("127.0.0.1", gw.udp_port))  # < header
+        assert _poll_until(gw, lambda: gw.rx_dropped == 1)
+        assert gw._rx == []
+
+        client.sendto(_HDR.pack(EXT_IN, 0, 5, 500),
+                      ("127.0.0.1", gw.udp_port))
+        assert _poll_until(gw, lambda: len(gw._rx) == 1)
+        assert gw.rx_dropped == 1
+        assert (gw._rx[0].b, gw._rx[0].c) == (5, 500)
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_udp_raising_parser_counted_not_raised():
+    """A parser that CRASHES on hostile bytes (the plausible bug in a
+    custom GenericPacketParser) is contained: counted as dropped, one
+    warning, the gateway keeps polling."""
+
+    class BoomParser(GenericPacketParser):
+        def decapsulate(self, data):
+            raise RuntimeError("boom")
+
+    gw = RealtimeGateway(None, None, parser=BoomParser())
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for _ in range(2):
+            client.sendto(b"hostile bytes", ("127.0.0.1", gw.udp_port))
+        assert _poll_until(gw, lambda: gw.rx_dropped == 2)
+        assert gw._rx == []
+        gw._poll_udp()                 # still alive after the crashes
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_tcp_desynced_stream_drops_connection():
+    """Garbage where the 4-byte length prefix should be desyncs the
+    stream forever: the connection is dropped (and counted), the
+    gateway survives."""
+    gw = RealtimeGateway(None, None, tcp_port=0)
+    client = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        client.connect(("127.0.0.1", gw.tcp_port))
+        client.sendall(b"\xff\xff\xff\xffgarbage")   # prefix ~4 GiB
+        assert _poll_until(gw, lambda: gw.rx_dropped >= 1)
+        assert gw._tcp_conns == {}, "desynced connection must be dropped"
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_rx_batching_one_pool_write(tmp_path):
+    """Accumulated datagrams enter the pool as ONE batched alloc on
+    flush_rx (rx_batches counts pool writes, rx_frames counts frames),
+    in arrival order, with zero overflow on an empty pool."""
+    gw = RealtimeGateway(None, _pool_state())
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for i in range(3):
+            client.sendto(_HDR.pack(EXT_IN, 0, i, 100 + i),
+                          ("127.0.0.1", gw.udp_port))
+        assert _poll_until(gw, lambda: len(gw._rx) == 3)
+        assert gw.rx_batches == 0      # nothing flushed yet
+
+        gw.flush_rx()
+        assert gw.rx_batches == 1 and gw.rx_frames == 3
+        assert gw.rx_overflow() == 0
+        pool = gw.state.pool
+        valid = np.asarray(pool.valid)
+        assert valid.sum() == 3
+        got = sorted(zip(np.asarray(pool.a)[valid],
+                         np.asarray(pool.b)[valid],
+                         np.asarray(pool.c)[valid]))
+        assert [(b, c) for _, b, c in got] == [(0, 100), (1, 101),
+                                               (2, 102)]
+        assert set(np.asarray(pool.kind)[valid]) == {EXT_IN}
     finally:
         client.close()
         gw.close()
