@@ -1,0 +1,376 @@
+"""The declarative graph-contract registry.
+
+Every compiled entry point of the simulator — the solo tick, the fused
+``run_chunk``, the device-resident ``run_until_device`` while-loop, the
+vmapped replica-sharded campaign tick, the telemetry-enabled tick, and
+the service window — registers ONE :class:`EntryPoint` here: how to
+build it (:class:`EntryContext` → jitted fn + fresh-args factory) and
+what its compiled graph is allowed to look like (:class:`GraphContract`)
+— op budgets, collective allowlist, host-transfer pin, donation
+requirement, dtype allowlist, plus the trace-time limits (recompiles /
+implicit host syncs) enforced by trace_pass.py.
+
+``scripts/analyze.py --all`` walks the registry; a new subsystem makes
+its graph a checked contract by calling :func:`register_entry` (or
+adding to :data:`DEFAULT_ENTRIES`) instead of hand-extending a script.
+
+The budgets consolidate what used to be three ad-hoc
+``scripts/hlo_breakdown.py`` modes: ``--budget`` → ``solo_tick``,
+``--campaign`` → ``campaign_tick``, ``--telemetry`` → the
+``telemetry_tick`` delta contract (hlo_breakdown's modes are now shims
+over this registry).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+# result dtypes a compiled entry may contain.  x64 is globally enabled:
+# time/keys/accumulators are s64/f64, rng bits u32, masks pred.  Reduced
+# precision (bf16/f16/f8*) anywhere in the tick means an accumulator
+# silently lost precision — disallowed until a PR introduces it
+# deliberately (with its own contract revision).
+DEFAULT_DTYPES = frozenset({
+    "pred", "token",
+    "s8", "s16", "s32", "s64",
+    "u8", "u16", "u32", "u64",
+    "f32", "f64",
+})
+
+# measured at -O0/inbox=8: kademlia 151 / chord 123 scatters per tick
+# (mostly small per-node logic scatters; engine share 8 + 2*inbox) — 200
+# catches gross regressions while zero-full-pool-sorts stays the sharp pin
+DEFAULT_MAX_SCATTERS = 200
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphContract:
+    """What one compiled entry point's optimized HLO may contain."""
+
+    max_full_pool_sorts: int = 0
+    max_sorts: int | None = None          # total sorts; None = unpinned
+    max_scatters: int = DEFAULT_MAX_SCATTERS
+    # collective census tokens allowed in the graph ("all-gather",
+    # "all-reduce:min", ...).  Enforced only when collectives_enforced —
+    # node-sharded single-replica steps legitimately carry collectives
+    # whose census is mesh-dependent.
+    allowed_collectives: frozenset = frozenset()
+    collectives_enforced: bool = True
+    max_host_transfers: int = 0
+    # donation: the optimized module header must carry input→output
+    # buffer aliases (may-/must-alias) — dropped donation round-trips
+    # the full state through fresh allocations every dispatch
+    require_donation: bool = False
+    min_donated_leaves: int = 1
+    dtype_allowlist: frozenset = DEFAULT_DTYPES
+    # trace-time limits (trace_pass.py): the second same-shape call may
+    # not recompile, and no tracer/array may be host-synced
+    # (__bool__/__index__/__int__/__float__/__array__/device_get)
+    # inside the harnessed calls
+    max_recompiles: int = 0
+    max_host_syncs: int = 0
+    max_device_gets: int = 0
+    check_leaks: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaContract:
+    """A contract on the DIFF between two entries' op counts.
+
+    ``telemetry_tick`` pins its cost relative to ``solo_tick``: zero
+    full-pool sorts, no new sorts anywhere, a bounded scatter delta (one
+    gated ``mode="drop"`` scatter per ring buffer), zero new
+    collectives (replicated [W] rings must not create traffic)."""
+
+    base: str                           # name of the baseline entry
+    max_full_pool_sorts: int = 0
+    max_sort_delta: int = 0
+    max_scatter_delta: int = 64
+    max_collective_delta: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryContext:
+    """Build-time knobs shared by every entry (mirrors the historical
+    hlo_breakdown CLI positionals).  ``fast`` shrinks sizes for the
+    tier-1 gate; op counts are size-independent, so the contracts hold
+    at any n."""
+
+    n: int = 256
+    overlay: str = "kademlia"
+    window: float = 0.2
+    inbox: int = 8
+    pool_factor: int = 4
+    replicas: int = 4
+    tel_ticks: int = 4
+    chunk: int = 4
+    fast: bool = False
+
+    @classmethod
+    def make(cls, *, fast: bool = False, **kw):
+        if fast:
+            kw.setdefault("n", 64)
+            kw.setdefault("replicas", 2)
+        return cls(fast=fast, **kw)
+
+
+@dataclasses.dataclass
+class EntryBuild:
+    """What :attr:`EntryPoint.build` returns: a jitted callable plus a
+    fresh-argument factory (donated entries consume their state — every
+    call needs fresh buffers), and the pool dimension for full-pool-sort
+    classification."""
+
+    fn: Callable                        # jit wrapper (.lower works)
+    make_args: Callable[[], tuple]      # fresh args per call
+    pool_dim: int
+    info: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    name: str
+    doc: str
+    contract: GraphContract
+    build: Callable[[EntryContext], EntryBuild]
+    delta: DeltaContract | None = None
+
+
+# ---------------------------------------------------------------------------
+# builders (import jax lazily — the registry itself stays import-safe)
+# ---------------------------------------------------------------------------
+
+def build_sim(ctx: EntryContext, *, inbox_impl: str = "scatter",
+              telemetry_ticks: int = 0, ext_hold_slot: int = -1):
+    """The bench-shaped Simulation every entry compiles (KbrTestApp over
+    chord/kademlia, churn off — the same construction the historical
+    hlo_breakdown modes used)."""
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.apps import kbrtest
+    from oversim_tpu.apps.kbrtest import KbrTestApp
+    from oversim_tpu.common import lookup as lk_mod
+    from oversim_tpu.engine import sim as sim_mod
+
+    app = KbrTestApp(kbrtest.KbrTestParams(test_interval=0.2))
+    if ctx.overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app,
+                              lcfg=lk_mod.LookupConfig(slots=8, merge=True))
+    cp = churn_mod.ChurnParams(model="none", target_num=ctx.n,
+                               init_interval=20.0 / ctx.n,
+                               init_deviation=2.0 / ctx.n)
+    ep = sim_mod.EngineParams(
+        window=ctx.window, inbox_slots=ctx.inbox,
+        pool_factor=ctx.pool_factor, inbox_impl=inbox_impl,
+        ext_hold_slot=ext_hold_slot,
+        telemetry=telemetry_mod.TelemetryParams(
+            sample_ticks=telemetry_ticks))
+    return sim_mod.Simulation(logic, cp, engine_params=ep)
+
+
+def _build_solo_tick(ctx):
+    import jax
+    sim = build_sim(ctx)
+    fn = jax.jit(sim.step)
+    s0 = sim.init(seed=7)
+    return EntryBuild(fn=fn, make_args=lambda: (s0,),
+                      pool_dim=sim.ep.pool_factor * ctx.n,
+                      info={"n": ctx.n, "overlay": ctx.overlay})
+
+
+def _build_solo_chunk(ctx):
+    sim = build_sim(ctx)
+    # run_chunk donates s: every call needs freshly initialized buffers.
+    # `self` is a static argname — reuse ONE sim instance or the cache
+    # keys differ and the recompile pin trips on its own harness.  Use
+    # the UNBOUND class-level jit (type(sim).run_chunk) so __call__ and
+    # .lower see the same explicit-self signature.
+    return EntryBuild(
+        fn=type(sim).run_chunk,
+        make_args=lambda: (sim, sim.init(seed=7), ctx.chunk),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay, "n_ticks": ctx.chunk})
+
+
+def _build_run_until_device(ctx):
+    import jax.numpy as jnp
+    from oversim_tpu.engine.sim import NS
+    sim = build_sim(ctx)
+    target = jnp.int64(int(2 * ctx.window * NS))
+    return EntryBuild(
+        fn=type(sim)._run_until_device,
+        make_args=lambda: (sim, sim.init(seed=7), target, ctx.chunk),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay, "chunk": ctx.chunk})
+
+
+def _campaign_step(ctx, sim):
+    """(jitted sharded _vstep, fresh-stacked-state factory, n_dev)."""
+    import jax
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.parallel import mesh as mesh_mod
+
+    camp = Campaign(sim, CampaignParams(replicas=ctx.replicas, base_seed=7))
+    cs0 = camp.init()
+    avail = len(jax.devices())
+    n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                if camp.s % d == 0)
+    mesh = mesh_mod.make_replica_mesh(n_dev)
+    sh = mesh_mod.campaign_state_shardings(cs0, mesh)
+    step = jax.jit(camp._vstep, in_shardings=(sh,), out_shardings=sh)
+    return step, (lambda: (cs0,)), n_dev
+
+
+def _build_campaign_tick(ctx):
+    sim = build_sim(ctx)
+    step, make_args, n_dev = _campaign_step(ctx, sim)
+    return EntryBuild(
+        fn=step, make_args=make_args,
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay,
+              "replicas": ctx.replicas, "devices": n_dev})
+
+
+def _build_telemetry_tick(ctx):
+    import jax
+    sim = build_sim(ctx, telemetry_ticks=ctx.tel_ticks)
+    fn = jax.jit(sim.step)
+    s0 = sim.init(seed=7)
+    return EntryBuild(fn=fn, make_args=lambda: (s0,),
+                      pool_dim=sim.ep.pool_factor * ctx.n,
+                      info={"n": ctx.n, "overlay": ctx.overlay,
+                            "sample_ticks": ctx.tel_ticks})
+
+
+def _build_service_window(ctx):
+    import jax.numpy as jnp
+    from oversim_tpu.engine.sim import NS
+    # the serving loop's dispatch unit: run_until_device with the
+    # EXT_OUT hold slot armed (gateway responses parked until the
+    # window-boundary drain, oversim_tpu/service/loop.py)
+    sim = build_sim(ctx, ext_hold_slot=0)
+    target = jnp.int64(int(2 * ctx.window * NS))
+    return EntryBuild(
+        fn=type(sim)._run_until_device,
+        make_args=lambda: (sim, sim.init(seed=7), target, ctx.chunk),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay, "ext_hold_slot": 0})
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+_TICK = GraphContract()
+_DONATED = GraphContract(require_donation=True)
+
+DEFAULT_ENTRIES = (
+    EntryPoint(
+        name="solo_tick",
+        doc="jit(sim.step): one engine tick, telemetry off",
+        contract=_TICK,
+        build=_build_solo_tick),
+    EntryPoint(
+        name="solo_chunk",
+        doc="sim.run_chunk: fused n-tick scan, donated state",
+        contract=_DONATED,
+        build=_build_solo_chunk),
+    EntryPoint(
+        name="run_until_device",
+        doc="sim._run_until_device: while-loop run-to-time, donated",
+        contract=_DONATED,
+        build=_build_run_until_device),
+    EntryPoint(
+        name="campaign_tick",
+        doc="vmapped replica-sharded campaign tick: ZERO cross-replica "
+            "collectives (pure data parallelism)",
+        contract=GraphContract(),       # allowed_collectives stays empty
+        build=_build_campaign_tick),
+    EntryPoint(
+        name="telemetry_tick",
+        doc="jit(sim.step) with telemetry rings: delta vs solo_tick "
+            "bounded (one drop-scatter per ring, no sorts, no "
+            "collectives)",
+        contract=GraphContract(max_scatters=DEFAULT_MAX_SCATTERS + 64),
+        build=_build_telemetry_tick,
+        delta=DeltaContract(base="solo_tick")),
+    EntryPoint(
+        name="service_window",
+        doc="service window: run_until_device with EXT_OUT hold armed",
+        contract=_DONATED,
+        build=_build_service_window),
+)
+
+REGISTRY: dict = {e.name: e for e in DEFAULT_ENTRIES}
+
+
+def register_entry(entry: EntryPoint, *, replace: bool = False) -> None:
+    """How a future subsystem joins the gate (see README 'Analysis
+    plane').  Entries run in registration order; a DeltaContract's base
+    must be registered first."""
+    if entry.name in REGISTRY and not replace:
+        raise ValueError(f"entry {entry.name!r} already registered")
+    if entry.delta is not None and entry.delta.base not in REGISTRY:
+        raise ValueError(f"delta base {entry.delta.base!r} not registered")
+    REGISTRY[entry.name] = entry
+
+
+def entries(names=None) -> list:
+    """Resolve ``--entries`` selections (None = everything, in order)."""
+    if names is None:
+        return list(REGISTRY.values())
+    missing = [n for n in names if n not in REGISTRY]
+    if missing:
+        raise KeyError(f"unknown entries: {', '.join(missing)} "
+                       f"(known: {', '.join(REGISTRY)})")
+    return [REGISTRY[n] for n in names]
+
+
+# ---------------------------------------------------------------------------
+# scenario pins (config-level contracts — no compilation needed)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_INI = """
+[General]
+**.overlayType = "oversim.overlay.kademlia.KademliaModules"
+**.targetOverlayTerminalNum = 16
+"""
+
+
+def scenario_pins() -> list:
+    """Config-level contract: the DEFAULT scenario resolution must never
+    pick ``inbox_impl="sort"`` — the legacy sort path is oracle-only
+    (ROADMAP item 6); only an explicit ``**.inboxImpl = "sort"`` key may
+    select it.  Returns Finding rows (empty = pinned)."""
+    from oversim_tpu.analysis.findings import Finding
+    from oversim_tpu.config import scenario
+    from oversim_tpu.config.ini import IniFile
+
+    out = []
+    ini = IniFile.loads(_DEFAULT_INI)
+    sim = scenario.build_simulation(ini, "General")
+    if sim.ep.inbox_impl != "scatter":
+        out.append(Finding(
+            pass_name="hlo", rule="default-inbox-impl",
+            where="config/scenario.py",
+            message="default scenario resolved inbox_impl="
+                    f"{sim.ep.inbox_impl!r} — the sort path is "
+                    "oracle-only and must require an explicit "
+                    "**.inboxImpl key",
+            measured=sim.ep.inbox_impl, limit="scatter"))
+    sort_ini = IniFile.loads(_DEFAULT_INI
+                             + '\n**.inboxImpl = "sort"\n')
+    sim_sort = scenario.build_simulation(sort_ini, "General")
+    if sim_sort.ep.inbox_impl != "sort":
+        out.append(Finding(
+            pass_name="hlo", rule="inbox-impl-override",
+            where="config/scenario.py",
+            message="explicit **.inboxImpl = \"sort\" was not honored "
+                    "— the oracle path became unreachable",
+            measured=sim_sort.ep.inbox_impl, limit="sort"))
+    return out

@@ -1,0 +1,76 @@
+"""Finding records + the machine-readable verdict document.
+
+Every analysis pass (hlo / trace / ast) reduces to a list of
+:class:`Finding` rows; ``scripts/analyze.py`` serializes them as ONE
+JSON document on stdout (and optionally ``--json PATH``) and exits
+non-zero when any finding is an error.  The document's compact
+``verdict`` form feeds ``run_manifest``'s ``hlo_budget`` field
+(oversim_tpu/telemetry.py ``analysis_verdict``) so every bench/campaign/
+service artifact records which contract revision its graph passed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+@dataclasses.dataclass
+class Finding:
+    """One contract breach (or informational note) from a pass."""
+
+    pass_name: str          # "hlo" | "trace" | "ast"
+    rule: str               # e.g. "full-pool-sorts", "host-item"
+    where: str              # entry-point name or "path/file.py:LINE"
+    message: str
+    measured: object = None     # what the pass saw
+    limit: object = None        # what the contract allows
+    severity: str = "error"     # "error" fails the run; "info" does not
+
+    def to_dict(self) -> dict:
+        d = {"pass": self.pass_name, "rule": self.rule,
+             "where": self.where, "message": self.message,
+             "severity": self.severity}
+        if self.measured is not None:
+            d["measured"] = self.measured
+        if self.limit is not None:
+            d["limit"] = self.limit
+        return d
+
+
+def errors(findings) -> list:
+    return [f for f in findings if f.severity == "error"]
+
+
+def document(findings, passes: dict, *, fast: bool) -> dict:
+    """The analyzer's single JSON output document."""
+    errs = errors(findings)
+    return {
+        "kind": "graph_contract_verdict",
+        "ok": not errs,
+        "fast": bool(fast),
+        "errors": len(errs),
+        "passes": passes,
+        "findings": [f.to_dict() for f in findings],
+    }
+
+
+def verdict_summary(doc: dict) -> dict:
+    """Compact form of :func:`document` for run_manifest embedding."""
+    hlo = doc.get("passes", {}).get("hlo") or {}
+    return {
+        "ok": doc.get("ok"),
+        "fast": doc.get("fast"),
+        "errors": doc.get("errors", 0),
+        "entries": sorted(hlo.get("entries", {})),
+        "passes": sorted(k for k, v in doc.get("passes", {}).items() if v),
+    }
+
+
+def write_document(doc: dict, path) -> None:
+    """Atomic write (tmp + replace), like every other artifact."""
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, str(path))

@@ -1,0 +1,175 @@
+"""HLO contract pass: lower + compile every registered entry point and
+diff its optimized module against the entry's :class:`GraphContract`.
+
+For each entry the pass records a census dict (op counts, collective
+census, host transfers, donated leaves, off-allowlist dtypes) in the
+verdict document's ``passes.hlo.entries`` — delta contracts
+(telemetry_tick vs solo_tick) diff against the base entry's recorded
+counts, so registration order matters (contracts.register_entry
+enforces base-first).
+"""
+
+from __future__ import annotations
+
+from oversim_tpu.analysis import hlo_text
+from oversim_tpu.analysis.findings import Finding
+
+
+def measure_entry(txt: str, pool_dim: int) -> dict:
+    """Every census the contracts can pin, from one optimized module."""
+    m = dict(hlo_text.hlo_op_counts(txt, pool_dim))
+    m["collectives"] = hlo_text.collective_census(txt)
+    m["host_transfers"] = hlo_text.host_transfer_count(txt)
+    m["donated_leaves"] = hlo_text.donated_leaf_count(txt)
+    m["dtypes"] = hlo_text.dtype_census(txt)
+    return m
+
+
+def check_contract(name: str, contract, m: dict) -> list:
+    """Diff one entry's measurements against its GraphContract."""
+    out = []
+
+    def breach(rule, message, measured, limit):
+        out.append(Finding(pass_name="hlo", rule=rule, where=name,
+                           message=message, measured=measured, limit=limit))
+
+    if m["full_pool_sort_count"] > contract.max_full_pool_sorts:
+        breach("full-pool-sorts",
+               "full-pool sorts appeared in the compiled graph — the "
+               "zero-sort tick regressed (engine/pool.py scatter-min "
+               "selection)",
+               m["full_pool_sort_count"], contract.max_full_pool_sorts)
+    if contract.max_sorts is not None and \
+            m["sort_count"] > contract.max_sorts:
+        breach("sorts", "total sort ops over budget",
+               m["sort_count"], contract.max_sorts)
+    if m["scatter_count"] > contract.max_scatters:
+        breach("scatters",
+               "scatter count (incl. XLA-CPU while-expanded scatters) "
+               "over budget",
+               m["scatter_count"], contract.max_scatters)
+    if contract.collectives_enforced:
+        bad = {k: v for k, v in m["collectives"].items()
+               if k not in contract.allowed_collectives}
+        if bad:
+            breach("collectives",
+                   "cross-device collectives outside the allowed set — "
+                   "for replica-sharded entries this means the "
+                   "partitioner found a cross-replica data dependency",
+                   bad, sorted(contract.allowed_collectives))
+    if m["host_transfers"] > contract.max_host_transfers:
+        breach("host-transfers",
+               "infeed/outfeed/send/recv/host-callback ops inside the "
+               "compiled module break the one-dispatch/one-fetch "
+               "contract",
+               m["host_transfers"], contract.max_host_transfers)
+    if contract.require_donation and \
+            m["donated_leaves"] < contract.min_donated_leaves:
+        breach("donation",
+               "input→output buffer aliasing missing from the optimized "
+               "module — donation was dropped; every dispatch "
+               "round-trips the state through fresh allocations",
+               m["donated_leaves"], f">= {contract.min_donated_leaves}")
+    bad_dtypes = {k: v for k, v in m["dtypes"].items()
+                  if k not in contract.dtype_allowlist}
+    if bad_dtypes:
+        breach("dtypes",
+               "instruction result dtypes outside the allowlist — an "
+               "x64 accumulator silently lost precision",
+               bad_dtypes, sorted(contract.dtype_allowlist))
+    return out
+
+
+def check_delta(name: str, delta, base_m: dict, m: dict) -> list:
+    """Diff one entry against its DeltaContract base entry."""
+    out = []
+    d = {
+        "full_pool_sort_count": m["full_pool_sort_count"],
+        "sort_delta": m["sort_count"] - base_m["sort_count"],
+        "scatter_delta": m["scatter_count"] - base_m["scatter_count"],
+        "collective_delta": (m["collective_count"]
+                             - base_m["collective_count"]),
+    }
+
+    def breach(rule, message, measured, limit):
+        out.append(Finding(pass_name="hlo", rule=rule,
+                           where=f"{name} (vs {delta.base})",
+                           message=message, measured=measured, limit=limit))
+
+    if d["full_pool_sort_count"] > delta.max_full_pool_sorts:
+        breach("delta-full-pool-sorts",
+               "full-pool sorts in the delta entry",
+               d["full_pool_sort_count"], delta.max_full_pool_sorts)
+    if d["sort_delta"] > delta.max_sort_delta:
+        breach("delta-sorts", "new sorts relative to the base entry",
+               d["sort_delta"], delta.max_sort_delta)
+    if d["scatter_delta"] > delta.max_scatter_delta:
+        breach("delta-scatters",
+               "scatter delta over budget (one gated drop-scatter per "
+               "telemetry ring is the whole allowance)",
+               d["scatter_delta"], delta.max_scatter_delta)
+    if d["collective_delta"] > delta.max_collective_delta:
+        breach("delta-collectives",
+               "new cross-device collectives relative to the base entry",
+               d["collective_delta"], delta.max_collective_delta)
+    return out, d
+
+
+def lower_entry(entry, ctx, builds=None) -> tuple:
+    """(optimized HLO text, EntryBuild) for one registry entry."""
+    if builds is not None and entry.name in builds:
+        built = builds[entry.name]
+    else:
+        built = entry.build(ctx)
+        if builds is not None:
+            builds[entry.name] = built
+    txt = built.fn.lower(*built.make_args()).compile().as_text()
+    return txt, built
+
+
+def run(ctx, selected=None, *, progress=None, builds=None):
+    """The whole pass: (findings, summary) over the selected entries.
+
+    ``progress`` is an optional ``callable(str)`` for per-entry status
+    lines (compiles are the slow part of the analyzer); ``builds`` an
+    optional shared ``{name: EntryBuild}`` cache across passes."""
+    from oversim_tpu.analysis import contracts as contracts_mod
+
+    findings = []
+    entries_summary = {}
+    measured = {}
+    for entry in contracts_mod.entries(selected):
+        if progress:
+            progress(f"hlo: compiling {entry.name} ...")
+        txt, built = lower_entry(entry, ctx, builds)
+        m = measure_entry(txt, built.pool_dim)
+        measured[entry.name] = m
+        findings.extend(check_contract(entry.name, entry.contract, m))
+        delta_info = None
+        if entry.delta is not None:
+            base_m = measured.get(entry.delta.base)
+            if base_m is None:
+                findings.append(Finding(
+                    pass_name="hlo", rule="delta-base-missing",
+                    where=entry.name,
+                    message=f"delta base {entry.delta.base!r} was not "
+                            f"measured in this run (select it too)"))
+            else:
+                delta_findings, delta_info = check_delta(
+                    entry.name, entry.delta, base_m, m)
+                findings.extend(delta_findings)
+        entries_summary[entry.name] = {
+            "counts": {k: m[k] for k in
+                       ("sort_count", "full_pool_sort_count",
+                        "scatter_count", "collective_count")},
+            "collectives": m["collectives"],
+            "host_transfers": m["host_transfers"],
+            "donated_leaves": m["donated_leaves"],
+            "info": built.info,
+            **({"delta": delta_info} if delta_info else {}),
+        }
+    findings.extend(contracts_mod.scenario_pins())
+    summary = {"entries": entries_summary,
+               "scenario_pins": "checked",
+               "findings": len(findings)}
+    return findings, summary

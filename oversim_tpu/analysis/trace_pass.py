@@ -1,0 +1,183 @@
+"""Trace-time pass: run each registered entry point twice and fail on
+recompilation across same-shape calls, tracer leaks, or implicit host
+syncs inside the harnessed window.
+
+Why not ``jax.transfer_guard``: on the CPU backend (the tier-1 test
+platform) the device→host transfer guards are no-ops — ``bool(x > 0)``
+on a committed array does not trip ``transfer_guard_device_to_host
+("disallow")``.  The harness instead patches the array dunders that ARE
+the implicit-sync surface (``ArrayImpl.__bool__`` / ``__index__`` /
+``__int__`` / ``__float__`` / ``__array__``) plus ``jax.device_get``,
+and counts hits while an entry executes.  Recompiles are detected via
+the jit wrapper's ``_cache_size()`` (a second same-shape call must not
+add a cache entry); leaks via ``jax.checking_leaks()`` around the first
+(tracing) call.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from oversim_tpu.analysis.findings import Finding
+
+_SYNC_DUNDERS = ("__bool__", "__index__", "__int__", "__float__",
+                 "__array__")
+
+
+class HostSyncMonitor:
+    """Counts implicit device→host syncs while active.
+
+    Patches ``jax._src.array.ArrayImpl``'s conversion dunders and the
+    ``jax.device_get`` module attribute; restores them on exit.  The
+    originals still run — the monitor observes, it does not block, so a
+    harnessed entry that genuinely syncs still completes and the finding
+    reports the real count."""
+
+    def __init__(self):
+        self.syncs = {}            # dunder name -> count
+        self.device_gets = 0
+        self._saved = {}
+
+    @property
+    def total_syncs(self) -> int:
+        return sum(self.syncs.values())
+
+    def __enter__(self):
+        import jax
+        from jax._src import array as _array
+        cls = _array.ArrayImpl
+        mon = self
+
+        def wrap(name, orig):
+            def patched(self_, *a, **kw):
+                mon.syncs[name] = mon.syncs.get(name, 0) + 1
+                return orig(self_, *a, **kw)
+            return patched
+
+        for name in _SYNC_DUNDERS:
+            orig = getattr(cls, name, None)
+            if orig is None:
+                continue
+            self._saved[name] = orig
+            setattr(cls, name, wrap(name, orig))
+
+        orig_get = jax.device_get
+
+        def patched_get(*a, **kw):
+            mon.device_gets += 1
+            return orig_get(*a, **kw)
+
+        self._saved["device_get"] = (jax, orig_get)
+        jax.device_get = patched_get
+        return self
+
+    def __exit__(self, *exc):
+        from jax._src import array as _array
+        for name, orig in self._saved.items():
+            if name == "device_get":
+                mod, fn = orig
+                mod.device_get = fn
+            else:
+                setattr(_array.ArrayImpl, name, orig)
+        self._saved.clear()
+        return False
+
+
+def _cache_size(fn):
+    try:
+        return fn._cache_size()
+    except Exception:
+        return None
+
+
+def harness_entry(name: str, built, contract) -> tuple:
+    """Run one entry twice under the harness: (findings, stats)."""
+    import jax
+
+    findings = []
+    stats = {}
+
+    # call 1: trace + compile under the leak checker
+    leak = None
+    try:
+        cm = (jax.checking_leaks() if contract.check_leaks
+              else contextlib.nullcontext())
+        with cm:
+            out = built.fn(*built.make_args())
+            jax.block_until_ready(out)
+    except Exception as e:                          # checking_leaks raises
+        if "Leaked" in str(e) or "leak" in type(e).__name__.lower():
+            leak = str(e).splitlines()[0]
+        else:
+            raise
+    if leak:
+        findings.append(Finding(
+            pass_name="trace", rule="tracer-leak", where=name,
+            message=f"tracer leaked out of the traced function: {leak}",
+            measured=1, limit=0))
+        return findings, {"leak": leak}
+
+    baseline = _cache_size(built.fn)
+
+    # call 2: same shapes — must hit the cache, must not touch the host.
+    # Fresh args are made OUTSIDE the monitor: init legitimately runs
+    # host-side; the contract is about the dispatch itself.
+    args = built.make_args()
+    with HostSyncMonitor() as mon:
+        out = built.fn(*args)
+    jax.block_until_ready(out)
+
+    after = _cache_size(built.fn)
+    stats["cache_size"] = after
+    if baseline is not None and after is not None:
+        recompiles = after - baseline
+        stats["recompiles"] = recompiles
+        if recompiles > contract.max_recompiles:
+            findings.append(Finding(
+                pass_name="trace", rule="recompile", where=name,
+                message="a second same-shape call recompiled — the "
+                        "entry's cache key is unstable (unhashable "
+                        "static arg, fresh closure, or weak-type drift) "
+                        "and every serving window would pay a compile",
+                measured=recompiles, limit=contract.max_recompiles))
+    stats["host_syncs"] = dict(mon.syncs)
+    stats["device_gets"] = mon.device_gets
+    if mon.total_syncs > contract.max_host_syncs:
+        findings.append(Finding(
+            pass_name="trace", rule="host-sync", where=name,
+            message="implicit device→host syncs "
+                    f"({', '.join(sorted(mon.syncs))}) inside the "
+                    "dispatch window — a __bool__/__index__/__float__ "
+                    "forced the host to block on device values",
+            measured=mon.syncs, limit=contract.max_host_syncs))
+    if mon.device_gets > contract.max_device_gets:
+        findings.append(Finding(
+            pass_name="trace", rule="device-get", where=name,
+            message="jax.device_get inside the dispatch window — "
+                    "fetches belong to the window-boundary drain",
+            measured=mon.device_gets, limit=contract.max_device_gets))
+    return findings, stats
+
+
+def run(ctx, selected=None, *, progress=None, builds=None):
+    """The whole pass over the selected registry entries.  ``builds``:
+    optional shared ``{name: EntryBuild}`` cache so the CLI constructs
+    each entry once across passes."""
+    from oversim_tpu.analysis import contracts as contracts_mod
+
+    findings = []
+    entries_summary = {}
+    for entry in contracts_mod.entries(selected):
+        if progress:
+            progress(f"trace: harnessing {entry.name} ...")
+        if builds is not None and entry.name in builds:
+            built = builds[entry.name]
+        else:
+            built = entry.build(ctx)
+            if builds is not None:
+                builds[entry.name] = built
+        f, stats = harness_entry(entry.name, built, entry.contract)
+        findings.extend(f)
+        entries_summary[entry.name] = stats
+    summary = {"entries": entries_summary, "findings": len(findings)}
+    return findings, summary

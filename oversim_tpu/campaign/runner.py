@@ -171,14 +171,16 @@ class Campaign:
 
     # -- reporting ----------------------------------------------------------
 
-    @partial(jax.jit, static_argnames=("self",))
+    # cs is deliberately NOT donated: report() is safe to call mid-run,
+    # so the caller keeps using the state afterwards
+    @partial(jax.jit, static_argnames=("self",))  # analysis: allow(undonated-jit)
     def _reduce(self, cs: SimState):
         return (stats_mod.ensemble_reduce(cs.stats),
                 dict(t_now=cs.t_now, tick=cs.tick,
                      alive=jnp.sum(cs.alive, axis=1),
                      counters=cs.counters))
 
-    def report(self, cs: SimState, confidence: float = 0.95) -> dict:
+    def report(self, cs: SimState, confidence: float = 0.95) -> dict:  # analysis: allow(host-numpy, host-float, host-device-get)
         """Ensemble report: every metric as cross-replica mean/stddev/
         Student-t CI + per-replica breakdown (stats.ensemble_summary
         schema), plus ``_campaign`` metadata (grid, per-replica t_sim/
@@ -222,7 +224,7 @@ class Campaign:
         }
         return out
 
-    def telemetry_report(self, cs: SimState,
+    def telemetry_report(self, cs: SimState,  # analysis: allow(host-device-get)
                          confidence: float = 0.95) -> dict:
         """Per-replica KPI time series + cross-replica CI bands off the
         stacked ``[S, W, ...]`` telemetry rings (oversim_tpu/telemetry.py

@@ -256,8 +256,9 @@ class Outbox:
         # (argsort of disabled-last order) keeps everything one fused
         # sort instead of S scatters
         order_key = jnp.where(en > 0, slots, s)                  # [S]
-        src = jnp.argsort(order_key)[:m] if s > m else \
-            jnp.argsort(order_key)
+        # [S] send-slot argsort, NOT a pool-sized sort ([:m] is a no-op
+        # when s <= m)
+        src = jnp.argsort(order_key)[:m]  # analysis: allow(sort-call)
         n_sent = jnp.sum(en)
 
         def pick(name, fill, width=None):

@@ -180,7 +180,7 @@ def _due_masks(pool: MsgPool, n: int, t_end, alive, hold=None):
 
 
 def build_inbox_sort(pool: MsgPool, n: int, r: int, t_end, alive,
-                     hold=None):
+                     hold=None):  # analysis: allow(sort-call)
     """Legacy inbox grouping: one lexicographic (dst, t_deliver) full-pool
     stable sort, O(P log P).  Kept selectable (``inbox_impl="sort"``) so
     the scatter path stays identity-testable against it."""

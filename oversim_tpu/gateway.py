@@ -430,27 +430,27 @@ class RealtimeGateway:
         self._poll_udp()
         self._poll_tcp()
         self.flush_rx()
-        target = int(self.state.t_now) + int(sim_seconds * NS)
-        while int(self.state.t_now) < target:
-            prev = int(self.state.t_now)
+        target = int(self.state.t_now) + int(sim_seconds * NS)  # analysis: allow(device-sync)
+        while int(self.state.t_now) < target:  # analysis: allow(device-sync)
+            prev = int(self.state.t_now)  # analysis: allow(device-sync)
             self.state = self.sim.step(self.state)
             self._drain_ext_out()
             for fn in self.ext_drains:
                 fn()
-            if int(self.state.t_now) == prev and not bool(
-                    np.asarray(self.state.pool.valid).any()):
+            if int(self.state.t_now) == prev and not bool(  # analysis: allow(device-sync)
+                    np.asarray(self.state.pool.valid).any()):  # analysis: allow(device-sync)
                 break   # nothing scheduled anywhere: idle sim
 
     def run_realtime(self, duration_s: float, slice_s: float = 0.05):
         """Realtime pacing: simulated time tracks wall-clock time
         (realtimescheduler.cc waits on the socket until the next event)."""
         t0_wall = time.monotonic()
-        t0_sim = int(self.state.t_now) / NS
+        t0_sim = int(self.state.t_now) / NS  # analysis: allow(device-sync)
         while True:
             elapsed = time.monotonic() - t0_wall
             if elapsed >= duration_s:
                 return
-            ahead = (int(self.state.t_now) / NS - t0_sim) - elapsed
+            ahead = (int(self.state.t_now) / NS - t0_sim) - elapsed  # analysis: allow(device-sync)
             if ahead > slice_s:
                 time.sleep(min(ahead, slice_s))
                 continue

@@ -47,9 +47,9 @@ def _fail(name, detail):
 
 
 def check_engine(state):
-    alive = np.asarray(state.alive)
-    pool_valid = np.asarray(state.pool.valid)
-    t_now = int(state.t_now)
+    alive = np.asarray(state.alive)  # analysis: allow(device-sync)
+    pool_valid = np.asarray(state.pool.valid)  # analysis: allow(device-sync)
+    t_now = int(state.t_now)  # analysis: allow(device-sync)
     if t_now < 0:
         _fail("time_monotone", f"t_now={t_now} < 0")
     n_valid = int(pool_valid.sum())
@@ -110,7 +110,7 @@ def check_chord(state, alive):
         cycle_len += 1
     if cycle_len != len(ready_idx):
         return
-    keys = np.asarray(state.node_keys)
+    keys = np.asarray(state.node_keys)  # analysis: allow(device-sync)
     kints = [int.from_bytes(b"".join(
         int(x).to_bytes(4, "big") for x in keys[i]), "big")
         for i in range(n)]

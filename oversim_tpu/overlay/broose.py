@@ -801,7 +801,7 @@ class BrooseLogic:
                                     st.bb_seen])
         stale = (all_e != NO_NODE) & ~K.dup_mask(all_e) & (
             all_seen + refresh_ns < now_b)
-        order = jnp.argsort(jnp.where(stale, all_seen, T_INF))
+        order = jnp.argsort(jnp.where(stale, all_seen, T_INF))  # analysis: allow(sort-call)
         for j in range(p.ping_slots):
             free = st.ping_dst[j] == NO_NODE
             tgt = all_e[order[j]]

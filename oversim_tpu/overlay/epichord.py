@@ -263,7 +263,7 @@ class EpiChordLogic:
         aug = jnp.concatenate([cache, jnp.where(fresh_mask, cands, NO_NODE)])
         aseen = jnp.concatenate([cseen, jnp.where(fresh_mask, seen, 0)])
         # keep the newest C entries (invalid slots sort oldest)
-        order = jnp.argsort(
+        order = jnp.argsort(  # analysis: allow(sort-call)
             jnp.where(aug == NO_NODE, jnp.int64(-1), aseen))[::-1]
         aug, aseen = aug[order], aseen[order]
         return dataclasses.replace(

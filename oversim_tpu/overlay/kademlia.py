@@ -333,7 +333,7 @@ class KademliaLogic:
         k1 = jnp.where(need, bi, num_b).astype(I32)
         k2 = (~alive).astype(I32)
         k3 = jnp.arange(c_dim, dtype=I32)
-        b_s, a_s, idx_s = jax.lax.sort((k1, k2, k3), num_keys=3)
+        b_s, a_s, idx_s = jax.lax.sort((k1, k2, k3), num_keys=3)  # analysis: allow(sort-call)
         rank = k3 - jnp.searchsorted(b_s, b_s, side="left").astype(I32)
         # per-bucket column order: free columns first, then evictable by
         # stale count descending, then untouchable
@@ -342,7 +342,7 @@ class KademliaLogic:
         cls = jnp.where(free, 0, jnp.where(evictable, 1, 2))
         colkey = cls * (1 << 20) - jnp.where(
             evictable, jnp.minimum(b_stale, (1 << 19) - 1), 0)
-        order = jnp.argsort(colkey, axis=1).astype(I32)       # [B, K]
+        order = jnp.argsort(colkey, axis=1).astype(I32)       # [B, K]  # analysis: allow(sort-call)
         free_cnt = jnp.sum(free, axis=1, dtype=I32)           # [B]
         avail_cnt = free_cnt + jnp.sum(evictable, axis=1, dtype=I32)
 

@@ -134,7 +134,7 @@ class KoordeLogic(ChordLogic):
         lhit = (st.db_list[..., None] == failed).any(-1) & (
             st.db_list != NO_NODE)
         # compact the backup list (drop failed entries, keep order)
-        order = jnp.argsort(jnp.where(lhit, 1, 0), stable=True)
+        order = jnp.argsort(jnp.where(lhit, 1, 0), stable=True)  # analysis: allow(sort-call)
         compacted = jnp.where(lhit, NO_NODE, st.db_list)[order]
         new_db = jnp.where(db_hit, compacted[0], st.db_node)
         compacted = jnp.where(

@@ -585,7 +585,7 @@ class NiceLogic:
             size = jnp.sum(mem != NO_NODE, dtype=I32)
             do_split = lead & (size > 3 * p.k - 1)
             c_splits += do_split.astype(I32)
-            others = jnp.sort(jnp.where(
+            others = jnp.sort(jnp.where(  # analysis: allow(sort-call)
                 (mem == NO_NODE) | (mem == node_idx), BIG, mem))
             others = jnp.where(others == BIG, NO_NODE, others)
             n_oth = jnp.sum(others != NO_NODE, dtype=I32)

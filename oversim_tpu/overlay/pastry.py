@@ -584,7 +584,7 @@ class PastryLogic:
         n_leafs = jnp.sum((leafs != NO_NODE).astype(I32))
         pick = jax.random.randint(rngs[3], (), 0, jnp.maximum(n_leafs, 1),
                                   dtype=I32)
-        order = jnp.argsort(jnp.where(leafs != NO_NODE, 0, 1))
+        order = jnp.argsort(jnp.where(leafs != NO_NODE, 0, 1))  # analysis: allow(sort-call)
         tgt = leafs[order[jnp.minimum(pick, leafs.shape[0] - 1)]]
         fire_l = en_l & (tgt != NO_NODE)
         ob.send(fire_l, now_l, tgt, wire.PASTRY_STATE_CALL,

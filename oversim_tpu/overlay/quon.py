@@ -63,7 +63,7 @@ class QuonLogic(VastLogic):
             binding = binding.at[jmin].set(
                 jnp.where(jnp.any(inq), True, binding[jmin]))
         sortkey = jnp.where(binding, dist, dist + jnp.float32(1e9))
-        order = jnp.argsort(sortkey)
+        order = jnp.argsort(sortkey)  # analysis: allow(sort-call)
         aug, augp, augs = aug[order], augp[order], augs[order]
         return dataclasses.replace(
             st, nbr=aug[:d], nbr_pos=augp[:d], nbr_seen=augs[:d])

@@ -184,7 +184,7 @@ class VastLogic:
         aug = jnp.where(dup, NO_NODE, aug)
         dist = jnp.sqrt(jnp.sum((augp - me_pos[None, :]) ** 2, axis=-1))
         dist = jnp.where(aug == NO_NODE, jnp.float32(1e30), dist)
-        order = jnp.argsort(dist)
+        order = jnp.argsort(dist)  # analysis: allow(sort-call)
         aug, augp, augs = aug[order], augp[order], augs[order]
         return dataclasses.replace(
             st, nbr=aug[:d], nbr_pos=augp[:d], nbr_seen=augs[:d])
@@ -295,7 +295,7 @@ class VastLogic:
                                   axis=-1))
             hd = jnp.where((st.nbr == NO_NODE) | (st.nbr == m.src),
                            jnp.float32(1e30), hd)
-            order = jnp.argsort(hd)
+            order = jnp.argsort(hd)  # analysis: allow(sort-call)
             hint_nodes = jnp.where(hd[order] < p.aoi, st.nbr[order],
                                    NO_NODE)[:4]
             ob.send(do_hint & jnp.any(hint_nodes != NO_NODE), now, m.src,

@@ -321,9 +321,10 @@ class ZeroconfDiscovery:
     def browse(self, wait_s: float = 0.2) -> list:
         """Collect announcements seen within ``wait_s`` →
         [(instance, host, port)] bootstrap candidates."""
-        deadline = time.time() + wait_s
+        # monotonic: a wall-clock NTP step must not stretch the wait
+        deadline = time.monotonic() + wait_s
         seen = []
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             try:
                 frame, _addr = self.sock.recvfrom(9000)
             except (BlockingIOError, OSError):
@@ -435,9 +436,10 @@ def stun_discover(sock, server, rto_s: float = 0.5, retries: int = 3):
                 sock.sendto(req, server)
             except OSError:
                 return None
-            deadline = time.time() + rto_s * (2 ** attempt)
+            # monotonic: retransmit timeouts must survive clock steps
+            deadline = time.monotonic() + rto_s * (2 ** attempt)
             while True:
-                remain = deadline - time.time()
+                remain = deadline - time.monotonic()
                 if remain <= 0:
                     break
                 sock.settimeout(remain)

@@ -435,14 +435,38 @@ def mesh_layout(mesh=None) -> dict:
     return out
 
 
+def analysis_verdict(path=None):
+    """Compact graph-contract verdict for the manifest's ``hlo_budget``
+    field, read from the analyzer's JSON document (``scripts/analyze.py
+    --json``).  ``path`` defaults to $OVERSIM_ANALYSIS_VERDICT — which
+    scripts/run_suite.sh exports after its analyze gate — so every
+    bench/campaign/service artifact records which contract revision its
+    graphs passed.  None when no verdict document is available."""
+    import json
+    import os
+    path = path or os.environ.get("OVERSIM_ANALYSIS_VERDICT")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    from oversim_tpu.analysis.findings import verdict_summary
+    return verdict_summary(doc)
+
+
 def run_manifest(*, config=None, mesh=None, hlo_budget=None,
                  artifacts=None, extra=None) -> dict:
     """The unified RunManifest attached to every bench/campaign/
     scale_smoke artifact: enough provenance to re-run or audit the
     measurement — config hash (and the config itself), mesh/sharding
     layout, HLO op-budget results, git rev, artifact paths, runtime
-    versions."""
+    versions.  ``hlo_budget`` defaults to :func:`analysis_verdict` (the
+    graph-contract analyzer's verdict document, when one is present)."""
     import platform as _platform
+    if hlo_budget is None:
+        hlo_budget = analysis_verdict()
     man = {
         "metric": "run_manifest",
         "kind": "run_manifest",

@@ -50,9 +50,9 @@ def snapshot(state) -> dict:
     Returns {"t_sim": s, "nodes": [{"id", "alive", "key"}...],
     "edges": [{"src", "dst", "kind"}...]} — the engine-side equivalent
     of the reference's per-node arrow set."""
-    alive = np.asarray(state.alive)
+    alive = np.asarray(state.alive)  # analysis: allow(device-sync)
     n = alive.shape[0]
-    keys = np.asarray(state.node_keys)
+    keys = np.asarray(state.node_keys)  # analysis: allow(device-sync)
     nodes = [{"id": int(i), "alive": bool(alive[i]),
               "key": "".join(f"{int(w):08x}" for w in keys[i])}
              for i in range(n)]
@@ -80,7 +80,7 @@ def snapshot(state) -> dict:
                     continue
                 seen_pairs.add(pair)
                 edges.append({"src": int(i), "dst": j, "kind": kind})
-    return {"t_sim": float(np.asarray(state.t_now)) / 1e9,
+    return {"t_sim": float(np.asarray(state.t_now)) / 1e9,  # analysis: allow(device-sync)
             "nodes": nodes, "edges": edges}
 
 

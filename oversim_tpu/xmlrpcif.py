@@ -57,9 +57,9 @@ class XmlRpcInterface:
 
     def _closest_ready(self, key, num: int):
         st = self.state
-        ready = np.asarray(st.alive) & np.asarray(
+        ready = np.asarray(st.alive) & np.asarray(  # analysis: allow(device-sync)
             self.sim.logic.ready_mask(st.logic))
-        kt = np.asarray(st.node_keys, dtype=np.uint64)
+        kt = np.asarray(st.node_keys, dtype=np.uint64)  # analysis: allow(device-sync)
         tgt = np.asarray(key, dtype=np.uint64)
         # big-endian lane compare == ring xor-free distance on the key
         # table; python bignum per node is fine host-side
@@ -149,9 +149,9 @@ class XmlRpcInterface:
         return clean
 
     def advance(self, seconds: float):
-        t = (int(self.state.t_now) / NS) + float(seconds)
+        t = (int(self.state.t_now) / NS) + float(seconds)  # analysis: allow(device-sync)
         self.state = self.sim.run_until(self.state, t)
-        return int(self.state.t_now)
+        return int(self.state.t_now)  # analysis: allow(device-sync)
 
     def local_lookup(self, key_hex: str, num: int = 4):
         """Closest READY nodes (XmlRpcInterface::localLookup)."""
@@ -166,8 +166,8 @@ class XmlRpcInterface:
         num = nrep.num_replica if nrep is not None and hasattr(
             nrep, "num_replica") else 4
         holders = self._closest_ready(key, num)
-        nonce = (int(self.state.t_now) // 1000) % (2**30) + 7
-        expire = int(self.state.t_now) + int(ttl * NS)
+        nonce = (int(self.state.t_now) // 1000) % (2**30) + 7  # analysis: allow(device-sync)
+        expire = int(self.state.t_now) + int(ttl * NS)  # analysis: allow(device-sync)
         for h in holders:
             self._inject(h, wire.DHT_PUT_CALL, key, a=int(value),
                          b=nonce, stamp=expire)
@@ -181,7 +181,7 @@ class XmlRpcInterface:
         holders = self._closest_ready(key, 1)
         if not holders:
             return -1
-        nonce = (int(self.state.t_now) // 1000) % (2**30) + 13
+        nonce = (int(self.state.t_now) // 1000) % (2**30) + 13  # analysis: allow(device-sync)
         self._inject(holders[0], wire.DHT_GET_CALL, key, b=nonce)
         got = self._collect([int(wire.DHT_GET_RES)], nonce)
         return got[0][1] if got else -1
@@ -200,7 +200,7 @@ class XmlRpcInterface:
             if cand is None:
                 return []
             visited.add(cand)
-            nonce = (int(self.state.t_now) // 1000) % (2**30) + 21
+            nonce = (int(self.state.t_now) // 1000) % (2**30) + 21  # analysis: allow(device-sync)
             self._inject(cand, wire.FINDNODE_CALL, key, b=nonce)
             got = self._collect([int(wire.FINDNODE_RES)], nonce,
                                 want_payload=True)
@@ -229,7 +229,7 @@ class XmlRpcInterface:
             hex(keys_mod.to_int(key))[2:], 1) or self._closest_ready(key, 1)
         if not holders:
             return False
-        expire = int(self.state.t_now) + int(ttl * NS)
+        expire = int(self.state.t_now) + int(ttl * NS)  # analysis: allow(device-sync)
         # wire protocol: a=name id, b=VALUE (stored by the registrar);
         # the ack echoes both — matching on (a, b) keeps in-sim P2PNS
         # traffic to the injector slot from false-acking us
@@ -248,7 +248,7 @@ class XmlRpcInterface:
             hex(keys_mod.to_int(key))[2:], 1) or self._closest_ready(key, 1)
         if not holders:
             return -1
-        nonce = (int(self.state.t_now) // 1000) % (2**30) + 29
+        nonce = (int(self.state.t_now) // 1000) % (2**30) + 29  # analysis: allow(device-sync)
         self._inject(holders[0], wire.P2PNS_RES_CALL, key, a=nid, b=nonce)
         got = self._collect([int(wire.P2PNS_RES_RES)], nonce,
                             want_payload=True)
@@ -262,7 +262,7 @@ class XmlRpcInterface:
         app = getattr(self.state.logic, "app", None)
         if app is None or not hasattr(app, "s_key"):
             return []
-        alive = np.asarray(self.state.alive)
+        alive = np.asarray(self.state.alive)  # analysis: allow(device-sync)
         s_key = np.asarray(app.s_key)
         s_val = np.asarray(app.s_val)
         out = []
@@ -281,7 +281,7 @@ class XmlRpcInterface:
         revives a dead slot with a fresh nodeId and schedules its join.
         Returns the slot index, or -1 when every slot is alive."""
         import jax
-        alive = np.asarray(self.state.alive)
+        alive = np.asarray(self.state.alive)  # analysis: allow(device-sync)
         dead = np.nonzero(~alive)[0]
         if not len(dead):
             return -1

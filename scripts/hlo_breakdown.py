@@ -1,38 +1,34 @@
 """Compile the bench-shaped sim step and break the optimized HLO down by
 opcode — evidence for which op classes dominate the op-issue-bound tick.
 
+The three budget modes below are now THIN SHIMS over the graph-contract
+registry (oversim_tpu/analysis/): same positionals, same output lines,
+same exit codes (0 ok / 1 breach), with a deprecation note on stderr.
+New code should run ``scripts/analyze.py`` instead — it checks the same
+budgets as declarative contracts over EVERY compiled entry point, plus
+trace-time and AST-lint passes.
+
 Usage:
   python scripts/hlo_breakdown.py [n] [overlay] [window] [inbox]
       Prints instruction counts by opcode inside the scan body, the
       largest sort/scatter/gather shapes, and fusion count.
   python scripts/hlo_breakdown.py --budget [n] [overlay] [window] [inbox]
       Compiles ONE tick and exits non-zero when the HLO exceeds the
-      pinned op budget: zero full-pool sorts (inbox_impl="scatter"
-      default) and at most 200 scatter ops (overlay logic contributes
-      ~120-150 small per-node scatters; the engine's own share is
-      ``8 + 2*inbox``).  Override with --max-sorts / --max-scatters.
-      Wired into the fast test tier via tests/test_engine.py, which
-      calls :func:`hlo_op_counts` / :func:`check_budget` on its own
-      compiled tick.
+      pinned op budget (→ analyze.py solo_tick contract).  Override with
+      --max-sorts / --max-scatters.
   python scripts/hlo_breakdown.py --campaign S [n] [overlay] [window] [inbox]
-      Compiles ONE vmapped campaign tick (S replicas, replica axis
-      sharded over the available devices) and additionally pins ZERO
-      cross-replica collectives — the replica axis must stay pure data
-      parallelism (oversim_tpu/campaign/; tests/test_vmap_campaign.py).
+      One vmapped replica-sharded campaign tick; additionally pins ZERO
+      cross-replica collectives (→ analyze.py campaign_tick contract).
   python scripts/hlo_breakdown.py --telemetry K [--campaign S] [n] ...
-      Compiles the tick telemetry-off AND telemetry-on (sampleTicks=K)
-      and pins the DELTA: zero full-pool sorts, no new sorts, scatter
-      delta bounded by --max-scatter-delta (default 64 — one gated
-      mode="drop" scatter per ring buffer, oversim_tpu/telemetry.py),
-      zero new collectives.  With --campaign S the compare runs on the
-      replica-sharded campaign tick (replicated [W] rings must add no
-      cross-device traffic).  Helper: :func:`check_telemetry_budget`.
+      Telemetry-off vs telemetry-on tick delta (→ analyze.py
+      telemetry_tick delta contract): no new sorts, bounded scatter
+      delta (--max-scatter-delta, default 64), zero new collectives.
 
-The counting helpers are import-safe (no jax import at module level):
-XLA-CPU at -O0 expands scatters into ``while`` loops (ScatterExpander),
-so :func:`hlo_op_counts` counts native ``scatter(`` ops PLUS while ops
-carrying a ``.../scatter`` op_name — the same graph compiled for TPU
-keeps them as native scatters.
+The counting helpers (``hlo_op_counts`` / ``check_budget`` /
+``check_telemetry_budget``) live in oversim_tpu/analysis/hlo_text.py and
+are re-exported here for back-compat (tests/test_hlo_budget.py,
+tests/test_engine.py, oversim_tpu/profiling.py import them from this
+module); both homes are import-safe (no jax at module level).
 """
 
 import collections
@@ -41,6 +37,14 @@ import sys
 import time
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from oversim_tpu.analysis.hlo_text import (  # noqa: E402,F401  (back-compat re-exports)
+    check_budget,
+    check_telemetry_budget,
+    hlo_op_counts,
+)
+
 T0 = time.time()
 
 
@@ -48,102 +52,11 @@ def log(msg):
     print(f"[{time.time() - T0:6.1f}s] {msg}", flush=True)
 
 
-# ---------------------------------------------------------------------------
-# pure HLO-text analysis (import-safe; used by tests/test_engine.py)
-# ---------------------------------------------------------------------------
-
-_SCATTER_WHILE = re.compile(r'op_name="[^"]*/scatter')
-
-# cross-device collective opcodes (GSPMD partitioning output).  The
-# campaign budget pins their count at ZERO inside the replica-sharded
-# tick: the replica axis is pure data parallelism (oversim_tpu/campaign/)
-# — any collective appearing there means the partitioner found a
-# cross-replica data dependency, i.e. replicas stopped being independent.
-_COLLECTIVE_OPS = ("all-reduce(", "all-gather(", "all-to-all(",
-                   "collective-permute(", "reduce-scatter(",
-                   "collective-broadcast(")
-
-
-def hlo_op_counts(txt: str, pool_dim: int | None = None) -> dict:
-    """Count sort/scatter/collective ops in optimized HLO text.
-
-    Returns ``{"sort_count", "full_pool_sort_count", "scatter_count",
-    "collective_count"}``.
-    ``full_pool_sort_count`` counts sorts whose operand shape contains
-    the pool dimension ``pool_dim`` (0 when pool_dim is None).
-    ``scatter_count`` = native ``scatter(`` ops + XLA-CPU's
-    scatter-expanded ``while`` loops (identified by op_name metadata).
-    ``collective_count`` = cross-device collectives (all-reduce /
-    all-gather / all-to-all / collective-permute / reduce-scatter /
-    collective-broadcast, including their ``-start`` async forms).
-    """
-    sorts = full = scatters = collectives = 0
-    # the pool dim counts as "full-pool" wherever it sits in the shape:
-    # leading ([P,...]) in the solo step, second ([S,P,...]) under the
-    # campaign's replica vmap
-    pool_re = (re.compile(rf"\[(\d+,)?{pool_dim}[\],]")
-               if pool_dim is not None else None)
-    for ln in txt.splitlines():
-        if " sort(" in ln:
-            sorts += 1
-            if pool_re is not None and pool_re.search(ln):
-                full += 1
-        elif " scatter(" in ln:
-            scatters += 1
-        elif " while(" in ln and _SCATTER_WHILE.search(ln):
-            scatters += 1
-        # async collectives lower to op-start/op-done pairs — counting
-        # only the -start (plus the sync form) avoids double counting
-        if any((" " + op in ln) or (" " + op[:-1] + "-start(" in ln)
-               for op in _COLLECTIVE_OPS):
-            collectives += 1
-    return {"sort_count": sorts, "full_pool_sort_count": full,
-            "scatter_count": scatters, "collective_count": collectives}
-
-
-def check_budget(txt: str, pool_dim: int, max_full_pool_sorts: int,
-                 max_scatters: int, max_collectives: int | None = None):
-    """(ok, counts) — does the compiled tick fit the pinned op budget?
-    ``max_collectives`` is only enforced when given (the campaign budget
-    pins it at 0; single-replica node-sharded steps legitimately carry
-    collectives)."""
-    counts = hlo_op_counts(txt, pool_dim)
-    ok = (counts["full_pool_sort_count"] <= max_full_pool_sorts
-          and counts["scatter_count"] <= max_scatters)
-    if max_collectives is not None:
-        ok = ok and counts["collective_count"] <= max_collectives
-    return ok, counts
-
-
-def check_telemetry_budget(base_counts: dict, tel_counts: dict,
-                           max_full_pool_sorts: int = 0,
-                           max_scatter_delta: int = 64,
-                           max_new_collectives: int = 0):
-    """(ok, delta) — the telemetry-enabled tick vs the telemetry-off tick.
-
-    The telemetry plane's entire graph cost is one gated ``mode="drop"``
-    scatter per ring buffer (oversim_tpu/telemetry.py fold), so the
-    pinned contract is: still ZERO full-pool sorts (no sort may appear
-    anywhere — the rings never sort), a BOUNDED scatter delta (one per
-    ring; KBRTest taps + engine counters + time/tick/alive meta fit well
-    under 64), and ZERO new collectives (the [W] rings are replicated /
-    per-replica — sampling must not create cross-device traffic).
-    ``base_counts``/``tel_counts`` are :func:`hlo_op_counts` dicts.
-    """
-    delta = {
-        "full_pool_sort_count": tel_counts["full_pool_sort_count"],
-        "sort_delta": (tel_counts["sort_count"]
-                       - base_counts["sort_count"]),
-        "scatter_delta": (tel_counts["scatter_count"]
-                          - base_counts["scatter_count"]),
-        "collective_delta": (tel_counts["collective_count"]
-                             - base_counts["collective_count"]),
-    }
-    ok = (delta["full_pool_sort_count"] <= max_full_pool_sorts
-          and delta["sort_delta"] <= 0
-          and delta["scatter_delta"] <= max_scatter_delta
-          and delta["collective_delta"] <= max_new_collectives)
-    return ok, delta
+def _deprecation(mode: str, entry: str):
+    print(f"note: hlo_breakdown {mode} is a shim over the graph-contract "
+          f"registry — prefer `python scripts/analyze.py --hlo "
+          f"--entries {entry}` (oversim_tpu/analysis/)",
+          file=sys.stderr, flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -151,7 +64,6 @@ def check_telemetry_budget(base_counts: dict, tel_counts: dict,
 # ---------------------------------------------------------------------------
 
 def _setup_jax():
-    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     sys.modules["zstandard"] = None
     import jax
 
@@ -168,48 +80,37 @@ def _setup_jax():
 
 def _build_sim(n, overlay, window, inbox, pool_factor=4, inbox_impl="scatter",
                telemetry_ticks=0):
-    from oversim_tpu import churn as churn_mod
-    from oversim_tpu import telemetry as telemetry_mod
-    from oversim_tpu.apps import kbrtest
-    from oversim_tpu.apps.kbrtest import KbrTestApp
-    from oversim_tpu.common import lookup as lk_mod
-    from oversim_tpu.engine import sim as sim_mod
+    """Back-compat wrapper over the registry's shared sim builder."""
+    from oversim_tpu.analysis import contracts as contracts_mod
+    ctx = contracts_mod.EntryContext(n=n, overlay=overlay, window=window,
+                                     inbox=inbox, pool_factor=pool_factor)
+    return contracts_mod.build_sim(ctx, inbox_impl=inbox_impl,
+                                   telemetry_ticks=telemetry_ticks)
 
-    app = KbrTestApp(kbrtest.KbrTestParams(test_interval=0.2))
-    if overlay == "chord":
-        from oversim_tpu.overlay.chord import ChordLogic
-        logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=8))
-    else:
-        from oversim_tpu.overlay.kademlia import KademliaLogic
-        logic = KademliaLogic(app=app,
-                              lcfg=lk_mod.LookupConfig(slots=8, merge=True))
-    cp = churn_mod.ChurnParams(model="none", target_num=n,
-                               init_interval=20.0 / n,
-                               init_deviation=2.0 / n)
-    ep = sim_mod.EngineParams(
-        window=window, inbox_slots=inbox,
-        pool_factor=pool_factor, inbox_impl=inbox_impl,
-        telemetry=telemetry_mod.TelemetryParams(
-            sample_ticks=telemetry_ticks))
-    return sim_mod.Simulation(logic, cp, engine_params=ep)
+
+def _ctx(n, overlay, window, inbox, **kw):
+    from oversim_tpu.analysis import contracts as contracts_mod
+    return contracts_mod.EntryContext(n=n, overlay=overlay, window=window,
+                                      inbox=inbox, pool_factor=4, **kw)
 
 
 def budget_main(n, overlay, window, inbox, max_sorts, max_scatters) -> int:
-    """Compile one tick, check the sort/scatter budget, exit non-zero on
-    breach (the --budget mode)."""
-    jax = _setup_jax()
-    sim = _build_sim(n, overlay, window, inbox)
-    s = sim.init(seed=7)
-    log("init done")
-    txt = jax.jit(sim.step).lower(s).compile().as_text()
+    """--budget: shim over the registry's solo_tick entry — compile one
+    tick, check the sort/scatter budget, exit non-zero on breach."""
+    _deprecation("--budget", "solo_tick")
+    _setup_jax()
+    from oversim_tpu.analysis import contracts as contracts_mod
+    from oversim_tpu.analysis import hlo_pass
+
+    txt, built = hlo_pass.lower_entry(
+        contracts_mod.REGISTRY["solo_tick"], _ctx(n, overlay, window, inbox))
     log(f"one-tick HLO compiled: {txt.count(chr(10))} lines")
-    pool_dim = sim.ep.pool_factor * n
     if max_scatters is None:
         # measured: kademlia 151 / chord 123 scatters at inbox=8 (mostly
         # per-node logic scatters) — 200 catches gross regressions while
         # the zero-full-pool-sort pin stays the sharp budget
         max_scatters = 200
-    ok, counts = check_budget(txt, pool_dim, max_sorts, max_scatters)
+    ok, counts = check_budget(txt, built.pool_dim, max_sorts, max_scatters)
     print(f"budget: full_pool_sorts {counts['full_pool_sort_count']} "
           f"(max {max_sorts}), scatters {counts['scatter_count']} "
           f"(max {max_scatters}), total sorts {counts['sort_count']} "
@@ -219,36 +120,25 @@ def budget_main(n, overlay, window, inbox, max_sorts, max_scatters) -> int:
 
 def campaign_budget_main(n, overlay, window, inbox, replicas, max_sorts,
                          max_scatters) -> int:
-    """--campaign S: compile ONE vmapped, replica-sharded campaign tick
-    and pin its budget — zero full-pool sorts, bounded scatters, and
-    ZERO cross-replica collectives (the replica axis is pure data
-    parallelism; a collective inside the tick means the partitioner
-    found a cross-replica dependency)."""
-    jax = _setup_jax()
-    from oversim_tpu.campaign import Campaign, CampaignParams
-    from oversim_tpu.parallel import mesh as mesh_mod
+    """--campaign S: shim over the registry's campaign_tick entry —
+    zero full-pool sorts, bounded scatters, ZERO cross-replica
+    collectives."""
+    _deprecation("--campaign", "campaign_tick")
+    _setup_jax()
+    from oversim_tpu.analysis import contracts as contracts_mod
+    from oversim_tpu.analysis import hlo_pass
 
-    sim = _build_sim(n, overlay, window, inbox)
-    camp = Campaign(sim, CampaignParams(replicas=replicas, base_seed=7))
-    cs = camp.init()
-    log(f"campaign init done (S={camp.s})")
-    # shard over the largest device count that divides S (1 = unsharded
-    # single-device fallback — the vmap budget still holds there)
-    avail = len(jax.devices())
-    n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
-                if camp.s % d == 0)
-    mesh = mesh_mod.make_replica_mesh(n_dev)
-    sh = mesh_mod.campaign_state_shardings(cs, mesh)
-    step = jax.jit(camp._vstep, in_shardings=(sh,), out_shardings=sh)
-    txt = step.lower(cs).compile().as_text()
+    txt, built = hlo_pass.lower_entry(
+        contracts_mod.REGISTRY["campaign_tick"],
+        _ctx(n, overlay, window, inbox, replicas=replicas))
+    n_dev = built.info["devices"]
     log(f"campaign-tick HLO compiled on {n_dev} device(s): "
         f"{txt.count(chr(10))} lines")
-    pool_dim = sim.ep.pool_factor * n
     if max_scatters is None:
         max_scatters = 200   # same rationale as budget_main
-    ok, counts = check_budget(txt, pool_dim, max_sorts, max_scatters,
+    ok, counts = check_budget(txt, built.pool_dim, max_sorts, max_scatters,
                               max_collectives=0)
-    print(f"campaign budget (S={camp.s}, {n_dev} dev): "
+    print(f"campaign budget (S={replicas}, {n_dev} dev): "
           f"full_pool_sorts {counts['full_pool_sort_count']} "
           f"(max {max_sorts}), scatters {counts['scatter_count']} "
           f"(max {max_scatters}), collectives "
@@ -260,40 +150,30 @@ def campaign_budget_main(n, overlay, window, inbox, replicas, max_sorts,
 
 def telemetry_budget_main(n, overlay, window, inbox, tel_ticks, replicas,
                           max_sorts, max_scatter_delta) -> int:
-    """--telemetry K: compile the tick TWICE — telemetry off and
-    telemetry on (sampleTicks=K) — and pin the delta: zero full-pool
-    sorts and no new sorts anywhere, a bounded scatter delta (one gated
-    mode="drop" scatter per ring buffer), and zero new collectives.
-    With --campaign S the comparison runs on the vmapped replica-sharded
-    campaign tick instead, where the zero-new-collectives pin proves the
-    replicated [W] rings add no cross-device traffic."""
+    """--telemetry K: shim over the registry's telemetry delta contract
+    — compile the tick telemetry-off AND telemetry-on and pin the
+    delta.  With --campaign S the compare runs on the replica-sharded
+    campaign tick."""
+    _deprecation("--telemetry", "solo_tick,telemetry_tick")
     jax = _setup_jax()
-    sim_off = _build_sim(n, overlay, window, inbox)
-    sim_on = _build_sim(n, overlay, window, inbox, telemetry_ticks=tel_ticks)
+    from oversim_tpu.analysis import contracts as contracts_mod
+
+    ctx = _ctx(n, overlay, window, inbox,
+               replicas=replicas if replicas is not None else 4)
+    sim_off = contracts_mod.build_sim(ctx)
+    sim_on = contracts_mod.build_sim(ctx, telemetry_ticks=tel_ticks)
     pool_dim = sim_off.ep.pool_factor * n
 
+    texts = []
     if replicas is not None:
-        from oversim_tpu.campaign import Campaign, CampaignParams
-        from oversim_tpu.parallel import mesh as mesh_mod
-        texts = []
         for sim in (sim_off, sim_on):
-            camp = Campaign(sim, CampaignParams(replicas=replicas,
-                                                base_seed=7))
-            cs = camp.init()
-            avail = len(jax.devices())
-            n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
-                        if camp.s % d == 0)
-            mesh = mesh_mod.make_replica_mesh(n_dev)
-            sh = mesh_mod.campaign_state_shardings(cs, mesh)
-            step = jax.jit(camp._vstep, in_shardings=(sh,),
-                           out_shardings=sh)
-            texts.append(step.lower(cs).compile().as_text())
+            step, make_args, n_dev = contracts_mod._campaign_step(ctx, sim)
+            texts.append(step.lower(*make_args()).compile().as_text())
             log(f"campaign tick compiled "
                 f"(telemetry={'on' if sim is sim_on else 'off'}, "
-                f"S={camp.s}, {n_dev} dev)")
+                f"S={replicas}, {n_dev} dev)")
         what = f"campaign S={replicas}"
     else:
-        texts = []
         for sim in (sim_off, sim_on):
             s = sim.init(seed=7)
             texts.append(jax.jit(sim.step).lower(s).compile().as_text())

@@ -8,6 +8,24 @@ set -u
 STATE=${SUITE_STATE:-/tmp/suite_logs}
 mkdir -p "$STATE"
 status=0
+# graph-contract gate (oversim_tpu/analysis/): every compiled entry
+# point checked against its declarative contract + trace-time + AST
+# lint, BEFORE the test tiers.  The JSON verdict is exported so
+# run_manifest embeds it in every artifact (telemetry.analysis_verdict).
+an_marker="$STATE/analyze.ok"
+export OVERSIM_ANALYSIS_VERDICT="$STATE/analysis.json"
+if [ -f "$an_marker" ]; then
+  echo "skip  analyze (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/analyze.py --all --fast \
+      --json "$OVERSIM_ANALYSIS_VERDICT" \
+      > "$STATE/analyze.log" 2>&1; then
+  touch "$an_marker"
+  echo "PASS  analyze  $(tail -1 "$STATE/analyze.log")"
+else
+  status=1
+  echo "FAIL  analyze  $(tail -1 "$STATE/analyze.log")"
+fi
 for f in tests/test_*.py; do
   name=$(basename "$f" .py)
   marker="$STATE/$name.ok"
