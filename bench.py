@@ -221,6 +221,12 @@ def orchestrate() -> int:
             child.kill()
 
     threading.Thread(target=_watchdog, daemon=True).start()
+    # graceful SIGTERM: forward to the child (which finishes its
+    # in-flight window and exits 0); the relay loop then drains the
+    # child's remaining lines and finish() commits a COMPLETE artifact
+    import signal as signal_mod
+    signal_mod.signal(signal_mod.SIGTERM,
+                      lambda *_: child.terminate())
     saw_tpu = False
     last_healthy_tpu = None     # most recent gate-passing chip line
     last_line_healthy = False
@@ -328,7 +334,7 @@ def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
                             measure_wall, chunk, on_window,
                             host_loop=False, now=time.perf_counter,
                             summarize_leaves=_summary_from_leaves,
-                            trace=None):
+                            trace=None, stop=None):
     """Drive wall-clock measurement windows, device-resident.
 
     Each window advances the sim by ``window_sim_s`` simulated seconds
@@ -347,11 +353,16 @@ def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
     Perfetto view of the one-dispatch-one-fetch contract.  The extra
     ``now()`` reads happen only with a trace, so the fake-timer pins of
     the untraced loop are unchanged.
+    ``stop`` (a ``threading.Event``) requests a graceful early finish:
+    checked only at the window boundary, so the in-flight window always
+    completes and its summary is reported — the SIGTERM handler's half
+    of the clean-shutdown contract (tests/test_bench_windows.py).
     """
     t0 = now()
     sim_t = start_sim_t
     windows = 0
-    while now() - t0 < measure_wall:
+    while ((stop is None or not stop.is_set())
+           and now() - t0 < measure_wall):
         sim_t += window_sim_s
         t_d0 = now() if trace is not None else None
         if host_loop:
@@ -664,11 +675,33 @@ def child_main():
             # trace of every completed window
             trace.write(trace_path)
 
+    # graceful SIGTERM: finish the in-flight window (stop is only
+    # checked at window boundaries), fall through to the normal
+    # telemetry/trace finish, and exit 0 — the orchestrator forwards
+    # its own SIGTERM here, so a polite preemption ends with a complete
+    # artifact instead of the SIGKILL-shaped partial one
+    import signal as signal_mod
+    import threading
+    stop_evt = threading.Event()
+    signal_mod.signal(signal_mod.SIGTERM, lambda *_: stop_evt.set())
+
     s, _ = run_measurement_windows(
         runner, s, start_sim_t=warm_until, window_sim_s=chunk * window,
         measure_wall=measure_wall, chunk=chunk, on_window=on_window,
         host_loop=host_loop, summarize_leaves=summarize_leaves,
-        trace=trace)
+        trace=trace, stop=stop_evt)
+
+    ckpt_path = os.environ.get("OVERSIM_BENCH_CHECKPOINT")
+    if ckpt_path:
+        # final checkpoint (atomic, reshard-aware meta when a campaign
+        # ran) — a SIGTERMed bench is resumable, not just recorded
+        from oversim_tpu import checkpoint as ckpt_mod
+        meta = {"bench": {"sigterm": stop_evt.is_set()}}
+        if camp is not None:
+            meta["campaign"] = camp.describe()
+        ckpt_mod.save(ckpt_path, s, meta=meta)
+        sys.stderr.write("bench: final checkpoint -> %s (sigterm=%s)\n"
+                         % (ckpt_path, stop_evt.is_set()))
 
     if tel_ticks > 0 and getattr(s, "telemetry", None) is not None:
         # KPI time series off the ring buffers — for the campaign tier

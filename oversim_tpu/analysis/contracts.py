@@ -247,6 +247,44 @@ def _build_telemetry_tick(ctx):
                             "sample_ticks": ctx.tel_ticks})
 
 
+def _build_resharded_resume(ctx):
+    """Reshard-on-resume (oversim_tpu/elastic/): a campaign checkpoint
+    written at HALF the replica extent is restored into the full-width
+    campaign via ``elastic.reshard_load`` (surviving rows bit-identical,
+    grown rows re-seeded), and the compiled entry is the replica-sharded
+    campaign tick on the RESHARDED state.  Resharding is a host-side
+    restore — the compiled graph must be indistinguishable from
+    ``campaign_tick``'s: the collective allowlist stays EMPTY."""
+    import os
+    import tempfile
+
+    from oversim_tpu import checkpoint as ckpt_mod
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.elastic import reshard_load
+
+    sim = build_sim(ctx)
+    small = Campaign(sim, CampaignParams(
+        replicas=max(1, ctx.replicas // 2), base_seed=7))
+    fd, path = tempfile.mkstemp(suffix=".ckpt.npz")
+    os.close(fd)
+    try:
+        ckpt_mod.save(path, small.init(),
+                      meta={"campaign": small.describe()})
+        full_sim = build_sim(ctx)
+        step, _, n_dev = _campaign_step(ctx, full_sim)
+        camp = Campaign(full_sim,
+                        CampaignParams(replicas=ctx.replicas, base_seed=7))
+        cs, _ = reshard_load(path, camp)
+    finally:
+        os.unlink(path)
+    return EntryBuild(
+        fn=step, make_args=lambda: (cs,),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay,
+              "replicas_from": small.s, "replicas_to": camp.s,
+              "devices": n_dev})
+
+
 def _build_service_window(ctx):
     import jax.numpy as jnp
     from oversim_tpu.engine.sim import NS
@@ -304,6 +342,14 @@ DEFAULT_ENTRIES = (
         doc="service window: run_until_device with EXT_OUT hold armed",
         contract=_DONATED,
         build=_build_service_window),
+    EntryPoint(
+        name="resharded_resume",
+        doc="campaign tick on a state reshard-restored from a "
+            "half-width checkpoint (oversim_tpu/elastic/): identical "
+            "contract to campaign_tick — resharding happens at restore "
+            "time, never in the graph",
+        contract=GraphContract(),       # allowlist unchanged vs base
+        build=_build_resharded_resume),
 )
 
 REGISTRY: dict = {e.name: e for e in DEFAULT_ENTRIES}

@@ -53,11 +53,19 @@ class CampaignParams:
     ``sweep``     — ((dotted_name, (v0, v1, ...)), ...) grid axes;
                     empty = pure seed sweep (ov=None, the engine's
                     bit-identical static-param trace)
+    ``replica_ids`` — optional GLOBAL replica-id subset: run only these
+                    replicas of the full replicas×grid campaign, with
+                    their full-campaign rng and sweep point.  A fleet
+                    worker (oversim_tpu/elastic/) holding shard
+                    ``replica_ids=(4,5,6,7)`` advances rows 4..7 of the
+                    full campaign bit-identically; None = all ids in
+                    order (the classic full campaign).
     """
 
     replicas: int = 4
     base_seed: int = 1
     sweep: tuple = ()
+    replica_ids: tuple | None = None
 
 
 def expand_grid(sweep) -> list:
@@ -88,14 +96,28 @@ class Campaign:
         if self.p.replicas < 1:
             raise ValueError("campaign needs at least one replica")
         self.grid = expand_grid(self.p.sweep)
-        self.s = self.p.replicas * len(self.grid)
-        # per-replica sweep values, stacked [S] in replica order
-        # (replica r belongs to grid point r // replicas)
+        # total extent of the FULL campaign; self.ids are global replica
+        # ids into it (identity for classic whole-campaign runs)
+        self.total = self.p.replicas * len(self.grid)
+        if self.p.replica_ids is None:
+            self.ids = tuple(range(self.total))
+        else:
+            self.ids = tuple(int(i) for i in self.p.replica_ids)
+            if not self.ids:
+                raise ValueError("campaign needs at least one replica id")
+            bad = [i for i in self.ids if i < 0 or i >= self.total]
+            if bad:
+                raise ValueError(
+                    f"replica_ids {bad} outside the campaign's "
+                    f"0..{self.total - 1} id space")
+        self.s = len(self.ids)
+        # per-replica sweep values, stacked [S] in id order (global
+        # replica id i belongs to grid point i // replicas)
         ftype = jnp.result_type(float)
         self.sweep_stack = {
             name: jnp.asarray(
-                [pt[name] for pt in self.grid
-                 for _ in range(self.p.replicas)], ftype)
+                [self.grid[i // self.p.replicas][name] for i in self.ids],
+                ftype)
             for name in (self.grid[0] or {})
         }
 
@@ -108,16 +130,35 @@ class Campaign:
             jax.random.PRNGKey(self.p.base_seed), jnp.uint32(r))
 
     def replica_ov(self, r: int):
-        """Replica r's sweep-override dict (None for pure seed sweeps) —
-        pass to ``sim.step(s, ov=...)`` to reproduce replica r solo."""
-        pt = self.grid[r // self.p.replicas]
+        """Local row r's sweep-override dict (None for pure seed
+        sweeps) — pass to ``sim.step(s, ov=...)`` to reproduce that row
+        solo.  ``r`` indexes THIS campaign's rows; ``self.ids[r]`` is
+        the global replica id (identical for full campaigns)."""
+        pt = self.grid[self.ids[r] // self.p.replicas]
         return dict(pt) if pt else None
+
+    def describe(self) -> dict:
+        """JSON-able campaign identity for checkpoint manifests: the
+        reshard path (oversim_tpu/elastic/reshard.py) refuses to graft a
+        checkpoint onto a campaign with a different base seed / grid,
+        and prefix-checks ``replica_ids`` so row k always means the same
+        replica before and after a grow/shrink."""
+        return {
+            "replicas": self.p.replicas,
+            "base_seed": self.p.base_seed,
+            "sweep": [[name, list(vals)] for name, vals in self.p.sweep],
+            "replica_ids": list(self.ids),
+            "s": self.s,
+            "total": self.total,
+        }
 
     # -- init ---------------------------------------------------------------
 
     def init(self) -> SimState:
-        """Stacked init: every SimState leaf gains a leading [S] axis."""
-        rngs = jax.vmap(self.replica_rng)(jnp.arange(self.s))
+        """Stacked init: every SimState leaf gains a leading [S] axis.
+        Row r is GLOBAL replica ``self.ids[r]`` — a subset campaign
+        initializes exactly the corresponding rows of the full one."""
+        rngs = jax.vmap(self.replica_rng)(jnp.asarray(self.ids))
         if self.sweep_stack:
             f = jax.jit(jax.vmap(
                 lambda rng, ov: self.sim.init_from_rng(rng, ov=ov)))
@@ -214,6 +255,7 @@ class Campaign:
             "replicas": self.p.replicas,
             "grid": self.grid,
             "s": self.s,
+            "replica_ids": list(self.ids),
             "base_seed": self.p.base_seed,
             "confidence": confidence,
             "t_sim": (np.asarray(meta["t_now"]) / NS).tolist(),

@@ -17,10 +17,21 @@ instead of silent corruption, and ``expect_config`` additionally refuses
 a checkpoint whose recorded config hash names a DIFFERENT scenario that
 happens to share the array layout.  v1 checkpoints (no meta) still load.
 
-Writes are KILL-SAFE: the ``.npz`` is written to ``path + ".tmp"``,
-fsynced, then ``os.replace``d — a SIGKILL at any point leaves either the
-previous complete checkpoint or the new complete one, never a torn file
-(the ArtifactWriter discipline from bench.py).
+Writes are KILL-SAFE and POWER-LOSS-SAFE: the ``.npz`` is written to
+``path + ".tmp"``, fsynced, ``os.replace``d, and then the CONTAINING
+DIRECTORY is fsynced — a SIGKILL at any point leaves either the previous
+complete checkpoint or the new complete one, and a power loss after the
+rename cannot roll it back (an unfsynced directory entry may be lost on
+crash even when the file data survived).  Platforms where directories
+refuse fsync (some network/overlay filesystems raise EINVAL/EBADF) are
+tolerated: the rename-level atomicity still holds there.
+
+RESHARD-AWARE META: campaign-stacked checkpoints record the stack layout
+(``meta["stack"]`` — leading axis extent + per-replica fingerprint) so
+:mod:`oversim_tpu.elastic.reshard` can restore them at a DIFFERENT
+replica count; callers (fleet workers, service loops over a Campaign)
+additionally record ``meta["campaign"]`` (``Campaign.describe()``) so the
+grown-slot re-seed is checked against the original base seed/grid.
 """
 
 from __future__ import annotations
@@ -45,6 +56,26 @@ def _fingerprint(leaves) -> str:
 def _git_rev() -> str | None:
     from oversim_tpu import telemetry as telemetry_mod
     return telemetry_mod.git_rev()
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` so the ``os.replace``
+    rename itself is durable (file-data fsync does not persist the
+    directory entry; a power loss could otherwise roll the rename back).
+    Filesystems that refuse directory fsync (EINVAL/EBADF on some
+    network/overlay mounts) are tolerated — rename atomicity still holds
+    there, only power-loss durability is best-effort."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def save(path: str, state, meta: dict | None = None) -> None:
@@ -78,6 +109,7 @@ def save(path: str, state, meta: dict | None = None) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(path)
 
 
 def read_meta(path: str) -> dict:
@@ -90,6 +122,29 @@ def read_meta(path: str) -> dict:
         if fmt != FORMAT:
             raise ValueError(f"not an oversim-tpu checkpoint: {path}")
         return json.loads(str(data["__meta__"]))
+
+
+def load_raw(path: str):
+    """The checkpoint's leaves (flatten order, host numpy arrays) plus
+    its meta manifest, WITHOUT an example structure.
+
+    The reshard path (oversim_tpu/elastic/reshard.py) needs the raw
+    arrays at their CHECKPOINTED replica extent before unflattening into
+    a campaign of a different size — :func:`load` can't express that
+    (its example fixes every shape).  No fingerprint check here; the
+    caller is responsible for structural validation against whatever it
+    unflattens into."""
+    with np.load(path, allow_pickle=False) as data:
+        fmt = str(data["__format__"])
+        if fmt not in (FORMAT, FORMAT_V1):
+            raise ValueError(f"not an oversim-tpu checkpoint: {path}")
+        meta = ({"format": FORMAT_V1} if fmt == FORMAT_V1
+                else json.loads(str(data["__meta__"])))
+        meta.setdefault("format", fmt)
+        leaves = []
+        while f"leaf{len(leaves)}" in data.files:
+            leaves.append(data[f"leaf{len(leaves)}"])
+    return leaves, meta
 
 
 def load(path: str, example, *, expect_config: str | None = None):

@@ -1,0 +1,52 @@
+"""Elastic fleets: checkpoint-portable resharding + preemption tolerance.
+
+The production-operations counterpart to raw scale (ROADMAP item 5):
+
+  * :mod:`oversim_tpu.elastic.reshard` — a checkpoint written at one
+    topology restores at another: the replica axis of campaign-stacked
+    state grows/shrinks by padding/slicing (grown slots re-seeded
+    deterministically from the campaign's base seed), and placement is
+    re-established via ``NamedSharding`` over whatever mesh is available
+    at restore time.  Surviving replicas are bit-identical across the
+    reshape.
+  * :mod:`oversim_tpu.elastic.retry` — the failure taxonomy: device /
+    tunnel errors classified transient vs fatal, jittered exponential
+    backoff around device dispatch and backend acquisition, and a
+    graceful, loudly-annotated degradation to ``JAX_PLATFORMS=cpu``
+    when chip acquisition keeps failing.
+  * :mod:`oversim_tpu.elastic.fleet` — the host-side pieces of the
+    fleet supervisor (``scripts/fleet_run.py``): replica-shard
+    assignment, heartbeat files, seeded chaos schedules, and the
+    per-shard artifact merge that reproduces the uninterrupted
+    single-process ensemble exactly.
+
+See README.md "Elastic fleets" for the user guide.
+"""
+
+from oversim_tpu.elastic.fleet import (  # noqa: F401
+    chaos_schedule,
+    decode_leaves,
+    encode_leaves,
+    heartbeat_age,
+    merge_shard_leaves,
+    read_json,
+    shard_replicas,
+    write_heartbeat,
+    write_json_atomic,
+)
+from oversim_tpu.elastic.reshard import (  # noqa: F401
+    place_campaign,
+    place_solo,
+    replica_fingerprint,
+    reshard_load,
+    reshard_stacked,
+)
+from oversim_tpu.elastic.retry import (  # noqa: F401
+    FATAL,
+    TRANSIENT,
+    RetryPolicy,
+    acquire_backend,
+    backoff_delays,
+    classify,
+    with_retry,
+)

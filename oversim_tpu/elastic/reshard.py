@@ -1,0 +1,228 @@
+"""Reshard-on-resume: a checkpoint restores at a DIFFERENT topology.
+
+Checkpoint v2 (oversim_tpu/checkpoint.py) resumes bit-identically on
+the same topology only: ``load`` demands an example with the exact
+checkpointed shapes, and placement is whatever the caller re-applies.
+This module makes both axes free variables at restore time:
+
+  * **replica axis** — :func:`reshard_stacked` grows/shrinks the
+    leading [S] axis of campaign-stacked state by slicing/padding.
+    Surviving rows are the checkpointed arrays UNCHANGED (bit-identical
+    across any 1×1 → 8-way → 1×1 round trip, pinned by
+    tests/test_zz_elastic.py); grown rows come from the target
+    campaign's own ``init()`` — and since ``Campaign.init`` derives row
+    r from ``fold_in(PRNGKey(base_seed), ids[r])``, a grown slot is
+    re-seeded deterministically, exactly the replica the full campaign
+    would have started with.
+  * **node/device placement** — :func:`place_campaign` /
+    :func:`place_solo` re-establish ``NamedSharding`` over whatever
+    mesh `parallel/mesh.py` can build from the devices available NOW:
+    the largest device count that divides the leading axis (1 chip, 8
+    chips, anything between).  Placement is layout-only; values are
+    untouched.
+
+:func:`reshard_load` is the end-to-end path: raw checkpoint leaves →
+campaign-identity refusals (base seed / sweep grid / replica-id prefix,
+recorded by ``Campaign.describe()`` in the checkpoint meta) →
+per-replica structure fingerprint refusal (a shape-mismatched reshard
+fails LOUDLY, never silently corrupts) → grown/shrunk stacked state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu import checkpoint as ckpt_mod
+from oversim_tpu.parallel import mesh as mesh_mod
+
+
+def _leading_extent(leaves, what: str) -> int:
+    """The common leading-axis extent of stacked leaves."""
+    extents = set()
+    for x in leaves:
+        shape = tuple(np.shape(x))
+        if not shape:
+            raise ValueError(
+                f"reshard fingerprint mismatch: {what} has a scalar "
+                "leaf — not campaign-stacked state (every stacked leaf "
+                "carries a leading replica axis)")
+        extents.add(shape[0])
+    if len(extents) != 1:
+        raise ValueError(
+            f"reshard fingerprint mismatch: {what} leaves disagree on "
+            f"the leading replica extent ({sorted(extents)})")
+    return extents.pop()
+
+
+def replica_fingerprint(state_or_leaves) -> str:
+    """sha1 over the PER-REPLICA structure (trailing dims + dtype of
+    every leaf, flatten order) — the replica-count-independent analogue
+    of checkpoint._fingerprint.  Two stacked states of the same scenario
+    at different S share it; different scenarios (or solo state mistaken
+    for stacked) do not."""
+    leaves = jax.tree.leaves(state_or_leaves)
+    sig = ";".join(
+        f"{tuple(np.shape(x))[1:]}:{np.asarray(x).dtype}" for x in leaves)
+    return hashlib.sha1(sig.encode()).hexdigest()
+
+
+def reshard_stacked(old, fresh):
+    """Graft checkpointed stacked state ``old`` ([S_old, ...] leaves)
+    onto the replica extent of ``fresh`` ([S_new, ...], typically
+    ``camp.init()`` of the target campaign).
+
+    Row policy: rows ``0..min(S_old,S_new)-1`` are ``old``'s arrays
+    unchanged (surviving replicas stay bit-identical); rows past S_old
+    are taken from ``fresh`` (deterministically re-seeded grown slots).
+    Pure function of its inputs — no checkpoint, no devices — so the
+    grow/shrink identity is unit-testable on synthetic pytrees.
+
+    Raises ``ValueError`` mentioning the fingerprints when the
+    per-replica structures differ (shape-mismatched reshard requests
+    fail loudly instead of silently corrupting)."""
+    old_leaves, old_def = jax.tree.flatten(old)
+    new_leaves, new_def = jax.tree.flatten(fresh)
+    if old_def != new_def:
+        raise ValueError(
+            "reshard fingerprint mismatch: checkpoint and target "
+            f"campaign disagree on pytree structure ({len(old_leaves)} "
+            f"vs {len(new_leaves)} leaves)")
+    fp_old = replica_fingerprint(old)
+    fp_new = replica_fingerprint(fresh)
+    if fp_old != fp_new:
+        raise ValueError(
+            "reshard fingerprint mismatch (different Simulation "
+            f"configuration per replica): checkpoint {fp_old[:12]} vs "
+            f"target {fp_new[:12]}")
+    s_old = _leading_extent(old_leaves, "checkpoint")
+    s_new = _leading_extent(new_leaves, "target")
+    keep = min(s_old, s_new)
+    out = []
+    for o, n in zip(old_leaves, new_leaves):
+        n = jnp.asarray(n)
+        o = jnp.asarray(o, dtype=n.dtype)
+        if s_new <= s_old:
+            out.append(o[:s_new])
+        else:
+            out.append(jnp.concatenate([o[:keep], n[keep:]], axis=0))
+    from oversim_tpu.engine.sim import _dedupe_buffers
+    # run_chunk donates the result downstream; slicing two deduped-alias
+    # source leaves could re-alias the outputs
+    return _dedupe_buffers(jax.tree.unflatten(new_def, out))
+
+
+def _check_campaign_meta(meta: dict, camp) -> None:
+    """Refuse to graft a checkpoint onto the WRONG campaign: same array
+    layout does not mean same ensemble.  Compares the checkpointed
+    ``Campaign.describe()`` record (when present — plain checkpoints
+    skip this) against the target's: base seed and sweep grid must be
+    equal, and the replica-id sequences must agree on their common
+    prefix so surviving row k is the same replica on both sides."""
+    rec = meta.get("campaign")
+    if not rec:
+        return
+    want = camp.describe()
+    if (rec.get("base_seed") is not None
+            and rec["base_seed"] != want["base_seed"]):
+        raise ValueError(
+            f"reshard campaign mismatch: checkpoint has "
+            f"base_seed={rec['base_seed']} but target campaign has "
+            f"{want['base_seed']} — grown slots would be mis-seeded")
+    if rec.get("sweep") is not None:
+        have = [[n, list(v)] for n, v in rec["sweep"]]
+        if have != want["sweep"]:
+            raise ValueError(
+                "reshard campaign mismatch: checkpoint sweep grid "
+                f"{have} differs from target {want['sweep']}")
+    # with a sweep grid, global id i maps to grid point i // replicas —
+    # changing `replicas` renumbers every replica's parameter point, so
+    # only pure seed sweeps may grow/shrink along the replicas axis
+    if (rec.get("replicas") is not None and len(camp.grid) > 1
+            and rec["replicas"] != want["replicas"]):
+        raise ValueError(
+            f"reshard campaign mismatch: checkpoint has "
+            f"replicas={rec['replicas']} per grid point but target has "
+            f"{want['replicas']} — the id→grid-point mapping would "
+            "shift under the sweep")
+    old_ids = rec.get("replica_ids")
+    if old_ids is not None:
+        k = min(len(old_ids), len(want["replica_ids"]))
+        if list(old_ids[:k]) != list(want["replica_ids"][:k]):
+            raise ValueError(
+                "reshard campaign mismatch: replica-id prefix differs "
+                f"(checkpoint {list(old_ids[:k])} vs target "
+                f"{want['replica_ids'][:k]}) — row k would change "
+                "identity across the reshape")
+
+
+def reshard_load(path: str, camp, *, expect_config: str | None = None,
+                 fresh=None):
+    """Restore checkpoint ``path`` into campaign ``camp`` at WHATEVER
+    replica extent ``camp`` has — grow, shrink, or same-size.
+
+    ``fresh`` — pre-built ``camp.init()`` (built on demand when omitted;
+    pass it when the caller already initialized, to avoid a second
+    compile).  ``expect_config`` refuses foreign scenarios exactly like
+    ``checkpoint.load``.  Returns ``(state, meta)`` — ``meta`` is the
+    checkpoint manifest, so callers recover service/fleet bookkeeping
+    without a second read."""
+    raw, meta = ckpt_mod.load_raw(path)
+    if expect_config is not None:
+        got = meta.get("config_hash")
+        if got is not None and got != expect_config:
+            raise ValueError(
+                "checkpoint scenario mismatch: checkpoint was written "
+                f"by config {got} but this run is config "
+                f"{expect_config} ({path})")
+    _check_campaign_meta(meta, camp)
+    if fresh is None:
+        fresh = camp.init()
+    new_leaves, new_def = jax.tree.flatten(fresh)
+    if len(raw) != len(new_leaves):
+        raise ValueError(
+            "reshard fingerprint mismatch: checkpoint holds "
+            f"{len(raw)} leaves but the target campaign state has "
+            f"{len(new_leaves)}")
+    old = jax.tree.unflatten(new_def, raw)
+    return reshard_stacked(old, fresh), meta
+
+
+def _best_divisor(extent: int, n_devices: int) -> int:
+    """Largest device count ≤ n_devices dividing ``extent`` — the widest
+    mesh the leading axis shards onto evenly."""
+    for d in range(min(extent, n_devices), 0, -1):
+        if extent % d == 0:
+            return d
+    return 1
+
+
+def place_campaign(cs, n_devices: int | None = None):
+    """Re-establish replica-axis placement over the mesh available NOW.
+
+    Builds a REPLICA_AXIS mesh over the largest available device count
+    that divides the stacked extent (all of them when S % n_dev == 0,
+    degenerating to 1 — fully replicated placement — for prime
+    mismatches) and ``device_put``s the state onto it.  Layout only:
+    values are bit-identical before and after.  Returns
+    ``(state, mesh)`` so the caller can jit with matching shardings."""
+    leaves = jax.tree.leaves(cs)
+    s = _leading_extent(leaves, "state")
+    avail = len(jax.devices()) if n_devices is None else n_devices
+    mesh = mesh_mod.make_replica_mesh(_best_divisor(s, avail))
+    return mesh_mod.shard_campaign_state(cs, mesh), mesh
+
+
+def place_solo(state, n_devices: int | None = None):  # analysis: allow(device-sync)
+    """Node-axis analogue of :func:`place_campaign` for solo SimState:
+    NODE_AXIS mesh over the largest device count dividing N, state
+    placed with ``parallel/mesh.py`` ``state_shardings`` (telemetry
+    rings replicated as usual).  Returns ``(state, mesh)``.  The int()
+    here reads a static SHAPE, not a device value — no sync."""
+    n = int(np.shape(state.alive)[0])
+    avail = len(jax.devices()) if n_devices is None else n_devices
+    mesh = mesh_mod.make_mesh(_best_divisor(n, avail))
+    return mesh_mod.shard_state(state, mesh), mesh

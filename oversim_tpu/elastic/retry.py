@@ -1,0 +1,229 @@
+"""Failure taxonomy + retry/backoff for preemptible device capacity.
+
+Every on-chip measurement round since r03 has been lost to tunnel
+flakiness, preemptions, or deadline SIGKILLs rather than to simulation
+bugs (PERFORMANCE.md).  This module turns that class of failure from a
+run-killer into a tolerated condition:
+
+  * :func:`classify` — the taxonomy.  An exception raised by device
+    dispatch or backend bring-up is either TRANSIENT (tunnel stall,
+    connection reset, preempted/unavailable device, deadline, resource
+    exhaustion — retry with backoff) or FATAL (shape/type/value errors,
+    invalid arguments — a retry would fail identically; raise now).
+    Classification is by exception type first, then by status markers in
+    the message (XLA runtime errors surface as a generic RuntimeError
+    whose text carries the gRPC-style status).
+  * :func:`with_retry` — wrap any thunk in jittered exponential backoff
+    over transient failures.  The jitter is SEEDED
+    (``random.Random(policy.seed)``) so fleet workers retrying in lockstep
+    de-synchronize deterministically instead of thundering back onto the
+    tunnel together.
+  * :func:`acquire_backend` — bring-up with degradation: probe the
+    ambient jax backend under the retry policy; when chip acquisition
+    keeps failing transiently, pin ``JAX_PLATFORMS=cpu``, warn LOUDLY on
+    stderr, and return a manifest annotation (``degraded_to_cpu: True``
+    plus the attempt log) that rides into every artifact via
+    ``telemetry.run_manifest(extra={"elastic": ...})`` — a degraded run
+    is always distinguishable from a healthy one.
+
+No jax import at module scope: the whole point is to run BEFORE a
+backend exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import sys
+import time
+
+TRANSIENT = "transient"
+FATAL = "fatal"
+
+# exception TYPES that are transient wherever they appear: every flavor
+# of I/O, socket, and timeout failure the tunnel transport can surface
+_TRANSIENT_TYPES = (
+    ConnectionError,        # incl. BrokenPipeError / ConnectionResetError
+    TimeoutError,
+    InterruptedError,
+    OSError,                # tunnel fds, sockets, NFS checkpoints
+)
+
+# message markers of transient device/tunnel failures.  XLA runtime
+# errors reach Python as RuntimeError/XlaRuntimeError with a gRPC-style
+# status prefix in the text — match the text so we need no jaxlib import.
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline exceeded",
+    "deadline_exceeded",
+    "resource exhausted",
+    "resource_exhausted",
+    "aborted",
+    "cancelled",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "socket closed",
+    "tunnel",
+    "preempt",
+    "timed out",
+    "timeout",
+    "temporarily",
+    "try again",
+    "too many open files",
+    "failed to connect",
+    "transport",
+)
+
+# message markers that are FATAL even on an otherwise-transient type:
+# retrying an invalid program never helps
+_FATAL_MARKERS = (
+    "invalid_argument",
+    "invalid argument",
+    "failed_precondition",
+    "failed precondition",
+    "unimplemented",
+    "not_found",
+    "out_of_range",
+)
+
+# exception types where a retry would fail identically
+_FATAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                AttributeError, AssertionError, NotImplementedError)
+
+
+def classify(exc: BaseException) -> str:
+    """The failure taxonomy: ``"transient"`` (retry with backoff) or
+    ``"fatal"`` (raise immediately).  Unknown errors default to FATAL —
+    silently retrying a bug would hide it."""
+    text = f"{type(exc).__name__}: {exc}".lower()
+    for marker in _FATAL_MARKERS:
+        if marker in text:
+            return FATAL
+    if isinstance(exc, _FATAL_TYPES):
+        return FATAL
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return TRANSIENT
+    for marker in _TRANSIENT_MARKERS:
+        if marker in text:
+            return TRANSIENT
+    return FATAL
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff knobs.
+
+    ``seed`` makes the jitter DETERMINISTIC: two policies with the same
+    seed produce the same delay sequence (testable), and fleet workers
+    seeded by worker index de-synchronize reproducibly."""
+
+    attempts: int = 5           # total tries (first call included)
+    base_s: float = 0.5         # first backoff delay
+    factor: float = 2.0         # exponential growth per attempt
+    max_s: float = 30.0         # delay ceiling (pre-jitter)
+    jitter: float = 0.5         # delay *= 1 + uniform(0, jitter)
+    seed: int = 0
+
+
+def backoff_delays(policy: RetryPolicy) -> list:
+    """The policy's full delay schedule (``attempts - 1`` sleeps),
+    jittered by the seeded rng — pure, deterministic, unit-testable."""
+    rnd = random.Random(policy.seed)
+    out = []
+    for i in range(max(0, policy.attempts - 1)):
+        base = min(policy.max_s, policy.base_s * policy.factor ** i)
+        out.append(base * (1.0 + policy.jitter * rnd.random()))
+    return out
+
+
+def with_retry(fn, *, policy: RetryPolicy | None = None,
+               classify_fn=classify, on_retry=None, sleep=time.sleep,
+               label: str = ""):
+    """Call ``fn()`` under the retry policy.
+
+    Transient failures sleep the next backoff delay and retry; fatal
+    failures (and transient ones past the attempt budget) re-raise.
+    ``on_retry(attempt, delay_s, exc)`` observes every retry (the fleet
+    worker logs them into its heartbeat); ``sleep`` is injectable for
+    tests."""
+    policy = policy or RetryPolicy()
+    delays = backoff_delays(policy)
+    last = None
+    for attempt in range(policy.attempts):
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 — classified below
+            if classify_fn(exc) != TRANSIENT or attempt >= len(delays):
+                raise
+            last = exc
+            delay = delays[attempt]
+            if on_retry is not None:
+                on_retry(attempt, delay, exc)
+            else:
+                sys.stderr.write(
+                    "elastic.retry: %stransient failure (attempt %d/%d, "
+                    "retry in %.1fs): %s\n"
+                    % (f"{label}: " if label else "", attempt + 1,
+                       policy.attempts, delay, exc))
+            sleep(delay)
+    raise last  # pragma: no cover — loop always returns or raises
+
+
+def _default_probe():
+    """Touch the backend for real: device list + one tiny computation
+    through the whole dispatch path."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    jnp.zeros(()).block_until_ready()
+    return dev.platform
+
+
+def acquire_backend(policy: RetryPolicy | None = None, *, probe=None,
+                    sleep=time.sleep, environ=None) -> dict:
+    """Acquire a usable jax backend, degrading to CPU when the chip
+    keeps failing.
+
+    Runs ``probe`` (default: ``jax.devices()`` + a tiny dispatch) under
+    the retry policy.  Success returns
+    ``{"platform": ..., "degraded_to_cpu": False, "attempts": n}``.
+    When every attempt fails TRANSIENTLY (tunnel down, device
+    preempted), pins ``JAX_PLATFORMS=cpu`` in ``environ``, warns loudly,
+    and returns ``degraded_to_cpu: True`` with the final error — the
+    caller merges this dict into its run manifest
+    (``run_manifest(extra={"elastic": ann})``) so the degradation is
+    recorded on every artifact the run emits.  Fatal probe errors raise:
+    degradation is for capacity problems, not for bugs."""
+    policy = policy or RetryPolicy()
+    environ = os.environ if environ is None else environ
+    probe = probe or _default_probe
+    attempts = 0
+    last = None
+
+    def counted():
+        nonlocal attempts
+        attempts += 1
+        return probe()
+
+    try:
+        platform = with_retry(counted, policy=policy, sleep=sleep,
+                              label="backend acquisition")
+        return {"platform": str(platform), "degraded_to_cpu": False,
+                "attempts": attempts}
+    except BaseException as exc:  # noqa: BLE001 — classified below
+        if classify(exc) != TRANSIENT:
+            raise
+        last = exc
+    environ["JAX_PLATFORMS"] = "cpu"
+    sys.stderr.write(
+        "=" * 70 + "\n"
+        "elastic.retry: CHIP ACQUISITION FAILED after %d attempts — "
+        "DEGRADING to JAX_PLATFORMS=cpu.\n"
+        "elastic.retry: last error: %s\n"
+        "elastic.retry: every artifact of this run will carry "
+        "degraded_to_cpu=true in its manifest.\n" % (attempts, last)
+        + "=" * 70 + "\n")
+    return {"platform": "cpu", "degraded_to_cpu": True,
+            "attempts": attempts, "last_error": str(last)}

@@ -220,7 +220,9 @@ class ServiceLoop:
     # ---------------------------------------------------- lifecycle ----
     @classmethod
     def resume(cls, runner, example_state, params: ServiceParams, *,
-               path: str | None = None, config=None, **kw):
+               path: str | None = None, config=None,
+               override_cadence: bool = False, reshard: bool = False,
+               **kw):
         """Restore the last checkpoint and continue bit-identically.
 
         ``example_state`` supplies the pytree structure (``sim.init()``
@@ -230,7 +232,18 @@ class ServiceLoop:
         The checkpointed window cadence must match ``params``: a changed
         ``window_sim_s``/``chunk`` would move every subsequent window
         target and silently break the bit-identity guarantee, so it
-        raises instead."""
+        raises — unless ``override_cadence=True``, the explicit escape
+        hatch, which RE-ANCHORS the window origin at the restored clock
+        (next target = restored t_now + new window_sim_s; all later
+        targets recomputed from the new origin, never accumulated).
+        The caller trades the uninterrupted-run identity for the new
+        cadence, knowingly.
+
+        ``reshard=True`` restores at a DIFFERENT replica extent:
+        ``runner`` must be a Campaign, and the checkpointed stacked
+        state is grown/shrunk onto its extent via
+        ``oversim_tpu.elastic.reshard_load`` (surviving rows
+        bit-identical, grown rows deterministically re-seeded)."""
         from oversim_tpu import checkpoint as ckpt_mod
         path = path or params.checkpoint_path
         if path is None:
@@ -239,23 +252,58 @@ class ServiceLoop:
         if config is not None:
             from oversim_tpu import telemetry as telemetry_mod
             expect = telemetry_mod.config_hash(config)
-        state = ckpt_mod.load(path, example_state, expect_config=expect)
-        svc = ckpt_mod.read_meta(path).get("service") or {}
-        for name in ("window_sim_s", "chunk"):
-            have = svc.get(name)
-            if have is not None and have != getattr(params, name):
-                raise ValueError(
-                    f"resume cadence mismatch: checkpoint ran with "
-                    f"{name}={have} but params say {getattr(params, name)}"
-                    " — window targets would diverge from the"
-                    " uninterrupted run")
+        if reshard:
+            from oversim_tpu.elastic import reshard_load
+            state, meta = reshard_load(path, runner,
+                                       expect_config=expect,
+                                       fresh=example_state)
+            svc = meta.get("service") or {}
+        else:
+            state = ckpt_mod.load(path, example_state,
+                                  expect_config=expect)
+            svc = ckpt_mod.read_meta(path).get("service") or {}
+        mismatch = [name for name in ("window_sim_s", "chunk")
+                    if svc.get(name) is not None
+                    and svc.get(name) != getattr(params, name)]
+        windows_done = int(svc.get("windows_done", 0))
+        start_sim_t = svc.get("start_sim_t")
+        if mismatch and not override_cadence:
+            name = mismatch[0]
+            raise ValueError(
+                f"resume cadence mismatch: checkpoint ran with "
+                f"{name}={svc.get(name)} but params say "
+                f"{getattr(params, name)}"
+                " — window targets would diverge from the uninterrupted"
+                " run (pass override_cadence=True / --override-cadence"
+                " to re-anchor the window origin at the restored clock"
+                " instead)")
+        if mismatch:
+            # re-anchor: choose the origin that puts the NEXT window
+            # target one new-cadence window past the restored clock;
+            # subsequent targets are start + (k+1)*w from this origin —
+            # recomputed, never accumulated (pinned in test_service.py)
+            start_sim_t = (_min_sim_t(state.t_now)
+                           - windows_done * params.window_sim_s)
         return cls(runner, state, params, config=config,
-                   windows_done=int(svc.get("windows_done", 0)),
-                   start_sim_t=svc.get("start_sim_t"), **kw)
+                   windows_done=windows_done,
+                   start_sim_t=start_sim_t, **kw)
 
     def stop(self):
         """Request a graceful stop after the current window drains."""
         self._stop = True
+
+    def checkpoint_now(self) -> bool:
+        """Write a checkpoint of the CURRENT state immediately.
+
+        The graceful-shutdown path: a SIGTERM handler calls
+        :meth:`stop`, :meth:`run` drains the in-flight window, then the
+        caller invokes this so the final state is resumable even when
+        the cadence checkpoint isn't due.  Returns False when no
+        checkpoint path is configured."""
+        if not self.p.checkpoint_path:
+            return False
+        self._write_checkpoint(self.copy(self.state))
+        return True
 
     # ---------------------------------------------------- the loop -----
     def run(self, n_windows: int | None = None):
@@ -372,6 +420,10 @@ class ServiceLoop:
         meta = dict(self.checkpoint_meta)
         if self.config_hash is not None:
             meta.setdefault("config_hash", self.config_hash)
+        # reshard-aware meta: a Campaign runner records its identity so
+        # elastic.reshard_load can check grown-slot seeding at restore
+        if hasattr(self.runner, "describe"):
+            meta.setdefault("campaign", self.runner.describe())
         meta["service"] = {
             "windows_done": self.windows_done,
             "start_sim_t": self.start_sim_t,

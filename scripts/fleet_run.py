@@ -1,0 +1,425 @@
+"""Fleet supervisor: run a campaign across preemptible worker processes.
+
+Shards a campaign's replica grid (``elastic.shard_replicas`` +
+``CampaignParams.replica_ids``) across worker processes, each advancing
+its rows by fixed-tick ``run_chunk`` strides with an atomic checkpoint
+after every chunk.  The supervisor monitors heartbeat files, SIGKILLs
+workers on demand (built-in chaos mode: seeded random kills), and
+respawns dead or hung workers — each respawn resumes its shard from the
+latest checkpoint via ``elastic.reshard_load`` at whatever mesh shape
+is free.  When every shard finishes, the per-shard counter leaves are
+merged by global replica id into ONE ensemble report identical to an
+uninterrupted single-process run (``--verify`` proves it in-process,
+with EXACT per-replica counter equality).
+
+Usage:
+  python scripts/fleet_run.py --workers 2 --replicas 4 --ticks 96 \
+      --chunk 16 --n 64 --overlay chord --out /tmp/fleet
+  python scripts/fleet_run.py ... --chaos --kills 3 [--chaos-seed 7] \
+      [--chaos-span 6.0]       # seeded random SIGKILLs, still converges
+  python scripts/fleet_run.py ... --verify
+      # also run the uninterrupted reference in-process and demand
+      # exact ensemble equality (exit 2 on divergence)
+
+Determinism contract: workers and the reference BOTH advance by
+``run_chunk(chunk)`` strides (never ``run_until_device``, whose
+any-replica stop condition is stack-dependent), so every replica's
+final state is a pure function of (base_seed, replica id, ticks) —
+independent of sharding, kills, and resume points.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "scripts"))
+
+
+# ----------------------------------------------------------- scenario --
+
+
+def _scenario(args) -> dict:
+    """The scenario-defining config (hashed into checkpoints; shipped
+    to workers verbatim via the spec file)."""
+    return {"overlay": args.overlay, "n": args.n, "seed": args.seed,
+            "churn": args.churn, "lifetime": args.lifetime,
+            "interval": args.interval,
+            "engine_window": args.engine_window,
+            "replicas": args.replicas, "ticks": args.ticks,
+            "chunk": args.chunk}
+
+
+def _build_campaign(scn: dict, replica_ids=None):
+    import service_run
+    from oversim_tpu.campaign import Campaign, CampaignParams
+
+    ns = argparse.Namespace(
+        overlay=scn["overlay"], n=scn["n"], churn=scn["churn"],
+        lifetime=scn["lifetime"], interval=scn["interval"],
+        engine_window=scn["engine_window"], telemetry=0,
+        telemetry_window=256)
+    sim = service_run._build_sim(ns)
+    p = CampaignParams(
+        replicas=scn["replicas"], base_seed=scn["seed"],
+        replica_ids=None if replica_ids is None else tuple(replica_ids))
+    return Campaign(sim, p)
+
+
+def _final_leaves(state):
+    """Host copies of the per-window counter leaves (no telemetry —
+    fleet artifacts carry only what the ensemble summary needs)."""
+    import jax
+    from oversim_tpu.service.loop import counter_leaf_refs
+    leaves = counter_leaf_refs(state)
+    leaves.pop("telemetry", None)
+    return jax.device_get(leaves)
+
+
+def _run_reference(scn: dict):
+    """The uninterrupted single-process run: the full campaign advanced
+    by the same run_chunk cadence the workers use."""
+    camp = _build_campaign(scn)
+    cs = camp.init()
+    for _ in range(scn["ticks"] // scn["chunk"]):
+        cs = camp.run_chunk(cs, scn["chunk"])
+    return _final_leaves(cs)
+
+
+# ------------------------------------------------------------- worker --
+
+
+def _worker_main(spec_path: str) -> int:
+    import service_run
+    spec = json.load(open(spec_path))
+    service_run._setup_jax(spec.get("platform", "cpu"))
+
+    import jax
+    from oversim_tpu import checkpoint as ckpt_mod
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.elastic import (RetryPolicy, acquire_backend,
+                                     backoff_delays, classify, fleet,
+                                     place_campaign, reshard_load)
+    from oversim_tpu.elastic.retry import FATAL
+
+    scn = spec["scenario"]
+    widx = spec["worker"]
+    chunk = scn["chunk"]
+    ticks = spec["ticks"]
+    ckpt_path = spec["checkpoint"]
+    cfg_hash = telemetry_mod.config_hash(scn)
+    policy = RetryPolicy(attempts=spec.get("retry_attempts", 4),
+                         base_s=0.2, seed=widx)
+
+    # backend bring-up under the retry policy; a persistent transient
+    # failure degrades to CPU with a loud manifest annotation
+    ann = acquire_backend(policy)
+
+    camp = _build_campaign(scn, replica_ids=spec["replica_ids"])
+    fresh = camp.init()
+    ticks_done, retries = 0, 0
+    if os.path.exists(ckpt_path):
+        state, meta = reshard_load(ckpt_path, camp,
+                                   expect_config=cfg_hash, fresh=fresh)
+        ticks_done = int((meta.get("fleet") or {}).get("ticks_done", 0))
+    else:
+        state = fresh
+    # placement over whatever mesh is free NOW (1 device on a plain CPU
+    # worker; the widest replica-dividing mesh on a pod)
+    state, mesh = place_campaign(state)
+
+    def checkpoint():
+        ckpt_mod.save(ckpt_path, state, meta={
+            "config_hash": cfg_hash,
+            "campaign": camp.describe(),
+            "fleet": {"ticks_done": ticks_done, "worker": widx,
+                      "retries": retries,
+                      "degraded_to_cpu": ann["degraded_to_cpu"]}})
+
+    def heartbeat():
+        fleet.write_heartbeat(spec["heartbeat"], worker=widx,
+                              ticks_done=ticks_done, ticks=ticks,
+                              retries=retries)
+
+    heartbeat()
+    delays = backoff_delays(policy)
+    while ticks_done < ticks:
+        try:
+            nxt = camp.run_chunk(state, chunk)
+            jax.block_until_ready(nxt)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            # run_chunk DONATES its input: after any failure the old
+            # buffers are unusable, so transient recovery is restore-
+            # from-checkpoint, never a naive re-call
+            if classify(exc) == FATAL or not os.path.exists(ckpt_path):
+                raise
+            if retries >= len(delays):
+                raise
+            time.sleep(delays[retries])
+            retries += 1
+            fresh = camp.init()
+            state, meta = reshard_load(ckpt_path, camp,
+                                       expect_config=cfg_hash,
+                                       fresh=fresh)
+            ticks_done = int(
+                (meta.get("fleet") or {}).get("ticks_done", 0))
+            state, mesh = place_campaign(state)
+            continue
+        state = nxt
+        ticks_done += chunk
+        checkpoint()
+        heartbeat()
+
+    fleet.write_json_atomic(spec["artifact"], {
+        "done": True, "worker": widx,
+        "replica_ids": list(spec["replica_ids"]),
+        "ticks_done": ticks_done, "retries": retries,
+        "elastic": ann,
+        "leaves": fleet.encode_leaves(_final_leaves(state))})
+    return 0
+
+
+# --------------------------------------------------------- supervisor --
+
+
+class _Worker:
+    def __init__(self, idx, spec_path, log_path):
+        self.idx = idx
+        self.spec_path = spec_path
+        self.log_path = log_path
+        self.proc = None
+        self.spawned_at = 0.0
+        self.respawns = 0
+        self.done = False
+        self.kills = 0
+
+    def spawn(self):
+        log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", "--spec", self.spec_path],
+            stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        self.spawned_at = time.monotonic()
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self):
+        if self.alive():
+            os.kill(self.proc.pid, signal.SIGKILL)
+            self.proc.wait()
+            self.kills += 1
+            return True
+        return False
+
+
+def _supervise(args) -> int:
+    from oversim_tpu.elastic import fleet
+
+    scn = _scenario(args)
+    if args.ticks % args.chunk:
+        raise SystemExit("--ticks must be a multiple of --chunk "
+                         "(fixed-stride determinism contract)")
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    shards = fleet.shard_replicas(args.replicas, args.workers)
+    workers = []
+    for w, ids in enumerate(shards):
+        spec = {"worker": w, "scenario": scn, "replica_ids": list(ids),
+                "ticks": args.ticks, "platform": args.platform or "cpu",
+                "checkpoint": str(out / f"shard{w}.ckpt.npz"),
+                "heartbeat": str(out / f"shard{w}.heartbeat.json"),
+                "artifact": str(out / f"shard{w}.artifact.json")}
+        spec_path = str(out / f"shard{w}.spec.json")
+        fleet.write_json_atomic(spec_path, spec)
+        workers.append(_Worker(w, spec_path, str(out / f"shard{w}.log")))
+
+    chaos = (fleet.chaos_schedule(args.kills, len(workers),
+                                  args.chaos_seed, span_s=args.chaos_span)
+             if args.chaos else [])
+    print(json.dumps({"phase": "fleet_start", "workers": len(workers),
+                      "shards": [list(s) for s in shards],
+                      "chaos": chaos}), flush=True)
+
+    t0 = time.monotonic()
+    for w in workers:
+        w.spawn()
+    pending_chaos = list(chaos)
+    executed_kills = []
+    fail = None
+    while True:
+        now = time.monotonic() - t0
+        # seeded chaos kills: SIGKILL scheduled workers that are still
+        # running (a finished shard can't be killed — recorded as a
+        # no-op so the report stays honest about delivered chaos)
+        while pending_chaos and pending_chaos[0][0] <= now:
+            delay, w_idx = pending_chaos.pop(0)
+            landed = workers[w_idx].kill()
+            executed_kills.append({"delay_s": round(delay, 3),
+                                   "worker": w_idx, "landed": landed})
+            if landed:
+                print(json.dumps({"phase": "chaos_kill",
+                                  "worker": w_idx,
+                                  "t": round(now, 2)}), flush=True)
+        for w in workers:
+            if w.done:
+                continue
+            art = fleet.read_json(
+                json.load(open(w.spec_path))["artifact"])
+            if art and art.get("done"):
+                w.done = True
+                if w.alive():
+                    w.proc.wait()
+                continue
+            if not w.alive():
+                # died without finishing: reschedule; the respawn
+                # resumes from the shard's latest checkpoint
+                if w.respawns >= args.max_respawns:
+                    fail = f"worker {w.idx} exceeded --max-respawns"
+                    break
+                w.respawns += 1
+                print(json.dumps({"phase": "respawn", "worker": w.idx,
+                                  "n": w.respawns}), flush=True)
+                w.spawn()
+            elif (time.monotonic() - w.spawned_at
+                    > args.heartbeat_timeout):
+                spec = json.load(open(w.spec_path))
+                age = fleet.heartbeat_age(spec["heartbeat"])
+                if age is not None and age > args.heartbeat_timeout:
+                    # hung, not dead: SIGKILL and let the respawn
+                    # branch above reschedule it next poll
+                    print(json.dumps({"phase": "hang_kill",
+                                      "worker": w.idx,
+                                      "heartbeat_age_s": round(age, 1)}),
+                          flush=True)
+                    w.kill()
+        if fail:
+            break
+        if all(w.done for w in workers):
+            break
+        if now > args.deadline:
+            fail = f"fleet deadline ({args.deadline}s) exceeded"
+            break
+        time.sleep(args.poll_s)
+
+    if fail:
+        for w in workers:
+            w.kill()
+        print(json.dumps({"phase": "fleet_fail", "error": fail}),
+              flush=True)
+        return 1
+
+    # ------------------------------------------------------- merge ----
+    # the merge itself is pure host numpy, but the manifest and the
+    # --verify reference touch jax — same backend setup as the workers
+    # (x64 on, cpu flags) so the reference runs the workers' program
+    import service_run
+    service_run._setup_jax(args.platform or "cpu")
+    arts = []
+    for w in workers:
+        spec = json.load(open(w.spec_path))
+        arts.append(fleet.read_json(spec["artifact"]))
+    merged = fleet.merge_shard_leaves(
+        [(a["replica_ids"], fleet.decode_leaves(a["leaves"]))
+         for a in arts],
+        total=args.replicas)
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.service.loop import campaign_summarize_leaves
+    summary = campaign_summarize_leaves(merged)
+    elastic_ann = {
+        "chaos": bool(args.chaos), "chaos_seed": args.chaos_seed,
+        "kills_requested": args.kills if args.chaos else 0,
+        "kills_landed": sum(1 for k in executed_kills if k["landed"]),
+        "kill_log": executed_kills,
+        "respawns": {w.idx: w.respawns for w in workers},
+        "worker_retries": {a["worker"]: a["retries"] for a in arts},
+        "degraded_to_cpu": any(a["elastic"]["degraded_to_cpu"]
+                               for a in arts),
+    }
+    report = {
+        "summary": summary,
+        "fleet": {"workers": len(workers),
+                  "shards": [list(s) for s in shards],
+                  "ticks": args.ticks, "chunk": args.chunk,
+                  "wall_s": round(time.monotonic() - t0, 2),
+                  **elastic_ann},
+        "manifest": telemetry_mod.run_manifest(
+            config=scn, extra={"elastic": elastic_ann}),
+    }
+
+    verdict = 0
+    if args.verify:
+        ref_leaves = _run_reference(scn)
+        ref_summary = campaign_summarize_leaves(ref_leaves)
+        leaves_ok = fleet.encode_leaves(merged) == fleet.encode_leaves(
+            ref_leaves)
+        summary_ok = (json.dumps(summary, sort_keys=True)
+                      == json.dumps(ref_summary, sort_keys=True))
+        report["verify"] = {"leaves_equal": leaves_ok,
+                            "summary_equal": summary_ok}
+        if leaves_ok and summary_ok:
+            print("VERIFY OK: fleet ensemble == uninterrupted run "
+                  "(exact counter equality)", flush=True)
+        else:
+            print("VERIFY FAIL: fleet ensemble diverged from the "
+                  "uninterrupted run", flush=True)
+            verdict = 2
+
+    fleet.write_json_atomic(str(out / "fleet_report.json"), report)
+    print(json.dumps({"phase": "fleet_done",
+                      "kills_landed": elastic_ann["kills_landed"],
+                      "respawns": sum(w.respawns for w in workers),
+                      "wall_s": report["fleet"]["wall_s"]}), flush=True)
+    return verdict
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true",
+                    help="internal: run one shard (needs --spec)")
+    ap.add_argument("--spec", default=None)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=4)
+    ap.add_argument("--ticks", type=int, default=96,
+                    help="run_chunk ticks per replica (multiple of "
+                    "--chunk)")
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--overlay", default="chord",
+                    choices=["kademlia", "chord"])
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--churn", default="none")
+    ap.add_argument("--lifetime", type=float, default=10_000.0)
+    ap.add_argument("--interval", type=float, default=0.2)
+    ap.add_argument("--engine-window", type=float, default=0.2)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--out", default="/tmp/oversim_fleet")
+    ap.add_argument("--chaos", action="store_true",
+                    help="seeded random SIGKILLs of running workers")
+    ap.add_argument("--kills", type=int, default=1)
+    ap.add_argument("--chaos-seed", type=int, default=7)
+    ap.add_argument("--chaos-span", type=float, default=6.0)
+    ap.add_argument("--verify", action="store_true",
+                    help="also run the uninterrupted reference and "
+                    "demand exact ensemble equality")
+    ap.add_argument("--heartbeat-timeout", type=float, default=120.0)
+    ap.add_argument("--max-respawns", type=int, default=8)
+    ap.add_argument("--deadline", type=float, default=900.0)
+    ap.add_argument("--poll-s", type=float, default=0.2)
+    args = ap.parse_args()
+    if args.worker:
+        if not args.spec:
+            raise SystemExit("--worker needs --spec")
+        return _worker_main(args.spec)
+    return _supervise(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

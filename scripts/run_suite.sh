@@ -58,4 +58,22 @@ else
   status=1
   echo "FAIL  service_smoke  $(tail -1 "$STATE/service_smoke.log")"
 fi
+# elastic-fleet chaos smoke (scripts/fleet_run.py): 2 workers sharding a
+# 4-replica campaign, 3 seeded SIGKILLs + reschedule-from-checkpoint,
+# then --verify pins the merged ensemble EXACTLY equal (counter leaves
+# and summary) to an uninterrupted single-process run
+fleet_marker="$STATE/fleet_smoke.ok"
+if [ -f "$fleet_marker" ]; then
+  echo "skip  fleet_smoke (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/fleet_run.py --workers 2 --replicas 4 --ticks 64 \
+      --chunk 16 --n 8 --overlay chord --chaos --kills 3 \
+      --chaos-span 25 --verify --out "$STATE/fleet_smoke.out" \
+      > "$STATE/fleet_smoke.log" 2>&1; then
+  touch "$fleet_marker"
+  echo "PASS  fleet_smoke  $(tail -1 "$STATE/fleet_smoke.log")"
+else
+  status=1
+  echo "FAIL  fleet_smoke  $(tail -1 "$STATE/fleet_smoke.log")"
+fi
 exit $status

@@ -113,6 +113,16 @@ def main():
     ap.add_argument("--resume", action="store_true",
                     help="restore the checkpoint and continue "
                     "bit-identically")
+    ap.add_argument("--override-cadence", action="store_true",
+                    help="resume despite a changed window_sim_s/chunk: "
+                    "re-anchor the window origin at the restored clock "
+                    "instead of refusing (trades the uninterrupted-run "
+                    "identity for the new cadence)")
+    ap.add_argument("--reshard", action="store_true",
+                    help="resume a campaign checkpoint at THIS run's "
+                    "--replicas even when it differs: surviving "
+                    "replicas restored bit-identically, grown slots "
+                    "re-seeded deterministically (oversim_tpu/elastic)")
     ap.add_argument("--single-buffer", action="store_true",
                     help="disable the dispatch/fetch pipeline")
     ap.add_argument("--replicas", type=int, default=0, metavar="S",
@@ -143,13 +153,14 @@ def main():
 
     # the scenario-defining config (hashed into checkpoints; resume
     # refuses a checkpoint whose hash differs) — run-shape flags like
-    # --windows/--out/--resume deliberately excluded
+    # --windows/--out/--resume deliberately excluded.  --replicas is
+    # run shape too: the per-replica scenario is identical at any
+    # ensemble size, and hashing it would veto every --reshard resume
     config = {"ini": args.ini, "config": args.config,
               "overlay": args.overlay, "n": args.n, "seed": args.seed,
               "churn": args.churn, "lifetime": args.lifetime,
               "interval": args.interval,
               "engine_window": args.engine_window,
-              "replicas": args.replicas,
               "telemetry": {"sampleTicks": args.telemetry,
                             "window": args.telemetry_window}}
 
@@ -206,18 +217,41 @@ def main():
     kw = dict(config=config, on_window=on_window, trace=trace,
               summarize=summarize)
     if args.resume:
-        loop = ServiceLoop.resume(runner, example, params, **kw)
+        if args.reshard and not args.replicas:
+            raise SystemExit("--reshard needs --replicas (campaign "
+                             "checkpoints only)")
+        loop = ServiceLoop.resume(runner, example, params,
+                                  override_cadence=args.override_cadence,
+                                  reshard=args.reshard, **kw)
         print(json.dumps({"phase": "resume",
                           "windows_done": loop.windows_done,
-                          "start_sim_t": loop.start_sim_t}), flush=True)
+                          "start_sim_t": loop.start_sim_t,
+                          "reshard": args.reshard,
+                          "override_cadence": args.override_cadence}),
+              flush=True)
     else:
         loop = ServiceLoop(runner, example, params, **kw)
+
+    # graceful SIGTERM: finish the in-flight window, write a final
+    # checkpoint + complete artifact manifest, exit 0 (the SIGKILL path
+    # — torn nothing, resumable checkpoint — is service_smoke's pin)
+    got_term = []
+
+    def _on_sigterm(signum, frame):
+        got_term.append(signum)
+        loop.stop()
+
+    import signal
+    signal.signal(signal.SIGTERM, _on_sigterm)
 
     state, done = loop.run(n_windows=args.windows)
     final = {"phase": "final", "windows_done": done,
              "checkpoints_written": loop.checkpoints_written,
              "last_checkpoint": loop.last_checkpoint,
              "wall_s": round(time.perf_counter() - t0, 2)}
+    if got_term:
+        final["sigterm"] = True
+        final["final_checkpoint"] = loop.checkpoint_now()
     artifact.add(final)
     if trace is not None:
         trace.write(args.trace)

@@ -214,7 +214,8 @@ def test_hlo_breakdown_reexports_are_the_registry_helpers():
 def test_registry_shape():
     names = list(contracts_mod.REGISTRY)
     assert names == ["solo_tick", "solo_chunk", "run_until_device",
-                     "campaign_tick", "telemetry_tick", "service_window"]
+                     "campaign_tick", "telemetry_tick", "service_window",
+                     "resharded_resume"]
     tel = contracts_mod.REGISTRY["telemetry_tick"]
     assert tel.delta is not None and tel.delta.base == "solo_tick"
     for donated in ("solo_chunk", "run_until_device", "service_window"):

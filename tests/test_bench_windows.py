@@ -142,6 +142,43 @@ def test_untelemetried_fake_state_fetch_has_no_telemetry_key():
     assert leaves["tick"] == 5
 
 
+def test_stop_event_finishes_in_flight_window_then_exits():
+    """Graceful SIGTERM: the handler sets a threading.Event; the loop
+    checks it at window boundaries only, so a signal landing MID-window
+    lets that window complete (its on_window summary is emitted) and
+    stops before the next — never a torn window."""
+    import threading
+
+    stop = threading.Event()
+    summaries = []
+
+    class SimThatGetsSignalled(FakeSim):
+        def run_until_device(self, s, t_sim, chunk=256):
+            if len(self.device_calls) == 1:
+                stop.set()          # "SIGTERM" arrives mid-window 2
+            return super().run_until_device(s, t_sim, chunk=chunk)
+
+    sim = SimThatGetsSignalled()
+    # the wall budget alone would allow 3+ windows (cf. the first test)
+    s, windows = bench.run_measurement_windows(
+        sim, FakeState(), start_sim_t=100.0, window_sim_s=6.25,
+        measure_wall=55.0, chunk=32,
+        on_window=lambda out, wall: summaries.append(out),
+        now=FakeClock(dt=10.0), stop=stop)
+    assert windows == 2                        # window 2 completed ...
+    assert len(sim.device_calls) == 2          # ... and no window 3
+    assert [out["fake_counter"] for out in summaries] == [32, 64]
+    assert s.tick == 64
+
+    # an already-set event stops before the first window
+    sim2 = FakeSim()
+    _, w0 = bench.run_measurement_windows(
+        sim2, FakeState(), start_sim_t=0.0, window_sim_s=1.0,
+        measure_wall=55.0, chunk=8, on_window=lambda out, wall: None,
+        now=FakeClock(dt=10.0), stop=stop)
+    assert w0 == 0 and sim2.device_calls == []
+
+
 def test_host_loop_mode_uses_run_until_with_invariants():
     """OVERSIM_INVARIANTS=1 debug tier: the per-chunk-synced run_until
     (with the structural validator on) replaces the device loop."""

@@ -93,6 +93,76 @@ def test_v1_checkpoint_still_loads(tmp_path):
     np.testing.assert_array_equal(np.asarray(out["y"]), state["y"])
 
 
+def test_v1_campaign_stacked_checkpoint_reshard_loads(tmp_path):
+    """v1 back-compat on CAMPAIGN-STACKED state: a hand-written v1 file
+    of [S]-stacked leaves (no __meta__, so no campaign identity record)
+    must restore through BOTH checkpoint.load and the elastic reshard
+    path — load_raw reports the v1 format, the meta checks are skipped
+    (nothing recorded = nothing to refuse), and a grow keeps the v1
+    rows bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    from oversim_tpu.elastic import reshard_stacked
+
+    path = str(tmp_path / "v1camp.npz")
+    stacked = {"x": np.arange(6, dtype=np.int64).reshape(2, 3),
+               "y": np.full((2, 4), 7.0, np.float64)}
+    leaves = jax.tree.leaves(stacked)
+    with open(path, "wb") as f:
+        np.savez_compressed(
+            f, __format__=np.asarray(ckpt.FORMAT_V1),
+            __fingerprint__=np.asarray(ckpt._fingerprint(
+                [np.asarray(x) for x in leaves])),
+            **{f"leaf{i}": np.asarray(x) for i, x in enumerate(leaves)})
+
+    raw, meta = ckpt.load_raw(path)
+    assert meta == {"format": ckpt.FORMAT_V1}
+    old = jax.tree.unflatten(jax.tree.structure(stacked), raw)
+    fresh = {"x": jnp.zeros((5, 3), jnp.int64),
+             "y": jnp.ones((5, 4), jnp.float64)}
+    grown = reshard_stacked(old, fresh)
+    np.testing.assert_array_equal(np.asarray(grown["x"])[:2],
+                                  stacked["x"])
+    np.testing.assert_array_equal(np.asarray(grown["y"])[2:],
+                                  np.ones((3, 4)))
+    # ... and the same file still loads same-shape via checkpoint.load
+    out = ckpt.load(path, jax.tree.map(np.zeros_like, stacked))
+    np.testing.assert_array_equal(np.asarray(out["x"]), stacked["x"])
+
+    # negative pin: a shape-mismatched reshard fails with the
+    # fingerprint error, never silently corrupts
+    with pytest.raises(ValueError, match="reshard fingerprint mismatch"):
+        reshard_stacked(old, {"x": jnp.zeros((5, 9), jnp.int64),
+                              "y": jnp.ones((5, 4), jnp.float64)})
+
+
+def test_save_tolerates_directory_fsync_refusal(tmp_path, monkeypatch):
+    """Some network/overlay filesystems refuse fsync on directory fds
+    (EINVAL).  The post-rename directory fsync must swallow that —
+    rename-level atomicity still holds — and the checkpoint must land
+    complete and loadable."""
+    import stat
+
+    real_fsync = os.fsync
+    synced_dirs = []
+
+    def picky_fsync(fd):
+        if stat.S_ISDIR(os.fstat(fd).st_mode):
+            synced_dirs.append(fd)
+            raise OSError(22, "Invalid argument")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", picky_fsync)
+    path = str(tmp_path / "ck.npz")
+    state = {"a": np.arange(4, dtype=np.int64)}
+    ckpt.save(path, state)                 # must not raise
+    assert synced_dirs, "directory fsync was attempted"
+    assert not os.path.exists(path + ".tmp")
+    out = ckpt.load(path, {"a": np.zeros(4, np.int64)})
+    np.testing.assert_array_equal(np.asarray(out["a"]), state["a"])
+
+
 def test_meta_auto_fills_tick_and_service_extras(tmp_path):
     """tick/t_now are read off states that carry them; caller extras
     (the service loop's window bookkeeping) round-trip via JSON."""

@@ -1,0 +1,402 @@
+"""Elastic-fleet machinery pins (oversim_tpu/elastic/) — fast tier.
+
+Everything here runs WITHOUT compiling a simulation: the failure
+taxonomy, the seeded backoff schedule, backend degradation, the
+synthetic reshard grow/shrink identity (including the loud
+fingerprint-mismatch refusals), campaign ``replica_ids`` subsetting, and
+the supervisor's host-side shard/merge/heartbeat/chaos helpers.  The
+real-sim reshard identities live in tests/test_zz_elastic.py.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from oversim_tpu import checkpoint as ckpt_mod
+from oversim_tpu.campaign import Campaign, CampaignParams
+from oversim_tpu.elastic import (FATAL, TRANSIENT, RetryPolicy,
+                                 acquire_backend, backoff_delays,
+                                 chaos_schedule, classify, decode_leaves,
+                                 encode_leaves, heartbeat_age,
+                                 merge_shard_leaves, read_json,
+                                 replica_fingerprint, reshard_load,
+                                 reshard_stacked, shard_replicas,
+                                 with_retry, write_heartbeat,
+                                 write_json_atomic)
+
+
+# -- failure taxonomy --------------------------------------------------------
+
+
+def test_classify_taxonomy():
+    # transient by type: the whole I/O family retries
+    assert classify(ConnectionResetError("peer reset")) == TRANSIENT
+    assert classify(BrokenPipeError("pipe")) == TRANSIENT
+    assert classify(TimeoutError()) == TRANSIENT
+    assert classify(OSError("nfs is sad")) == TRANSIENT
+    # transient by marker: XLA runtime errors arrive as RuntimeError
+    # with a gRPC-style status in the text
+    assert classify(RuntimeError("UNAVAILABLE: tunnel went away")) \
+        == TRANSIENT
+    assert classify(RuntimeError("DEADLINE_EXCEEDED: 30s")) == TRANSIENT
+    assert classify(RuntimeError("device preempted by scheduler")) \
+        == TRANSIENT
+    assert classify(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == TRANSIENT
+    # fatal by type: a retry would fail identically
+    assert classify(ValueError("bad shape")) == FATAL
+    assert classify(TypeError("no")) == FATAL
+    assert classify(AssertionError()) == FATAL
+    # fatal markers OUTRANK transient types/markers: an OSError carrying
+    # INVALID_ARGUMENT is a program bug, not a capacity problem
+    assert classify(OSError("INVALID_ARGUMENT: bad buffer")) == FATAL
+    assert classify(RuntimeError("UNIMPLEMENTED: collective")) == FATAL
+    # and a fatal TYPE stays fatal even when the text smells transient
+    assert classify(ValueError("timeout while parsing")) == FATAL
+    # unknown errors default fatal — silently retrying a bug hides it
+    assert classify(RuntimeError("some novel failure")) == FATAL
+
+
+def test_backoff_delays_seeded_and_capped():
+    p = RetryPolicy(attempts=6, base_s=1.0, factor=4.0, max_s=10.0,
+                    jitter=0.5, seed=3)
+    d1, d2 = backoff_delays(p), backoff_delays(p)
+    assert d1 == d2                       # same seed -> same schedule
+    assert len(d1) == p.attempts - 1
+    bases = [min(p.max_s, p.base_s * p.factor ** i) for i in range(5)]
+    for d, b in zip(d1, bases):
+        assert b <= d <= b * (1 + p.jitter)
+    # pre-jitter ceiling engaged: last delays never exceed max*(1+jitter)
+    assert max(d1) <= p.max_s * (1 + p.jitter)
+    # different seeds de-synchronize (fleet workers seeded by index)
+    assert backoff_delays(RetryPolicy(attempts=6, seed=0)) \
+        != backoff_delays(RetryPolicy(attempts=6, seed=1))
+    assert backoff_delays(RetryPolicy(attempts=1)) == []
+
+
+def test_with_retry_transient_then_success():
+    p = RetryPolicy(attempts=5, base_s=0.1, seed=7)
+    slept, seen, calls = [], [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("blip")
+        return "ok"
+
+    out = with_retry(flaky, policy=p, sleep=slept.append,
+                     on_retry=lambda a, d, e: seen.append((a, d)))
+    assert out == "ok" and len(calls) == 3
+    # slept exactly the policy's first two delays, observed by on_retry
+    assert slept == backoff_delays(p)[:2]
+    assert [a for a, _ in seen] == [0, 1]
+    assert [d for _, d in seen] == slept
+
+
+def test_with_retry_fatal_immediate_and_exhaustion():
+    slept = []
+    with pytest.raises(ValueError, match="bad program"):
+        with_retry(lambda: (_ for _ in ()).throw(ValueError("bad program")),
+                   sleep=slept.append)
+    assert slept == []                    # fatal never sleeps
+
+    p = RetryPolicy(attempts=3, base_s=0.1, seed=0)
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        with_retry(always_down, policy=p, sleep=slept.append)
+    assert len(calls) == p.attempts       # budget honored exactly
+    assert slept == backoff_delays(p)     # attempts-1 sleeps
+
+
+def test_acquire_backend_success_and_degradation():
+    ok = acquire_backend(RetryPolicy(attempts=2), probe=lambda: "axon",
+                         sleep=lambda _: None, environ={})
+    assert ok == {"platform": "axon", "degraded_to_cpu": False,
+                  "attempts": 1}
+
+    env = {}
+    ann = acquire_backend(
+        RetryPolicy(attempts=3, base_s=0.01),
+        probe=lambda: (_ for _ in ()).throw(
+            RuntimeError("UNAVAILABLE: tunnel down")),
+        sleep=lambda _: None, environ=env)
+    # degraded: environment pinned to cpu, annotation is LOUD and
+    # manifest-ready (rides into run_manifest(extra={"elastic": ann}))
+    assert env == {"JAX_PLATFORMS": "cpu"}
+    assert ann["degraded_to_cpu"] is True
+    assert ann["platform"] == "cpu" and ann["attempts"] == 3
+    assert "tunnel down" in ann["last_error"]
+
+    # fatal probe errors raise: degradation is for capacity, not bugs
+    env2 = {}
+    with pytest.raises(ValueError):
+        acquire_backend(RetryPolicy(attempts=3),
+                        probe=lambda: (_ for _ in ()).throw(
+                            ValueError("bad build")),
+                        sleep=lambda _: None, environ=env2)
+    assert env2 == {}
+
+
+# -- synthetic reshard -------------------------------------------------------
+
+
+def _stacked(s, fill=0.0, dtype=np.float32):
+    """A campaign-stacked-shaped pytree: every leaf leads with [s]."""
+    return {
+        "a": jnp.asarray(np.arange(s * 3).reshape(s, 3) + fill, dtype),
+        "b": jnp.asarray(np.arange(s) + int(fill), jnp.int64),
+    }
+
+
+def test_reshard_stacked_grow_shrink_roundtrip():
+    old = _stacked(2, fill=100.0)
+    fresh = _stacked(5, fill=0.0)
+    grown = reshard_stacked(old, fresh)
+    # surviving rows 0..1 are the checkpointed arrays UNCHANGED
+    np.testing.assert_array_equal(grown["a"][:2], old["a"])
+    np.testing.assert_array_equal(grown["b"][:2], old["b"])
+    # grown rows come verbatim from fresh (deterministic re-seed slots)
+    np.testing.assert_array_equal(grown["a"][2:], fresh["a"][2:])
+    np.testing.assert_array_equal(grown["b"][2:], fresh["b"][2:])
+    # shrink straight back: bit-identical round trip for the survivors
+    back = reshard_stacked(grown, _stacked(2))
+    for k in ("a", "b"):
+        np.testing.assert_array_equal(back[k], old[k])
+        assert back[k].dtype == old[k].dtype
+    # same-size reshard is the identity on values
+    same = reshard_stacked(old, _stacked(2))
+    np.testing.assert_array_equal(same["a"], old["a"])
+
+
+def test_reshard_stacked_refusals():
+    old = _stacked(2)
+    # trailing-shape mismatch -> loud fingerprint error, never silent
+    bad = {"a": jnp.zeros((5, 4), jnp.float32),
+           "b": jnp.zeros((5,), jnp.int64)}
+    with pytest.raises(ValueError, match="reshard fingerprint mismatch"):
+        reshard_stacked(old, bad)
+    # dtype is part of the per-replica fingerprint too
+    baddt = {"a": jnp.zeros((5, 3), jnp.float64),
+             "b": jnp.zeros((5,), jnp.int64)}
+    with pytest.raises(ValueError, match="reshard fingerprint mismatch"):
+        reshard_stacked(old, baddt)
+    # pytree structure mismatch
+    with pytest.raises(ValueError, match="pytree structure"):
+        reshard_stacked(old, {"a": jnp.zeros((5, 3), jnp.float32)})
+    # scalar leaf = not stacked state
+    with pytest.raises(ValueError, match="scalar"):
+        reshard_stacked({"a": jnp.zeros((2, 3)), "b": jnp.float32(0)},
+                        {"a": jnp.zeros((5, 3)), "b": jnp.float32(0)})
+
+
+def test_replica_fingerprint_is_extent_independent():
+    assert replica_fingerprint(_stacked(2)) \
+        == replica_fingerprint(_stacked(8))
+    assert replica_fingerprint(_stacked(2)) != replica_fingerprint(
+        {"a": jnp.zeros((2, 4), jnp.float32),
+         "b": jnp.zeros((2,), jnp.int64)})
+
+
+class _FakeCamp:
+    """Quacks like Campaign for reshard_load: describe() + init() + grid."""
+
+    def __init__(self, s, base_seed=1, sweep=(), replicas=None,
+                 replica_ids=None, fill=0.0):
+        self._s = s
+        self._fill = fill
+        self.grid = [{}] if not sweep else [dict(p) for p in
+                                            ({"x": v} for _, vs in sweep
+                                             for v in vs)]
+        self._desc = {
+            "replicas": s if replicas is None else replicas,
+            "base_seed": base_seed,
+            "sweep": [[n, list(v)] for n, v in sweep],
+            "replica_ids": (list(range(s)) if replica_ids is None
+                            else list(replica_ids)),
+            "s": s, "total": s,
+        }
+
+    def describe(self):
+        return dict(self._desc)
+
+    def init(self):
+        return _stacked(self._s, fill=self._fill)
+
+
+def test_reshard_load_grow_and_meta(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    old = _stacked(2, fill=100.0)
+    ckpt_mod.save(path, old, meta={
+        "config_hash": "cafe", "campaign": _FakeCamp(2).describe(),
+        "fleet": {"ticks_done": 32}})
+    camp = _FakeCamp(5, fill=7.0)
+    state, meta = reshard_load(path, camp, expect_config="cafe")
+    np.testing.assert_array_equal(state["a"][:2], old["a"])
+    np.testing.assert_array_equal(state["a"][2:], camp.init()["a"][2:])
+    # meta rides back so callers recover fleet/service bookkeeping
+    assert meta["fleet"]["ticks_done"] == 32
+    assert meta["format"] == ckpt_mod.FORMAT
+    # scenario refusal, exactly like checkpoint.load
+    with pytest.raises(ValueError, match="scenario mismatch"):
+        reshard_load(path, camp, expect_config="beef")
+
+
+def test_reshard_load_campaign_identity_refusals(tmp_path):
+    path = str(tmp_path / "ck.npz")
+    ckpt_mod.save(path, _stacked(2), meta={
+        "campaign": _FakeCamp(2, base_seed=1).describe()})
+    # wrong base seed -> grown slots would be mis-seeded
+    with pytest.raises(ValueError, match="base_seed"):
+        reshard_load(path, _FakeCamp(5, base_seed=2))
+    # replica-id prefix must agree: row k keeps its identity
+    with pytest.raises(ValueError, match="replica-id prefix"):
+        reshard_load(path, _FakeCamp(5, replica_ids=(4, 5, 6, 7, 8)))
+    # sweep grid must match
+    with pytest.raises(ValueError, match="sweep grid"):
+        reshard_load(path, _FakeCamp(5, sweep=(("x", (1.0, 2.0)),),
+                                     replica_ids=(0, 1, 2, 3, 4)))
+    # under a sweep the id->grid-point map is id // replicas: changing
+    # `replicas` renumbers every parameter point, so it is refused ...
+    sweep = (("x", (1.0, 2.0)),)
+    ckpt_mod.save(path, _stacked(4), meta={
+        "campaign": _FakeCamp(4, sweep=sweep, replicas=2).describe()})
+    with pytest.raises(ValueError, match="grid-point mapping"):
+        reshard_load(path, _FakeCamp(8, sweep=sweep, replicas=4,
+                                     replica_ids=range(8)))
+    # ... but a PURE seed sweep may grow/shrink the replica axis freely
+    ckpt_mod.save(path, _stacked(2), meta={
+        "campaign": _FakeCamp(2, replicas=2).describe()})
+    state, _ = reshard_load(path, _FakeCamp(5, replicas=5))
+    assert int(np.shape(state["a"])[0]) == 5
+    # leaf-count mismatch is a structural refusal
+    ckpt_mod.save(path, {"a": jnp.zeros((2, 3), jnp.float32)})
+    with pytest.raises(ValueError, match="leaves"):
+        reshard_load(path, _FakeCamp(5))
+
+
+# -- campaign replica_ids ----------------------------------------------------
+
+
+def test_campaign_replica_ids_validation_and_mapping():
+    # __init__ never touches sim, so the id bookkeeping tests compile
+    # nothing
+    camp = Campaign(None, CampaignParams(replicas=8, base_seed=3,
+                                         replica_ids=(4, 5, 6, 7)))
+    assert camp.ids == (4, 5, 6, 7)
+    assert camp.s == 4 and camp.total == 8
+    d = camp.describe()
+    assert d["replica_ids"] == [4, 5, 6, 7]
+    assert d["total"] == 8 and d["s"] == 4
+    # full campaign: ids are the identity
+    assert Campaign(None, CampaignParams(replicas=3)).ids == (0, 1, 2)
+
+    with pytest.raises(ValueError, match="at least one replica id"):
+        Campaign(None, CampaignParams(replicas=4, replica_ids=()))
+    with pytest.raises(ValueError, match="outside"):
+        Campaign(None, CampaignParams(replicas=4, replica_ids=(0, 9)))
+
+    # a subset campaign's rows carry their FULL-campaign sweep point:
+    # global id i sits at grid point i // replicas
+    sweep = (("churn.lifetimeMean", (100.0, 200.0)),)
+    sub = Campaign(None, CampaignParams(replicas=2, sweep=sweep,
+                                        replica_ids=(2, 3)))
+    assert sub.replica_ov(0) == {"churn.lifetimeMean": 200.0}
+    assert float(sub.sweep_stack["churn.lifetimeMean"][0]) == 200.0
+
+
+# -- fleet host helpers ------------------------------------------------------
+
+
+def test_shard_replicas_tiles_contiguously():
+    assert shard_replicas(8, 2) == [(0, 1, 2, 3), (4, 5, 6, 7)]
+    assert shard_replicas(7, 3) == [(0, 1, 2), (3, 4), (5, 6)]
+    # more workers than replicas: only non-empty shards
+    assert shard_replicas(3, 5) == [(0,), (1,), (2,)]
+    assert shard_replicas(1, 1) == [(0,)]
+    for total, workers in ((8, 3), (13, 4), (5, 5)):
+        flat = [i for sh in shard_replicas(total, workers) for i in sh]
+        assert flat == list(range(total))
+    with pytest.raises(ValueError):
+        shard_replicas(0, 2)
+    with pytest.raises(ValueError):
+        shard_replicas(4, 0)
+
+
+def test_merge_shard_leaves_global_order_and_refusals():
+    leaves = {"kbr": {"sent": np.arange(6, dtype=np.int64) * 10},
+              "alive": np.arange(6, dtype=np.float32)}
+
+    def rows(ids):
+        return jax.tree.map(lambda x: x[list(ids)], leaves)
+
+    # shards handed over OUT of order still merge into global id order
+    merged = merge_shard_leaves([((3, 4, 5), rows((3, 4, 5))),
+                                 ((0, 1, 2), rows((0, 1, 2)))])
+    np.testing.assert_array_equal(merged["kbr"]["sent"],
+                                  leaves["kbr"]["sent"])
+    np.testing.assert_array_equal(merged["alive"], leaves["alive"])
+    assert merged["alive"].dtype == np.float32
+
+    with pytest.raises(ValueError, match="do not tile"):
+        merge_shard_leaves([((0, 1), rows((0, 1))),
+                            ((1, 2), rows((1, 2)))])       # overlap
+    with pytest.raises(ValueError, match="do not tile"):
+        merge_shard_leaves([((0, 1), rows((0, 1))),
+                            ((3,), rows((3,)))], total=4)  # hole
+    with pytest.raises(ValueError, match="disagree on keys"):
+        merge_shard_leaves([((0,), {"a": np.zeros((1,))}),
+                            ((1,), {"b": np.zeros((1,))})])
+
+
+def test_leaves_json_codec_preserves_dtype():
+    tree = {"counters": {"lost": np.asarray([1, 2], np.int64)},
+            "ratio": np.asarray([0.5, 0.25], np.float32),
+            "mask": np.asarray([True, False])}
+    doc = encode_leaves(tree)
+    # JSON round trip exactly as the shard artifact files do it
+    back = decode_leaves(json.loads(json.dumps(doc)))
+    assert back["counters"]["lost"].dtype == np.int64
+    assert back["ratio"].dtype == np.float32        # NOT widened to f64
+    assert back["mask"].dtype == np.bool_
+    np.testing.assert_array_equal(back["ratio"], tree["ratio"])
+    # the ensemble-identity check compares encoded docs for equality
+    assert encode_leaves(back) == doc
+
+
+def test_chaos_schedule_seeded():
+    plan = chaos_schedule(5, workers=3, seed=11, span_s=4.0,
+                          min_delay_s=0.5)
+    assert plan == chaos_schedule(5, 3, 11, span_s=4.0, min_delay_s=0.5)
+    assert plan != chaos_schedule(5, 3, 12, span_s=4.0, min_delay_s=0.5)
+    assert len(plan) == 5
+    assert plan == sorted(plan)
+    for delay, worker in plan:
+        assert 0.5 <= delay < 4.5
+        assert 0 <= worker < 3
+
+
+def test_heartbeat_files(tmp_path):
+    hb = str(tmp_path / "w0.heartbeat.json")
+    assert heartbeat_age(hb) is None          # never written: normal
+    write_heartbeat(hb, ticks_done=32, retries=0)
+    doc = read_json(hb)
+    assert doc["ticks_done"] == 32 and "wall" in doc
+    age = heartbeat_age(hb, now=doc["wall"] + 3.5)
+    assert age == pytest.approx(3.5)
+    # torn/garbage file reads as None, not an exception
+    bad = str(tmp_path / "torn.json")
+    with open(bad, "w") as f:
+        f.write('{"wall": 1.')
+    assert read_json(bad) is None
+    # atomic writer leaves no tmp droppings
+    write_json_atomic(str(tmp_path / "a.json"), {"v": 1})
+    assert [p.name for p in tmp_path.glob("*.tmp.*")] == []

@@ -240,6 +240,76 @@ def test_checkpoint_cadence_resume_and_refusals(tmp_path):
         ServiceLoop.resume(TinyRunner(), _tiny_state(), p2, config=cfg)
 
 
+def test_override_cadence_reanchors_window_origin(tmp_path):
+    """The cadence-mismatch escape hatch: ``override_cadence=True``
+    re-anchors the window origin so the NEXT target is the restored
+    clock plus one NEW window, and every later target is recomputed as
+    ``start + (k+1)*w`` from that origin — never accumulated."""
+    path = str(tmp_path / "svc.npz")
+    cfg = {"scenario": "tiny", "n": 2}
+    p = ServiceParams(window_sim_s=0.5, chunk=4,
+                      checkpoint_every=2, checkpoint_path=path)
+    ServiceLoop(TinyRunner(), _tiny_state(), p, config=cfg).run(
+        n_windows=5)
+    # checkpoint landed at windows_done=4, t_now = 4 * 0.5s = 2.0s
+
+    # the refusal names the hatch so operators can find it
+    p2 = dataclasses.replace(p, window_sim_s=1.25)
+    with pytest.raises(ValueError, match="override_cadence"):
+        ServiceLoop.resume(TinyRunner(), _tiny_state(), p2, config=cfg)
+
+    class Recorder(TinyRunner):
+        def __init__(self):
+            self.targets = []
+
+        def run_until_device(self, s, t_sim, chunk=32):
+            self.targets.append(float(t_sim))
+            return super().run_until_device(s, t_sim, chunk=chunk)
+
+    rec = Recorder()
+    # same cadence + override: a plain resume, origin untouched
+    r2 = ServiceLoop.resume(TinyRunner(), _tiny_state(), p, config=cfg,
+                            override_cadence=True)
+    assert r2.start_sim_t == 0.0 and r2.windows_done == 4
+
+    r = ServiceLoop.resume(rec, _tiny_state(), p2, config=cfg,
+                           override_cadence=True)
+    assert r.windows_done == 4
+    # re-anchored origin: restored clock minus windows_done NEW windows
+    assert r.start_sim_t == pytest.approx(2.0 - 4 * 1.25)
+    state, done = r.run(n_windows=2)
+    assert done == 6
+    # next target = restored t_now + one new window; then the grid
+    assert rec.targets == [pytest.approx(2.0 + 1.25),
+                           pytest.approx(2.0 + 2 * 1.25)]
+    assert rec.targets == [pytest.approx(r.start_sim_t + k * 1.25)
+                           for k in (5, 6)]
+
+
+def test_checkpoint_now_graceful_shutdown(tmp_path):
+    """The SIGTERM path: stop() drains the in-flight window, then
+    checkpoint_now() snapshots the CURRENT state even when the cadence
+    checkpoint isn't due — and the result resumes."""
+    path = str(tmp_path / "svc.npz")
+    cfg = {"scenario": "tiny", "n": 2}
+    p = ServiceParams(window_sim_s=0.5, chunk=4,
+                      checkpoint_every=100, checkpoint_path=path)
+    loop = ServiceLoop(TinyRunner(), _tiny_state(), p, config=cfg)
+    loop.run(n_windows=3)
+    assert loop.checkpoints_written == 0      # cadence never fired
+    assert loop.checkpoint_now() is True
+    meta = ckpt_mod.read_meta(path)
+    assert meta["service"]["windows_done"] == 3
+    r = ServiceLoop.resume(TinyRunner(), _tiny_state(), p, config=cfg)
+    assert r.windows_done == 3 and int(r.state.tick) == 12
+
+    # without a checkpoint path it reports False instead of raising
+    free = ServiceLoop(TinyRunner(), _tiny_state(),
+                       ServiceParams(window_sim_s=0.5, chunk=4))
+    free.run(n_windows=1)
+    assert free.checkpoint_now() is False
+
+
 # ---------------------------------------------------------------------------
 # ingest: batched injection, drain, and the engine's EXT_OUT hold
 # ---------------------------------------------------------------------------

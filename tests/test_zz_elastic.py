@@ -1,0 +1,148 @@
+"""Elastic reshard-on-resume identity on a REAL simulation (heavy tier).
+
+The PR's hard pin: a campaign checkpoint written at one topology
+restores at another with the surviving replicas BIT-IDENTICAL — grown
+slots are exactly the replicas the bigger campaign would have started
+with (``Campaign.init`` seeds row r from ``fold_in(base_seed, ids[r])``,
+so growth is deterministic re-seeding, not fresh randomness), placement
+re-establishes over whatever mesh the 8 virtual devices offer, and a
+2-way → 8-way → 2-way round trip returns the original leaves unchanged.
+Advancing the resharded ensemble then matches the per-replica truth:
+survivors track the small campaign, grown rows track the full fresh one
+(``run_chunk`` is replica-independent — the fleet determinism contract).
+
+NOTE test_zz_* naming: sorts LAST in the alphabetical tier-1 run, heavy
+compiles must not starve the mid-alphabet modules (run_suite.sh gives
+each file its own budget).  Marked ``slow`` besides: each test is
+multi-minute on this box, which is exactly what the smoke tier excludes
+— scripts/run_suite.sh runs this module standalone with its own budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oversim_tpu import checkpoint as ckpt_mod
+from oversim_tpu import churn as churn_mod
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.campaign import Campaign, CampaignParams
+from oversim_tpu.common import lookup as lk_mod
+from oversim_tpu.elastic import place_campaign, reshard_load
+from oversim_tpu.engine import sim as sim_mod
+
+pytestmark = pytest.mark.slow
+
+CHUNK = 64           # ticks per advance (fixed-tick fleet cadence)
+S_SMALL, S_BIG = 2, 8
+
+
+def make_sim(n=8):
+    from oversim_tpu.overlay.chord import ChordLogic
+    app = KbrTestApp(KbrTestParams(test_interval=0.5))
+    logic = ChordLogic(app=app, lcfg=lk_mod.LookupConfig(slots=4))
+    cp = churn_mod.ChurnParams(model="lifetime", target_num=n,
+                               init_interval=0.2, lifetime_mean=8.0)
+    ep = sim_mod.EngineParams(window=0.1, inbox_slots=4, pool_factor=4)
+    return sim_mod.Simulation(logic, cp, engine_params=ep)
+
+
+def rows(tree, sl):
+    return jax.tree.map(lambda x: np.asarray(x)[sl], tree)
+
+
+def assert_rows_identical(a, b, label):
+    la = jax.tree_util.tree_flatten_with_path(a)[0]
+    lb = jax.tree_util.tree_leaves(b)
+    bad = [jax.tree_util.keystr(path)
+           for (path, x), y in zip(la, lb)
+           if not np.array_equal(np.asarray(x), np.asarray(y),
+                                 equal_nan=True)]
+    assert not bad, f"{label}: leaves diverged: {bad}"
+
+
+def test_reshard_grow_place_shrink_roundtrip_and_continuation(tmp_path):
+    sim = make_sim()
+    small = Campaign(sim, CampaignParams(replicas=S_SMALL, base_seed=7))
+    big = Campaign(sim, CampaignParams(replicas=S_BIG, base_seed=7))
+
+    # -- advance the small campaign and checkpoint it ----------------------
+    cs2 = small.run_chunk(small.init(), CHUNK)
+    host2 = jax.device_get(cs2)
+    path = str(tmp_path / "small.npz")
+    ckpt_mod.save(path, cs2, meta={"config_hash": "deadbeefcafe0000",
+                                   "campaign": small.describe()})
+
+    # keep advancing the small campaign: the survivors' reference truth
+    host2b = jax.device_get(small.run_chunk(cs2, CHUNK))  # cs2 donated
+
+    # -- GROW 2 -> 8 -------------------------------------------------------
+    fresh8 = big.init()
+    fresh8_host = jax.device_get(fresh8)
+    grown, meta = reshard_load(path, big,
+                               expect_config="deadbeefcafe0000",
+                               fresh=fresh8)
+    assert meta["campaign"]["base_seed"] == 7
+    grown_host = jax.device_get(grown)
+    # survivors: the checkpointed replicas, bit-identical
+    assert_rows_identical(rows(grown_host, slice(0, S_SMALL)),
+                          rows(host2, slice(0, S_SMALL)),
+                          "grow survivors")
+    # grown slots: exactly the rows the FULL fresh campaign starts with
+    # (deterministic re-seed via fold_in(base_seed, global id))
+    assert_rows_identical(rows(grown_host, slice(S_SMALL, S_BIG)),
+                          rows(fresh8_host, slice(S_SMALL, S_BIG)),
+                          "grown slots")
+
+    # -- placement over the mesh available NOW -----------------------------
+    placed, mesh = place_campaign(grown)
+    assert int(np.prod(mesh.devices.shape)) == 8  # S=8 % 8 dev == 0
+    assert_rows_identical(jax.device_get(placed), grown_host,
+                          "placement is layout-only")
+
+    # -- SHRINK 8 -> 2: the round-trip identity ----------------------------
+    path8 = str(tmp_path / "big.npz")
+    ckpt_mod.save(path8, placed, meta={"campaign": big.describe()})
+    back, _ = reshard_load(path8, small, fresh=small.init())
+    assert_rows_identical(jax.device_get(back), host2,
+                          "2 -> 8 -> 2 round trip")
+
+    # -- continuation after the graft --------------------------------------
+    # run_chunk is replica-independent, so advancing the resharded
+    # ensemble must track BOTH per-replica truths at once:
+    ref8 = jax.device_get(big.run_chunk(fresh8, CHUNK))   # fresh8 donated
+    adv = jax.device_get(big.run_chunk(placed, CHUNK))    # placed donated
+    # survivors advanced == the small campaign advanced the same ticks
+    assert_rows_identical(rows(adv, slice(0, S_SMALL)),
+                          rows(host2b, slice(0, S_SMALL)),
+                          "survivor continuation")
+    # grown rows advanced == the uninterrupted fresh big campaign's rows
+    assert_rows_identical(rows(adv, slice(S_SMALL, S_BIG)),
+                          rows(ref8, slice(S_SMALL, S_BIG)),
+                          "grown-row continuation")
+
+
+def test_fleet_shards_equal_full_campaign_rows(tmp_path):
+    """The fleet determinism contract at sim level: two shard campaigns
+    (``replica_ids`` subsets) advanced by the fixed-tick cadence merge
+    into exactly the full campaign's stacked rows."""
+    from oversim_tpu.elastic import merge_shard_leaves, shard_replicas
+
+    sim = make_sim()
+    full = Campaign(sim, CampaignParams(replicas=4, base_seed=7))
+    ref = jax.device_get(full.run_chunk(full.init(), CHUNK))
+
+    shards = []
+    for ids in shard_replicas(4, 2):
+        camp = Campaign(sim, CampaignParams(replicas=4, base_seed=7,
+                                            replica_ids=ids))
+        cs = camp.run_chunk(camp.init(), CHUNK)
+        shards.append((ids, jax.device_get(
+            {"stats": cs.stats, "counters": cs.counters,
+             "tick": cs.tick})))
+
+    merged = merge_shard_leaves(shards, total=4)
+    assert_rows_identical(
+        merged, {"stats": ref.stats, "counters": ref.counters,
+                 "tick": ref.tick},
+        "shard merge == full campaign")
