@@ -445,26 +445,14 @@ def child_main():
                 flags + " --xla_backend_optimization_level=0"
                 " --xla_llvm_disable_expensive_passes=true").strip()
 
-    sys.modules["zstandard"] = None  # zstd C ext segfaults on this box
+    # hostcache.enable owns the pre-import ritual (zstandard poison, x64,
+    # host-keyed persistent cache dir).  persistent=False on CPU: this
+    # box's XLA-CPU executable serialize() segfaults sporadically on big
+    # sim-step graphs (tests/conftest.py note)
+    from oversim_tpu import hostcache
+    hostcache.enable(persistent=not on_cpu)
     import jax
 
-    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
-    from jax._src import compilation_cache as _cc
-    if getattr(_cc, "zstandard", None) is not None:
-        _cc.zstandard = None
-    if getattr(_cc, "zstd", None) is not None:
-        _cc.zstd = None
-
-    jax.config.update("jax_enable_x64", True)
-    if on_cpu:
-        # this box's XLA-CPU executable serialize() segfaults sporadically
-        # on big sim-step graphs (tests/conftest.py note) — no persistence
-        jax.config.update("jax_enable_compilation_cache", False)
-    else:
-        # sim-step graphs compile slowly; cache across invocations/rounds
-        jax.config.update("jax_compilation_cache_dir",
-                          _host_cache_dir())
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     # last update wins over the sitecustomize hook's forced "axon,cpu";
     # None keeps the ambient (tunnel) selection
     if platform is not None:
@@ -510,7 +498,23 @@ def child_main():
     host_loop = bool(os.environ.get("OVERSIM_INVARIANTS")
                      or os.environ.get("OVERSIM_DEBUG_INVARIANTS"))
 
-    dev = jax.devices()[0]
+    # device acquisition under the elastic retry taxonomy: a tunnel
+    # stall here is a transient, not a run-killer (oversim_tpu/elastic/)
+    from oversim_tpu import elastic
+    retries = []
+
+    def _on_retry(attempt, delay, exc):
+        retries.append(str(exc))
+        sys.stderr.write("bench: transient device failure (attempt %d, "
+                         "retry in %.1fs): %s\n" % (attempt + 1, delay, exc))
+
+    dev = elastic.with_retry(lambda: jax.devices()[0],
+                             policy=elastic.RetryPolicy(attempts=3),
+                             on_retry=_on_retry,
+                             label="bench device acquisition")
+    elastic_ann = {"degraded_to_cpu": False,
+                   "attempts": len(retries) + 1,
+                   **({"retried": retries} if retries else {})}
     sys.stderr.write("bench: platform=%s device=%s n=%d\n"
                      % (dev.platform, str(dev), n))
 
@@ -555,6 +559,27 @@ def child_main():
     trace_path = os.environ.get("OVERSIM_BENCH_TRACE")
     trace = telemetry_mod.PerfettoTrace("bench") if trace_path else None
 
+    # OVERSIM_BENCH_REPLICAS=S: campaign tier — S independent replicas
+    # as ONE vmapped program (oversim_tpu/campaign/), replica axis
+    # sharded when S divides the device count.  The campaign run loop is
+    # device-resident only (no host-synced invariant tier).
+    replicas = int(os.environ.get("OVERSIM_BENCH_REPLICAS", "0"))
+
+    # AOT pre-warm ($OVERSIM_AOT=1): deserialize-or-export the entry
+    # this run will compile, so a second process on the same config
+    # skips trace+lower entirely (oversim_tpu/aot/).  The report rides
+    # the manifest and the Perfetto trace.
+    from oversim_tpu import aot
+    from oversim_tpu.analysis import contracts as contracts_mod
+    aot_ctx = contracts_mod.EntryContext(
+        n=n, overlay=overlay, window=window, inbox=inbox,
+        pool_factor=pool_f, replicas=max(replicas, 1), tel_ticks=tel_ticks,
+        chunk=chunk)
+    aot_rep = aot.warmup(("campaign_tick",) if replicas >= 1
+                         else ("run_until_device",), ctx=aot_ctx)
+    if trace is not None and aot_rep["enabled"]:
+        aot.trace_spans(trace, aot_rep)
+
     # RunManifest side-channel line — the orchestrator attaches it to
     # the artifact's top-level "manifest" key
     print(json.dumps(telemetry_mod.run_manifest(
@@ -565,13 +590,8 @@ def child_main():
                 "telemetry_window": tel_window,
                 "replicas": os.environ.get("OVERSIM_BENCH_REPLICAS", "0")},
         artifacts={"artifact": os.environ.get("OVERSIM_BENCH_ARTIFACT"),
-                   "trace": trace_path})), flush=True)
-
-    # OVERSIM_BENCH_REPLICAS=S: campaign tier — S independent replicas
-    # as ONE vmapped program (oversim_tpu/campaign/), replica axis
-    # sharded when S divides the device count.  The campaign run loop is
-    # device-resident only (no host-synced invariant tier).
-    replicas = int(os.environ.get("OVERSIM_BENCH_REPLICAS", "0"))
+                   "trace": trace_path},
+        extra={"aot": aot_rep, "elastic": elastic_ann})), flush=True)
     camp = None
     summarize_leaves = _summary_from_leaves
     if replicas >= 1:

@@ -15,6 +15,16 @@ Design (see SURVEY.md §7):
   - randomness: counter-based jax.random with per-node fold_in
 """
 
+import sys
+
+# zstd's C extension segfaults on this box (tests/conftest.py note) —
+# poison it BEFORE anything can import jax's compilation cache, so the
+# cache falls back to zlib wherever this package is imported.  Kept in
+# sync with hostcache.enable(), which re-asserts it for script entry
+# points that configure the cache explicitly.
+if "zstandard" not in sys.modules:
+    sys.modules["zstandard"] = None
+
 import jax
 
 # Simulated time is int64 nanoseconds; without x64 JAX silently

@@ -70,6 +70,9 @@ RULES = {
     "legacy-pyc": "*.pyc outside __pycache__ can shadow its source",
     "orphan-pyc": "__pycache__ bytecode whose source file is gone",
     "tracked-bytecode": "bytecode committed to git can shadow source edits",
+    "untracked-pycache": "__pycache__ not git-ignored — stray bytecode "
+                         "pollutes grep/status and is one `git add .` "
+                         "from being committed",
 }
 
 HOT_RULES = ("host-numpy", "host-item", "host-float", "host-device-get",
@@ -306,12 +309,18 @@ def iter_targets(root: Path):
 
 def bytecode_findings(root: Path,
                       trees=("oversim_tpu", "scripts", "tests")) -> list:
-    """Stale/shadowing-bytecode guards over the source trees."""
+    """Stale/shadowing-bytecode + __pycache__-hygiene guards over the
+    source trees — the runner entry points under ``scripts/`` are
+    covered the same as the package (a stale scripts/__pycache__ once
+    fed binary .pyc matches into every repo grep)."""
     out = []
+    pycache_dirs = []
     for tree in trees:
         base = root / tree
         if not base.is_dir():
             continue
+        pycache_dirs.extend(sorted(
+            p for p in base.rglob("__pycache__") if p.is_dir()))
         for pyc in sorted(base.rglob("*.pyc")):
             rel = str(pyc.relative_to(root))
             if "__pycache__" not in pyc.parts:
@@ -338,6 +347,27 @@ def bytecode_findings(root: Path,
             pass_name="ast", rule="tracked-bytecode", where=rel,
             message="bytecode is committed to git — `git rm --cached` "
                     "it and keep __pycache__/ in .gitignore"))
+    if pycache_dirs:
+        rels = [str(p.relative_to(root)) for p in pycache_dirs]
+        try:
+            # rc 0 = some ignored, 1 = none ignored; 128 (not a git
+            # work tree) skips the rule rather than spamming findings
+            r = subprocess.run(["git", "check-ignore", *rels],
+                               capture_output=True, text=True,
+                               timeout=15, cwd=root)
+            if r.returncode in (0, 1):
+                ignored = set(r.stdout.splitlines())
+                for rel in rels:
+                    if rel not in ignored:
+                        out.append(Finding(
+                            pass_name="ast", rule="untracked-pycache",
+                            where=rel,
+                            message="__pycache__ is not git-ignored — "
+                                    "add `__pycache__/` to .gitignore "
+                                    "so bytecode never reaches grep or "
+                                    "a commit"))
+        except (OSError, subprocess.TimeoutExpired):
+            pass
     return out
 
 

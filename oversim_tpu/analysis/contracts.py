@@ -72,6 +72,12 @@ class GraphContract:
     max_host_syncs: int = 0
     max_device_gets: int = 0
     check_leaks: bool = True
+    # compile-seconds budget (hlo_pass times lower+compile per entry):
+    # None defers to the analyzer-wide --compile-budget ceiling; a float
+    # pins THIS entry tighter.  Wall-clock, so budgets must carry slack
+    # for a loaded CI box — the point is catching 2x compile blowups
+    # (the unrolled-on_msg class), not 10% noise.
+    max_compile_seconds: float | None = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -11,6 +11,8 @@ enforces base-first).
 
 from __future__ import annotations
 
+import time
+
 from oversim_tpu.analysis import hlo_text
 from oversim_tpu.analysis.findings import Finding
 
@@ -115,6 +117,37 @@ def check_delta(name: str, delta, base_m: dict, m: dict) -> list:
     return out, d
 
 
+def timed_lower_compile(built) -> tuple:
+    """(optimized HLO text, compile-seconds dict) for one EntryBuild,
+    timing lower (trace+StableHLO) and compile (XLA backend) apart —
+    the two stages the AOT artifact plane (oversim_tpu/aot/) and the
+    persistent cache attack separately.  The timing is also stashed in
+    ``built.info["compile_seconds"]`` for the verdict document."""
+    t0 = time.perf_counter()
+    lowered = built.fn.lower(*built.make_args())
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+    timing = {"lower": round(t_lower, 3), "compile": round(t_compile, 3),
+              "total": round(t_lower + t_compile, 3)}
+    built.info["compile_seconds"] = timing
+    return compiled.as_text(), timing
+
+
+def check_compile_budget(name: str, budget, timing: dict) -> list:
+    """Budget breach finding (empty when within budget or unbudgeted)."""
+    if budget is None or timing["total"] <= budget:
+        return []
+    return [Finding(
+        pass_name="hlo", rule="compile-seconds", where=name,
+        message="lower+compile wall time over the CI compile budget — "
+                "compile-latency regressions burn the TPU deadline "
+                "before the first measured window (--compile-budget / "
+                "GraphContract.max_compile_seconds)",
+        measured=timing["total"], limit=budget)]
+
+
 def lower_entry(entry, ctx, builds=None) -> tuple:
     """(optimized HLO text, EntryBuild) for one registry entry."""
     if builds is not None and entry.name in builds:
@@ -123,16 +156,21 @@ def lower_entry(entry, ctx, builds=None) -> tuple:
         built = entry.build(ctx)
         if builds is not None:
             builds[entry.name] = built
-    txt = built.fn.lower(*built.make_args()).compile().as_text()
+    txt, _ = timed_lower_compile(built)
     return txt, built
 
 
-def run(ctx, selected=None, *, progress=None, builds=None):
+def run(ctx, selected=None, *, progress=None, builds=None,
+        compile_budget=None):
     """The whole pass: (findings, summary) over the selected entries.
 
     ``progress`` is an optional ``callable(str)`` for per-entry status
     lines (compiles are the slow part of the analyzer); ``builds`` an
-    optional shared ``{name: EntryBuild}`` cache across passes."""
+    optional shared ``{name: EntryBuild}`` cache across passes.
+    ``compile_budget`` (seconds, ``--compile-budget``) is the default
+    per-entry lower+compile ceiling; an entry's
+    ``contract.max_compile_seconds`` overrides it.  Timings are
+    recorded in the summary regardless — only enforcement is gated."""
     from oversim_tpu.analysis import contracts as contracts_mod
 
     findings = []
@@ -145,6 +183,13 @@ def run(ctx, selected=None, *, progress=None, builds=None):
         m = measure_entry(txt, built.pool_dim)
         measured[entry.name] = m
         findings.extend(check_contract(entry.name, entry.contract, m))
+        timing = built.info.get("compile_seconds",
+                                {"lower": 0.0, "compile": 0.0,
+                                 "total": 0.0})
+        budget = entry.contract.max_compile_seconds
+        if budget is None:
+            budget = compile_budget
+        findings.extend(check_compile_budget(entry.name, budget, timing))
         delta_info = None
         if entry.delta is not None:
             base_m = measured.get(entry.delta.base)
@@ -165,6 +210,7 @@ def run(ctx, selected=None, *, progress=None, builds=None):
             "collectives": m["collectives"],
             "host_transfers": m["host_transfers"],
             "donated_leaves": m["donated_leaves"],
+            "compile_seconds": timing,
             "info": built.info,
             **({"delta": delta_info} if delta_info else {}),
         }

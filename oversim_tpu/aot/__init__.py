@@ -1,0 +1,38 @@
+"""AOT compile plane: exported entry-point artifacts + process pre-warm.
+
+Every on-chip measurement round since r03 has been eaten by compile +
+warm-up rather than run time (PERFORMANCE.md; the reference OverSim's
+C++ event loop starts instantly).  This package attacks that tax
+structurally, over the SAME entry-point registry the graph-contract
+analyzer walks (oversim_tpu/analysis/contracts.py):
+
+* :mod:`oversim_tpu.aot.store` — versioned on-disk ``jax.export``
+  artifacts keyed by (entry, EntryContext config hash, jax version,
+  device signature, host, format), with loud refusal-on-mismatch and
+  recompile+rewrite fallback — never a crash, never silent stale
+  execution.
+* :mod:`oversim_tpu.aot.warmup` — ``aot.warmup()``: the one pre-warm
+  call at the top of bench.py and the runner scripts; deserializes or
+  exports each entry, reports per-entry compile-vs-load seconds for
+  ``run_manifest`` and Perfetto.
+
+CI enforcement (compile-seconds budgets per entry) lives in the
+analysis plane: ``scripts/analyze.py --compile-budget`` +
+``GraphContract.max_compile_seconds``.  See README "AOT compile plane".
+"""
+
+from oversim_tpu.aot.store import (  # noqa: F401
+    FORMAT_VERSION,
+    ArtifactStore,
+    artifact_key,
+    default_root,
+)
+from oversim_tpu.aot.warmup import (  # noqa: F401
+    call_exported,
+    enabled_by_env,
+    entry_config,
+    export_entry,
+    load_entry,
+    trace_spans,
+    warmup,
+)

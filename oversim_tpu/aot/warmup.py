@@ -1,0 +1,214 @@
+"""Process pre-warm over the analysis registry's compiled entry points.
+
+:func:`warmup` walks the registry entries a run will need and, per
+entry, either DESERIALIZES the stored ``jax.export`` artifact
+(StableHLO bytes — skips trace+lower, the dominant cold-start cost on
+these graphs) or BUILDS + exports it fresh and writes the artifact for
+the next process.  The returned report carries per-entry
+``compile_seconds`` vs ``load_seconds`` plus hit/refusal counters; the
+runner scripts attach it to every ``run_manifest``
+(``extra={"aot": report}``) and lay it out as Perfetto spans
+(:func:`trace_spans`).
+
+Export wrapper
+--------------
+The sim-state pytrees (``register_dataclass`` types) carry no
+``jax.export`` serialization registrations, so entries are exported as
+a FLATTENED-LEAF wrapper: the jitted wrapper takes only the
+``jax.Array`` leaves of the entry's example args, closes over the
+static leaves (Simulation instances, python ints), reassembles via
+``tree_unflatten``, and returns ``tree_leaves`` of the result.  Calling
+an exported entry therefore needs only fresh dynamic leaves in the same
+flatten order (:func:`call_exported`).
+
+Sharded entries (campaign_tick / resharded_resume) export with their
+mesh extent baked in (``Exported.nr_devices > 1``); deserialization is
+device-independent but a ``.call`` requires a matching device context —
+:func:`call_exported` refuses (returns None) rather than crash when the
+visible device count differs.
+
+Warm-up never throws: any per-entry failure (export bug, refused
+artifact that then fails to rebuild) is recorded in the report and the
+run proceeds cold for that entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+from oversim_tpu.aot.store import ArtifactStore, artifact_key
+
+
+def _log(msg: str) -> None:
+    sys.stderr.write(f"aot: {msg}\n")
+
+
+def enabled_by_env(environ=None) -> bool:
+    """$OVERSIM_AOT truthy → warm-up active.  Default OFF: tests and
+    fleet-smoke subprocesses must not pay export cost implicitly."""
+    environ = os.environ if environ is None else environ
+    return str(environ.get("OVERSIM_AOT", "")).lower() in (
+        "1", "true", "on", "yes")
+
+
+def entry_config(name: str, ctx) -> dict:
+    """The JSON config hashed into the artifact key: entry name + every
+    EntryContext field — any build-shape change rolls the key."""
+    return {"entry": name, **dataclasses.asdict(ctx)}
+
+
+def _dyn_leaves(args):
+    """(flat leaves, treedef, dynamic indices) of an args tuple — the
+    dynamic leaves are exactly the jax.Arrays; everything else (sim
+    objects, python scalars) is closed over statically."""
+    import jax
+    flat, tree = jax.tree_util.tree_flatten(args)
+    idx = [i for i, x in enumerate(flat) if isinstance(x, jax.Array)]
+    return flat, tree, idx
+
+
+def export_entry(built):
+    """Export one EntryBuild as a flattened-leaf jax.export artifact."""
+    import jax
+    from jax import export as jexport
+
+    flat, tree, idx = _dyn_leaves(built.make_args())
+
+    def flat_fn(*leaves):
+        full = list(flat)
+        for i, v in zip(idx, leaves):
+            full[i] = v
+        out = built.fn(*jax.tree_util.tree_unflatten(tree, full))
+        return jax.tree_util.tree_leaves(out)
+
+    return jexport.export(jax.jit(flat_fn))(*[flat[i] for i in idx])
+
+
+def deserialize(blob: bytes):
+    from jax import export as jexport
+    return jexport.deserialize(blob)
+
+
+def load_entry(name: str, *, ctx, store=None):
+    """Deserialize one stored artifact, or None (miss/refusal/corrupt).
+    The cheap path the smoke and serving loops use after :func:`warmup`
+    has populated the store."""
+    store = store if store is not None else ArtifactStore()
+    key = artifact_key(name, entry_config(name, ctx))
+    blob, refusal = store.load(name, key)
+    if blob is None:
+        if refusal:
+            _log(f"load_entry({name}): refused — {refusal}")
+        return None
+    try:
+        return deserialize(blob)
+    except Exception as e:  # noqa: BLE001 — a bad blob must not kill a run
+        _log(f"load_entry({name}): deserialize failed — {e}")
+        return None
+
+
+def call_exported(exp, built):
+    """Run an exported entry on FRESH dynamic leaves from its
+    EntryBuild's args factory.  Returns the flat output leaves, or None
+    when the export's device extent doesn't match the current context
+    (multi-device exports demand an equal device count)."""
+    import jax
+    flat, _, idx = _dyn_leaves(built.make_args())
+    if exp.nr_devices > 1 and exp.nr_devices != len(jax.devices()):
+        _log(f"call refused: exported for {exp.nr_devices} devices, "
+             f"{len(jax.devices())} visible")
+        return None
+    return exp.call(*[flat[i] for i in idx])
+
+
+def warmup(names=None, *, ctx=None, store=None, enabled=None,
+           environ=None) -> dict:
+    """Pre-warm the named registry entries (default: all of them).
+
+    Per entry: try the artifact store (load = deserialize StableHLO,
+    recorded as ``load_seconds`` with ``compile_seconds`` 0.0); on a
+    miss or a LOUD refusal, build + export + serialize fresh
+    (``compile_seconds`` = build + trace/lower/export wall) and rewrite
+    the artifact.  Returns the report dict for
+    ``run_manifest(extra={"aot": report})``; with warm-up disabled
+    (``enabled=False`` / $OVERSIM_AOT unset) returns immediately with
+    ``{"enabled": False}`` so callers can attach it unconditionally.
+    """
+    from oversim_tpu.analysis import contracts as contracts_mod
+
+    if enabled is None:
+        enabled = enabled_by_env(environ)
+    report = {"kind": "aot_warmup", "enabled": bool(enabled),
+              "entries": {}, "fresh_compiles": 0, "artifact_hits": 0,
+              "refusals": 0, "errors": 0}
+    if not enabled:
+        return report
+    if ctx is None:
+        ctx = contracts_mod.EntryContext.make(fast=True)
+    store = store if store is not None else ArtifactStore()
+    report["store"] = str(store.root)
+    names = list(names) if names is not None else list(contracts_mod.REGISTRY)
+    t_warm0 = time.perf_counter()
+    for name in names:
+        rec = {"started_s": round(time.perf_counter() - t_warm0, 3)}
+        report["entries"][name] = rec
+        try:
+            key = artifact_key(name, entry_config(name, ctx))
+            blob, refusal = store.load(name, key)
+            if blob is not None:
+                t0 = time.perf_counter()
+                try:
+                    deserialize(blob)
+                    rec.update(source="artifact",
+                               load_seconds=round(
+                                   time.perf_counter() - t0, 3),
+                               compile_seconds=0.0,
+                               blob_bytes=len(blob))
+                    report["artifact_hits"] += 1
+                    _log(f"{name}: artifact hit "
+                         f"({rec['load_seconds']}s load)")
+                    continue
+                except Exception as e:  # noqa: BLE001 — degrade to fresh
+                    refusal = f"deserialize failed ({e})"
+                    blob = None
+            if refusal:
+                report["refusals"] += 1
+                rec["refused"] = refusal
+                _log(f"{name}: REFUSING stored artifact — {refusal}; "
+                     f"recompiling fresh and rewriting")
+            t0 = time.perf_counter()
+            built = contracts_mod.REGISTRY[name].build(ctx)
+            exp = export_entry(built)
+            new_blob = exp.serialize()
+            rec.update(source="fresh",
+                       compile_seconds=round(time.perf_counter() - t0, 3),
+                       load_seconds=0.0, blob_bytes=len(new_blob),
+                       nr_devices=int(exp.nr_devices))
+            store.save(name, key, new_blob)
+            report["fresh_compiles"] += 1
+            _log(f"{name}: fresh export ({rec['compile_seconds']}s) "
+                 f"-> {store.blob_path(name)}")
+        except Exception as e:  # noqa: BLE001 — warm-up must never kill a run
+            rec.update(source="error", error=f"{type(e).__name__}: {e}")
+            report["errors"] += 1
+            _log(f"{name}: warm-up FAILED ({e}) — run proceeds cold")
+    report["wall_seconds"] = round(time.perf_counter() - t_warm0, 3)
+    return report
+
+
+def trace_spans(trace, report: dict, *, t0_s: float = 0.0,
+                tid: int = 3) -> None:
+    """Lay a warm-up report out as Perfetto spans (one per entry, named
+    ``aot.load:`` / ``aot.export:`` by source) on a telemetry
+    PerfettoTrace."""
+    for name, rec in (report.get("entries") or {}).items():
+        src = rec.get("source")
+        dur = rec.get("load_seconds" if src == "artifact"
+                      else "compile_seconds", 0.0) or 0.0
+        trace.span(f"aot.{'load' if src == 'artifact' else 'export'}:{name}",
+                   t0_s + rec.get("started_s", 0.0), dur, tid=tid,
+                   args={k: v for k, v in rec.items()
+                         if isinstance(v, (int, float, str, bool))})

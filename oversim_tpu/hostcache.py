@@ -1,25 +1,97 @@
-"""Host-keyed persistent-compile-cache path.
+"""Host/device-keyed compile-cache setup shared by every runner script.
 
-XLA-CPU AOT executables embed machine features; an entry compiled on a
-different host poisons the cache with load-time machine-feature
-mismatches (the round-4 goldens-regen failure).  Keying the cache
-directory on the CPU model + ISA flags makes a foreign entry simply
-invisible instead of fatal.  Pure stdlib — safe to import before jax.
+Two jobs:
+
+* :func:`cache_dir` — the host-keyed persistent-compile-cache path.
+  XLA-CPU AOT executables embed machine features; an entry compiled on
+  a different host poisons the cache with load-time machine-feature
+  mismatches (the round-4 goldens-regen failure).  Keying the cache
+  directory on the CPU model + ISA flags makes a foreign entry simply
+  invisible instead of fatal.
+* :func:`enable` — the ONE compile-cache boilerplate block.  Before this
+  helper existed, six scripts each carried the same zstandard poisoning
+  + x64 + ``jax_compilation_cache_dir`` stanza (bench.py,
+  campaign_run.py, service_run.py, hlo_breakdown.py, diag_ring64.py,
+  dev_dht_*.py); drift between the copies is how the round-4 cache
+  poisoning shipped.  ``persistent=False`` is the per-script opt-out —
+  this box's XLA-CPU ``executable.serialize()`` segfaults sporadically
+  on big sim-step graphs (tests/conftest.py), so the CPU tier disables
+  persistence entirely.
+
+:func:`device_signature` keys the AOT export artifacts
+(oversim_tpu/aot/) on the accelerator actually visible at warm-up time;
+:func:`host_signature` is the raw CPU identity string the cache dir
+hashes.  Module import stays pure stdlib — safe before jax.
 """
 
 from __future__ import annotations
 
 import hashlib
 import platform
+import sys
+
+_CPUINFO = "/proc/cpuinfo"
 
 
-def cache_dir(prefix: str = "/tmp/oversim_jax_cache") -> str:
+def host_signature(cpuinfo_path: str = _CPUINFO) -> str:
+    """CPU identity string: machine arch + model name + ISA flags.
+    Falls back to ``platform.processor()`` when cpuinfo is unreadable
+    (non-Linux, restricted /proc)."""
     sig = platform.machine()
     try:
-        with open("/proc/cpuinfo") as f:
+        with open(cpuinfo_path) as f:
             lines = f.read().splitlines()
         sig += "".join(ln for ln in lines
                        if ln.startswith(("model name", "flags")))[:8192]
     except OSError:
         sig += platform.processor() or ""
+    return sig
+
+
+def cache_dir(prefix: str = "/tmp/oversim_jax_cache", *,
+              cpuinfo_path: str = _CPUINFO) -> str:
+    sig = host_signature(cpuinfo_path)
     return prefix + "_" + hashlib.sha1(sig.encode()).hexdigest()[:10]
+
+
+def device_signature() -> str:
+    """Identity of the visible accelerator set, for keying exported AOT
+    artifacts: ``platform:kind0[+kind1...]:xN``.  Imports jax lazily —
+    call only after the backend env (JAX_PLATFORMS/XLA_FLAGS) is set."""
+    import jax
+    devs = jax.devices()
+    if not devs:
+        return "none:x0"
+    kinds = sorted({str(getattr(d, "device_kind", "?")) for d in devs})
+    return f"{devs[0].platform}:{'+'.join(kinds)}:x{len(devs)}"
+
+
+def enable(*, persistent: bool = True, min_compile_secs: float = 1.0,
+           prefix: str = "/tmp/oversim_jax_cache",
+           x64: bool = True) -> str | None:
+    """Configure jax's compile cache the one blessed way.
+
+    Poisons the zstandard C extension (segfaults on this box), nulls the
+    already-bound ``compilation_cache`` module references when jax beat
+    us to the import, enables x64, then either points the persistent
+    cache at the host-keyed directory (``persistent=True``; returns the
+    path) or disables persistence entirely (``persistent=False``; the
+    CPU-tier opt-out — returns None).  Call AFTER platform env vars are
+    final; safe whether or not jax is already imported.
+    """
+    sys.modules["zstandard"] = None
+    import jax
+    from jax._src import compilation_cache as _cc
+    for attr in ("zstandard", "zstd"):
+        if getattr(_cc, attr, None) is not None:
+            setattr(_cc, attr, None)
+    if x64:
+        jax.config.update("jax_enable_x64", True)
+    if not persistent:
+        jax.config.update("jax_enable_compilation_cache", False)
+        return None
+    d = cache_dir(prefix)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+    return d

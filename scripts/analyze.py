@@ -6,7 +6,8 @@ Usage:
                             [--entries a,b,...] [--json PATH] [--list]
                             [--n N] [--overlay chord|kademlia]
                             [--window W] [--inbox I] [--replicas S]
-                            [--seed-breach hlo|trace|ast]
+                            [--compile-budget S]
+                            [--seed-breach hlo|trace|ast|compile]
 
   No pass flag = --all.  Prints ONE machine-readable JSON verdict
   document on stdout (kind "graph_contract_verdict"), human-readable
@@ -21,6 +22,14 @@ Usage:
                  (atomic); run_suite.sh points OVERSIM_ANALYSIS_VERDICT
                  at it so run_manifest embeds the verdict.
   --list         print registered entries + lint rules and exit.
+  --compile-budget S
+                 enforce a per-entry lower+compile wall ceiling of S
+                 seconds during the hlo pass (implies --hlo); an
+                 entry's GraphContract.max_compile_seconds overrides
+                 the ceiling.  compile_seconds timings are recorded in
+                 the JSON verdict regardless — this flag only arms the
+                 breach (run_suite.sh passes it so a compile-time
+                 regression fails CI before it burns a TPU deadline).
   --seed-breach  deliberately violate ONE pass with a toy entry/fixture
                  and run only that — the self-test hook
                  (tests/test_analysis.py pins each seeded breach exits
@@ -58,14 +67,13 @@ def _setup_env():
 
 
 def _setup_jax():
+    from oversim_tpu import hostcache
+    # persistent=False: the analyzer compiles on CPU, where this box's
+    # executable serialize() segfaults sporadically (conftest note) —
+    # and a COLD compile is exactly what --compile-budget must measure
+    hostcache.enable(persistent=False)
     import jax
-    from jax._src import compilation_cache as _cc
-    for attr in ("zstandard", "zstd"):
-        if getattr(_cc, attr, None) is not None:
-            setattr(_cc, attr, None)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_enable_compilation_cache", False)
     return jax
 
 
@@ -125,7 +133,25 @@ def _seed_ast(ctx):
     return findings, {"files_scanned": 1, "findings": len(findings)}
 
 
-_SEEDS = {"hlo": _seed_hlo, "trace": _seed_trace, "ast": _seed_ast}
+def _seed_compile(ctx):
+    """A toy entry timed against an impossible 0.0-second compile
+    budget — any real lower+compile breaches it."""
+    import jax
+    import jax.numpy as jnp
+    from oversim_tpu.analysis import contracts as C
+    from oversim_tpu.analysis import hlo_pass
+
+    fn = jax.jit(lambda x: x + 1)
+    built = C.EntryBuild(fn=fn, make_args=lambda: (jnp.arange(8),),
+                         pool_dim=8, info={"seeded": True})
+    _, timing = hlo_pass.timed_lower_compile(built)
+    findings = hlo_pass.check_compile_budget("seeded_compile", 0.0, timing)
+    return findings, {"entries": {"seeded_compile":
+                                  {"compile_seconds": timing}}}
+
+
+_SEEDS = {"hlo": _seed_hlo, "trace": _seed_trace, "ast": _seed_ast,
+          "compile": _seed_compile}
 
 
 # ---------------------------------------------------------------------------
@@ -151,6 +177,11 @@ def _parse(argv):
     p.add_argument("--window", type=float, default=0.2)
     p.add_argument("--inbox", type=int, default=8)
     p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--compile-budget", type=float, default=None,
+                   metavar="S", help="per-entry lower+compile wall "
+                   "ceiling in seconds (implies --hlo); "
+                   "GraphContract.max_compile_seconds overrides per "
+                   "entry")
     return p.parse_args(argv[1:])
 
 
@@ -195,7 +226,7 @@ def main(argv) -> int:
         doc["seeded"] = args.seed_breach
         return _emit(doc, args.json_path)
 
-    run_hlo = args.all or args.hlo
+    run_hlo = args.all or args.hlo or args.compile_budget is not None
     run_trace = args.all or args.trace
     run_ast = args.all or args.ast
     if not (run_hlo or run_trace or run_ast):
@@ -224,7 +255,8 @@ def main(argv) -> int:
         if run_hlo:
             from oversim_tpu.analysis import hlo_pass
             f, summary = hlo_pass.run(ctx, selected, progress=log,
-                                      builds=builds)
+                                      builds=builds,
+                                      compile_budget=args.compile_budget)
             log(f"hlo: {len(summary['entries'])} entries, "
                 f"{len(f)} finding(s)")
             findings.extend(f)

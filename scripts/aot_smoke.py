@@ -1,0 +1,165 @@
+"""AOT artifact-store smoke: two processes, one store, zero recompiles.
+
+The acceptance test of the AOT compile plane (oversim_tpu/aot/): run the
+same tiny scenario in TWO subprocesses sharing one artifact store.
+Process 1 starts cold — every registered entry point exports fresh and
+writes its artifact.  Process 2 must pre-warm EVERY entry from the
+store with ZERO fresh compilations (per-entry ``compile_seconds`` 0.0,
+``source: "artifact"``), execute the scenario through a loaded
+artifact, and say so in its run manifest.  The parent asserts all of it
+and prints the cold-vs-warm walls (the PERFORMANCE.md numbers).
+
+Usage:
+  python scripts/aot_smoke.py [--store DIR]       # parent: run + assert
+  python scripts/aot_smoke.py --child --store DIR --manifest PATH
+
+run_suite.sh runs the parent as the ``aot_smoke`` gate.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+N = 16  # tiny: the smoke proves plumbing, not throughput
+
+
+def _child_env():
+    """conftest-style env: CPU backend, 8 virtual devices (the sharded
+    entries need them), -O0 so the cold process stays fast."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if "xla_backend_optimization_level" not in flags:
+        flags += (" --xla_backend_optimization_level=0"
+                  " --xla_llvm_disable_expensive_passes=true")
+    env["XLA_FLAGS"] = flags
+    return env
+
+
+def child(store_dir: str, manifest_path: str) -> int:
+    from oversim_tpu import aot, hostcache
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.analysis import contracts as contracts_mod
+
+    hostcache.enable(persistent=False)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    ctx = contracts_mod.EntryContext.make(fast=True, n=N)
+    store = aot.ArtifactStore(store_dir)
+    # enabled=True explicitly: the smoke IS the warm-up path, it must
+    # not depend on $OVERSIM_AOT
+    rep = aot.warmup(ctx=ctx, store=store, enabled=True)
+
+    # drive the tiny scenario through a LOADED artifact — proof the
+    # stored StableHLO still executes, not just deserializes
+    exp = aot.load_entry("solo_chunk", ctx=ctx, store=store)
+    ran = None
+    if exp is not None:
+        built = contracts_mod.REGISTRY["solo_chunk"].build(ctx)
+        t0 = time.perf_counter()
+        out = aot.call_exported(exp, built)
+        if out is not None:
+            jax.block_until_ready(out)
+            ran = {"entry": "solo_chunk", "out_leaves": len(out),
+                   "wall_s": round(time.perf_counter() - t0, 3)}
+
+    man = telemetry_mod.run_manifest(
+        config={"smoke": "aot", "n": N, "fast": True},
+        extra={"aot": rep, "aot_ran": ran})
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1)
+    os.replace(tmp, manifest_path)
+    print(f"aot_smoke child: fresh={rep['fresh_compiles']} "
+          f"hits={rep['artifact_hits']} errors={rep['errors']} "
+          f"wall={rep['wall_seconds']}s ran={ran}", flush=True)
+    return 0
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL aot_smoke: {msg}", flush=True)
+    return 1
+
+
+def parent(store_dir: str) -> int:
+    store_dir = str(Path(store_dir).resolve())
+    env = _child_env()
+    walls, mans = [], []
+    for i in (1, 2):
+        man_path = os.path.join(store_dir, f"manifest{i}.json")
+        t0 = time.perf_counter()
+        r = subprocess.run(
+            [sys.executable, __file__, "--child", "--store", store_dir,
+             "--manifest", man_path], env=env, cwd=str(ROOT))
+        walls.append(time.perf_counter() - t0)
+        if r.returncode != 0:
+            return _fail(f"process {i} exited {r.returncode}")
+        mans.append(json.load(open(man_path)))
+
+    rep1, rep2 = mans[0]["aot"], mans[1]["aot"]
+    n_entries = len(rep1["entries"])
+    if n_entries == 0:
+        return _fail("process 1 warmed zero entries")
+    if rep1["errors"] or rep2["errors"]:
+        return _fail(f"warm-up errors: p1={rep1['errors']} "
+                     f"p2={rep2['errors']}")
+    if rep1["fresh_compiles"] != n_entries or rep1["artifact_hits"] != 0:
+        return _fail(f"process 1 expected {n_entries} fresh compiles, "
+                     f"got fresh={rep1['fresh_compiles']} "
+                     f"hits={rep1['artifact_hits']}")
+    # THE acceptance criterion: the second process pre-warms every
+    # registered entry from artifacts with zero fresh compilations
+    if rep2["fresh_compiles"] != 0 or rep2["refusals"] != 0:
+        return _fail(f"process 2 recompiled: fresh={rep2['fresh_compiles']} "
+                     f"refusals={rep2['refusals']}")
+    if rep2["artifact_hits"] != n_entries:
+        return _fail(f"process 2 expected {n_entries} artifact hits, "
+                     f"got {rep2['artifact_hits']}")
+    for name, rec in rep2["entries"].items():
+        if rec.get("source") != "artifact" or rec.get("compile_seconds"):
+            return _fail(f"process 2 entry {name}: source="
+                         f"{rec.get('source')} compile_seconds="
+                         f"{rec.get('compile_seconds')} (want artifact/0.0)")
+    if not mans[1].get("aot_ran"):
+        return _fail("process 2 did not execute through a loaded artifact")
+
+    cold = rep1["wall_seconds"]
+    warm = rep2["wall_seconds"]
+    loads = [rec["load_seconds"] for rec in rep2["entries"].values()]
+    print(f"aot_smoke: {n_entries} entries; cold warm-up {cold:.1f}s "
+          f"(export) vs warm {warm:.2f}s (load; per-entry "
+          f"{min(loads):.3f}-{max(loads):.3f}s); process walls "
+          f"{walls[0]:.1f}s -> {walls[1]:.1f}s", flush=True)
+    print("PASS aot_smoke: second process pre-warmed every entry from "
+          "artifacts with zero fresh compilations", flush=True)
+    return 0
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser(prog="aot_smoke.py")
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--store", default=None, metavar="DIR")
+    ap.add_argument("--manifest", default=None, metavar="PATH")
+    args = ap.parse_args(argv[1:])
+    if args.child:
+        if not (args.store and args.manifest):
+            ap.error("--child needs --store and --manifest")
+        return child(args.store, args.manifest)
+    store = args.store or os.path.join(
+        os.environ.get("SUITE_STATE", "/tmp/suite_logs"), "aot_store")
+    os.makedirs(store, exist_ok=True)
+    return parent(store)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

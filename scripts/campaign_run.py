@@ -55,21 +55,14 @@ def _setup_jax(platform):
                 os.environ["XLA_FLAGS"] = (
                     flags + " --xla_backend_optimization_level=0"
                     " --xla_llvm_disable_expensive_passes=true").strip()
-    sys.modules["zstandard"] = None
+    # hostcache.enable owns the shared ritual (zstandard poison, x64,
+    # host-keyed persistent cache dir); persistent=False on CPU — this
+    # box's XLA-CPU executable serialize() segfaults (conftest note)
+    from oversim_tpu import hostcache
+    hostcache.enable(persistent=platform != "cpu")
     import jax
-
-    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
-    from jax._src import compilation_cache as _cc
-    for attr in ("zstandard", "zstd"):
-        if getattr(_cc, attr, None) is not None:
-            setattr(_cc, attr, None)
-    jax.config.update("jax_enable_x64", True)
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_enable_compilation_cache", False)
-    else:
-        jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
 
 
@@ -184,6 +177,17 @@ def main():
         trace.span("init", t0, time.perf_counter() - t0,
                    args={"s": camp.s, "devices": n_dev})
 
+    # AOT pre-warm ($OVERSIM_AOT=1): deserialize-or-export campaign_tick
+    # before the first dispatch (oversim_tpu/aot/); report → manifest
+    from oversim_tpu import aot
+    from oversim_tpu.analysis import contracts as contracts_mod
+    aot_rep = aot.warmup(("campaign_tick",), ctx=contracts_mod.EntryContext(
+        n=args.n, overlay=args.overlay, window=args.window,
+        inbox=8, pool_factor=8, replicas=camp.p.replicas,
+        chunk=args.chunk))
+    if trace and aot_rep["enabled"]:
+        aot.trace_spans(trace, aot_rep)
+
     # run manifest: config hash + mesh layout + artifact paths attached
     # to the artifact as its top-level "manifest" key
     manifest = telemetry_mod.run_manifest(
@@ -194,7 +198,8 @@ def main():
                 "telemetry": {"sampleTicks": args.telemetry,
                               "window": args.telemetry_window}},
         mesh=mesh,
-        artifacts={"report": args.out, "trace": args.trace})
+        artifacts={"report": args.out, "trace": args.trace},
+        extra={"aot": aot_rep})
     artifact.set_manifest(manifest)
 
     t0 = time.perf_counter()

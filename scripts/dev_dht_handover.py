@@ -6,7 +6,6 @@ import os
 import sys
 import time
 
-sys.modules["zstandard"] = None
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -16,16 +15,17 @@ if "xla_backend_optimization_level" not in flags:
               " --xla_llvm_disable_expensive_passes=true")
 os.environ["XLA_FLAGS"] = flags
 
+sys.path.insert(0, "/root/repo")
+
+# hostcache.enable owns the shared ritual (zstandard poison, x64);
+# persistent=False: CPU diagnostic — this box's XLA-CPU executable
+# serialize() segfaults sporadically (tests/conftest.py note)
+from oversim_tpu import hostcache  # noqa: E402
+
+hostcache.enable(persistent=False)
 import jax  # noqa: E402
 
-from jax._src import compilation_cache as _cc  # noqa: E402
-if getattr(_cc, "zstandard", None) is not None:
-    _cc.zstandard = None
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_enable_compilation_cache", False)
-
-sys.path.insert(0, "/root/repo")
 
 from oversim_tpu import churn as churn_mod  # noqa: E402
 from oversim_tpu.apps.dht import DhtApp, DhtParams  # noqa: E402

@@ -15,14 +15,14 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# hostcache.enable owns the shared ritual (zstandard poison, x64,
+# host-keyed persistent compilation cache)
+from oversim_tpu import hostcache  # noqa: E402
+
+hostcache.enable(persistent=True)
 import jax  # noqa: E402
 
-from oversim_tpu.hostcache import cache_dir as _host_cache_dir  # noqa: E402
-
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import numpy as np  # noqa: E402
 

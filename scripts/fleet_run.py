@@ -121,6 +121,16 @@ def _worker_main(spec_path: str) -> int:
     # failure degrades to CPU with a loud manifest annotation
     ann = acquire_backend(policy)
 
+    # AOT pre-warm ($OVERSIM_AOT=1): every worker of a fleet runs the
+    # same campaign graph — the first to export it feeds the rest from
+    # the shared artifact store (oversim_tpu/aot/)
+    from oversim_tpu import aot
+    from oversim_tpu.analysis import contracts as contracts_mod
+    aot_rep = aot.warmup(("campaign_tick",), ctx=contracts_mod.EntryContext(
+        n=scn["n"], overlay=scn["overlay"], window=scn["engine_window"],
+        inbox=8, pool_factor=8, replicas=max(len(spec["replica_ids"]), 1),
+        chunk=scn["chunk"]))
+
     camp = _build_campaign(scn, replica_ids=spec["replica_ids"])
     fresh = camp.init()
     ticks_done, retries = 0, 0
@@ -180,7 +190,7 @@ def _worker_main(spec_path: str) -> int:
         "done": True, "worker": widx,
         "replica_ids": list(spec["replica_ids"]),
         "ticks_done": ticks_done, "retries": retries,
-        "elastic": ann,
+        "elastic": ann, "aot": aot_rep,
         "leaves": fleet.encode_leaves(_final_leaves(state))})
     return 0
 

@@ -64,17 +64,11 @@ def _deprecation(mode: str, entry: str):
 # ---------------------------------------------------------------------------
 
 def _setup_jax():
-    sys.modules["zstandard"] = None
+    # hostcache.enable owns the shared ritual (zstandard poison, x64,
+    # host-keyed persistent compilation cache)
+    from oversim_tpu import hostcache
+    hostcache.enable(persistent=True)
     import jax
-
-    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
-    from jax._src import compilation_cache as _cc
-    for attr in ("zstandard", "zstd"):
-        if getattr(_cc, attr, None) is not None:
-            setattr(_cc, attr, None)
-    jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
 
 

@@ -23,7 +23,6 @@ import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-sys.modules["zstandard"] = None
 
 T0 = time.time()
 
@@ -32,34 +31,44 @@ def log(msg):
     print(f"[{time.time() - T0:7.1f}s] {msg}", flush=True)
 
 
-import jax
+# hostcache.enable owns the pre-import ritual (zstandard poison, x64,
+# host-keyed persistent compilation cache)
+from oversim_tpu import hostcache  # noqa: E402
 
-from oversim_tpu.hostcache import cache_dir as _host_cache_dir
-
-from jax._src import compilation_cache as _cc
-for attr in ("zstandard", "zstd"):
-    if getattr(_cc, attr, None) is not None:
-        setattr(_cc, attr, None)
-
-jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+hostcache.enable(persistent=True)
+import jax  # noqa: E402
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 16
 overlay = sys.argv[3] if len(sys.argv) > 3 else "kademlia"
 
+# backend bring-up through the elastic taxonomy: transient tunnel
+# failures retry with backoff, persistent ones degrade to CPU with a
+# loud manifest annotation (oversim_tpu/elastic/)
+from oversim_tpu import elastic  # noqa: E402
+
+elastic_ann = elastic.acquire_backend(elastic.RetryPolicy(attempts=3,
+                                                          base_s=0.2))
 dev = jax.devices()[0]
 log(f"backend up: {dev} platform={dev.platform}")
 
 from bench import ArtifactWriter  # noqa: E402
+from oversim_tpu import aot  # noqa: E402
 from oversim_tpu import telemetry as telemetry_mod  # noqa: E402
+from oversim_tpu.analysis import contracts as _contracts  # noqa: E402
+
+# AOT pre-warm ($OVERSIM_AOT=1) of the two entries this probe compiles
+aot_rep = aot.warmup(("solo_chunk", "run_until_device"),
+                     ctx=_contracts.EntryContext(
+                         n=n, overlay=overlay, window=0.05, inbox=4,
+                         pool_factor=4, chunk=chunk))
 
 artifact = ArtifactWriter(os.environ.get("OVERSIM_PROBE_ARTIFACT"))
 artifact.set_manifest(telemetry_mod.run_manifest(
     config={"probe": "perf_probe", "n": n, "chunk": chunk,
             "overlay": overlay, "platform": dev.platform},
-    artifacts={"report": os.environ.get("OVERSIM_PROBE_ARTIFACT")}))
+    artifacts={"report": os.environ.get("OVERSIM_PROBE_ARTIFACT")},
+    extra={"aot": aot_rep, "elastic": elastic_ann}))
 
 from oversim_tpu import churn as churn_mod
 from oversim_tpu.apps import kbrtest

@@ -17,7 +17,7 @@ export OVERSIM_ANALYSIS_VERDICT="$STATE/analysis.json"
 if [ -f "$an_marker" ]; then
   echo "skip  analyze (done)"
 elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
-    python scripts/analyze.py --all --fast \
+    python scripts/analyze.py --all --fast --compile-budget 600 \
       --json "$OVERSIM_ANALYSIS_VERDICT" \
       > "$STATE/analyze.log" 2>&1; then
   touch "$an_marker"
@@ -75,5 +75,21 @@ elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
 else
   status=1
   echo "FAIL  fleet_smoke  $(tail -1 "$STATE/fleet_smoke.log")"
+fi
+# AOT compile-plane smoke (scripts/aot_smoke.py): the same tiny scenario
+# in TWO processes sharing one artifact store — the second must pre-warm
+# every registered entry from exported artifacts with ZERO fresh
+# compilations (per-entry compile_seconds 0.0 in its run manifest)
+aot_marker="$STATE/aot_smoke.ok"
+if [ -f "$aot_marker" ]; then
+  echo "skip  aot_smoke (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/aot_smoke.py --store "$STATE/aot_store" \
+      > "$STATE/aot_smoke.log" 2>&1; then
+  touch "$aot_marker"
+  echo "PASS  aot_smoke  $(tail -1 "$STATE/aot_smoke.log")"
+else
+  status=1
+  echo "FAIL  aot_smoke  $(tail -1 "$STATE/aot_smoke.log")"
 fi
 exit $status

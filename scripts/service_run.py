@@ -53,21 +53,14 @@ def _setup_jax(platform):
                 os.environ["XLA_FLAGS"] = (
                     flags + " --xla_backend_optimization_level=0"
                     " --xla_llvm_disable_expensive_passes=true").strip()
-    sys.modules["zstandard"] = None
+    # hostcache.enable owns the shared ritual (zstandard poison, x64,
+    # host-keyed persistent cache dir); persistent=False on CPU — this
+    # box's XLA-CPU executable serialize() segfaults (conftest note)
+    from oversim_tpu import hostcache
+    hostcache.enable(persistent=platform != "cpu")
     import jax
-
-    from oversim_tpu.hostcache import cache_dir as _host_cache_dir
-    from jax._src import compilation_cache as _cc
-    for attr in ("zstandard", "zstd"):
-        if getattr(_cc, attr, None) is not None:
-            setattr(_cc, attr, None)
-    jax.config.update("jax_enable_x64", True)
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_enable_compilation_cache", False)
-    else:
-        jax.config.update("jax_compilation_cache_dir", _host_cache_dir())
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return jax
 
 
@@ -201,10 +194,24 @@ def main():
     print(json.dumps(init_rec), flush=True)
     artifact.add(init_rec)
 
+    # AOT pre-warm ($OVERSIM_AOT=1): deserialize-or-export the window
+    # entry this service will compile (oversim_tpu/aot/); report → manifest
+    from oversim_tpu import aot
+    from oversim_tpu.analysis import contracts as contracts_mod
+    aot_rep = aot.warmup(
+        ("campaign_tick",) if args.replicas else ("service_window",),
+        ctx=contracts_mod.EntryContext(
+            n=args.n, overlay=args.overlay, window=args.engine_window,
+            inbox=8, pool_factor=8, replicas=max(args.replicas, 1),
+            chunk=params.chunk))
+    if trace and aot_rep["enabled"]:
+        aot.trace_spans(trace, aot_rep)
+
     manifest = telemetry_mod.run_manifest(
         config=config,
         artifacts={"artifact": args.out, "trace": args.trace,
-                   "checkpoint": params.checkpoint_path})
+                   "checkpoint": params.checkpoint_path},
+        extra={"aot": aot_rep})
     artifact.set_manifest(manifest)
 
     def on_window(window, summary, wall):

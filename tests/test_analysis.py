@@ -147,6 +147,25 @@ def test_bytecode_guards(tmp_path):
     assert _rules(fs) == ["legacy-pyc", "orphan-pyc"]
 
 
+def test_untracked_pycache_rule(tmp_path):
+    """A __pycache__ dir NOT covered by .gitignore is a finding; adding
+    `__pycache__/` to .gitignore clears it (scripts/ tree included)."""
+    import subprocess
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    tree = tmp_path / "scripts"
+    (tree / "__pycache__").mkdir(parents=True)
+    (tree / "x.py").write_text("x = 1\n")
+    (tree / "__pycache__" / "x.cpython-311.pyc").write_bytes(b"ok")
+
+    fs = ast_pass.bytecode_findings(tmp_path)
+    [f] = [f for f in fs if f.rule == "untracked-pycache"]
+    assert "scripts" in f.where and "__pycache__" in f.where
+
+    (tmp_path / ".gitignore").write_text("__pycache__/\n")
+    fs = ast_pass.bytecode_findings(tmp_path)
+    assert [f for f in fs if f.rule == "untracked-pycache"] == []
+
+
 # ---------------------------------------------------------------------------
 # HLO text censuses (synthetic modules — no backend)
 # ---------------------------------------------------------------------------
@@ -317,6 +336,19 @@ def test_seeded_trace_breach_exits_nonzero(tmp_path):
     assert rc == 1 and doc["ok"] is False
     [f] = [f for f in doc["findings"] if f["rule"] == "recompile"]
     assert f["pass"] == "trace" and f["measured"] == 1
+
+
+def test_seeded_compile_breach_exits_nonzero(tmp_path):
+    """--seed-breach compile: a toy entry vs a 0.0-second budget — the
+    --compile-budget enforcement path exits non-zero with the timing in
+    the JSON verdict."""
+    rc, doc = _run_seed("compile", tmp_path)
+    assert rc == 1 and doc["ok"] is False
+    [f] = [f for f in doc["findings"] if f["rule"] == "compile-seconds"]
+    assert f["pass"] == "hlo" and f["measured"] > 0 and f["limit"] == 0.0
+    timing = (doc["passes"]["compile"]["entries"]["seeded_compile"]
+              ["compile_seconds"])
+    assert timing["total"] >= timing["compile"] >= 0
 
 
 # ---------------------------------------------------------------------------

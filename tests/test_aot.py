@@ -1,0 +1,174 @@
+"""AOT compile plane: artifact store semantics + warm-up plumbing.
+
+The store tests are jax-free (fake keys/blobs); the warm-up tests
+export ONE real registry entry at tiny n and pin the full lifecycle:
+fresh export -> artifact hit with compile_seconds 0.0 -> loud refusal +
+rewrite on a tampered key -> execution through the loaded artifact.
+The two-process acceptance path lives in scripts/aot_smoke.py
+(run_suite.sh aot_smoke gate).
+"""
+
+import json
+
+import pytest
+
+from oversim_tpu import aot
+from oversim_tpu.aot.store import KEY_FIELDS, ArtifactStore
+from oversim_tpu.analysis import contracts as contracts_mod
+
+FAKE_KEY = {"entry": "e", "config_hash": "c" * 16, "jax_version": "9.9.9",
+            "device_signature": "cpu:Fake:x8", "host": "a" * 10,
+            "format": aot.FORMAT_VERSION}
+
+
+# ------------------------------------------------------------- store --
+
+
+def test_store_round_trip(tmp_path):
+    store = ArtifactStore(tmp_path / "s")
+    assert store.load("e", FAKE_KEY) == (None, None)  # plain miss
+    store.save("e", FAKE_KEY, b"blob-bytes")
+    blob, refusal = store.load("e", FAKE_KEY)
+    assert blob == b"blob-bytes"
+    assert refusal is None
+    assert store.entries() == ["e"]
+
+
+@pytest.mark.parametrize("field", KEY_FIELDS)
+def test_store_refuses_any_stale_key_field(tmp_path, field):
+    store = ArtifactStore(tmp_path / "s")
+    store.save("e", FAKE_KEY, b"blob")
+    stale = dict(FAKE_KEY, **{field: "SOMETHING-ELSE"})
+    blob, refusal = store.load("e", stale)
+    assert blob is None
+    # the refusal names the differing field — loud, attributable
+    assert "stale key" in refusal
+    assert field in refusal
+
+
+def test_store_refuses_corrupt_meta_and_torn_blob(tmp_path):
+    store = ArtifactStore(tmp_path / "s")
+    store.save("e", FAKE_KEY, b"blob-bytes")
+    store.meta_path("e").write_text("{not json")
+    blob, refusal = store.load("e", FAKE_KEY)
+    assert blob is None and "corrupt meta" in refusal
+
+    store.save("e", FAKE_KEY, b"blob-bytes")
+    store.blob_path("e").write_bytes(b"trunc")  # torn write
+    blob, refusal = store.load("e", FAKE_KEY)
+    assert blob is None and "torn write" in refusal
+
+    # refusal is never fatal: a fresh save recovers the entry
+    store.save("e", FAKE_KEY, b"new")
+    assert store.load("e", FAKE_KEY) == (b"new", None)
+
+
+def test_artifact_key_fields(tmp_path):
+    key = aot.artifact_key("solo_tick", {"entry": "solo_tick", "n": 16})
+    assert sorted(key) == sorted(KEY_FIELDS)
+    assert key["entry"] == "solo_tick"
+    assert key["format"] == aot.FORMAT_VERSION
+    # config hash rolls with the config
+    key2 = aot.artifact_key("solo_tick", {"entry": "solo_tick", "n": 17})
+    assert key2["config_hash"] != key["config_hash"]
+
+
+def test_default_root_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("OVERSIM_AOT_DIR", str(tmp_path / "override"))
+    assert aot.default_root() == str(tmp_path / "override")
+
+
+# ----------------------------------------------------------- warm-up --
+
+
+def test_enabled_by_env():
+    assert not aot.enabled_by_env({})
+    assert not aot.enabled_by_env({"OVERSIM_AOT": "0"})
+    assert aot.enabled_by_env({"OVERSIM_AOT": "1"})
+    assert aot.enabled_by_env({"OVERSIM_AOT": "true"})
+
+
+def test_warmup_disabled_is_free():
+    rep = aot.warmup(enabled=False)
+    assert rep["enabled"] is False
+    assert rep["entries"] == {}
+    assert rep["fresh_compiles"] == rep["artifact_hits"] == 0
+
+
+CTX = contracts_mod.EntryContext.make(fast=True, n=16)
+
+
+@pytest.fixture(scope="module")
+def warm_store(tmp_path_factory):
+    """One real export of the cheapest chunk entry, shared by the
+    lifecycle tests below (export costs a couple of seconds)."""
+    store = ArtifactStore(tmp_path_factory.mktemp("aot") / "store")
+    rep = aot.warmup(("solo_chunk",), ctx=CTX, store=store, enabled=True)
+    return store, rep
+
+
+def test_warmup_fresh_then_hit(warm_store):
+    store, rep = warm_store
+    assert rep["fresh_compiles"] == 1 and rep["errors"] == 0
+    rec = rep["entries"]["solo_chunk"]
+    assert rec["source"] == "fresh"
+    assert rec["compile_seconds"] > 0
+    assert rec["blob_bytes"] > 0
+
+    rep2 = aot.warmup(("solo_chunk",), ctx=CTX, store=store, enabled=True)
+    assert rep2["artifact_hits"] == 1 and rep2["fresh_compiles"] == 0
+    rec2 = rep2["entries"]["solo_chunk"]
+    # THE point of the plane: a warm process pays load, not compile
+    assert rec2["source"] == "artifact"
+    assert rec2["compile_seconds"] == 0.0
+    assert rec2["load_seconds"] < rec["compile_seconds"]
+
+
+def test_stale_jax_version_refused_and_rewritten(warm_store):
+    store, _ = warm_store
+    meta_p = store.meta_path("solo_chunk")
+    meta = json.loads(meta_p.read_text())
+    good_key = dict(meta["key"])
+    meta["key"]["jax_version"] = "0.0.0-stale"
+    meta_p.write_text(json.dumps(meta))
+
+    rep = aot.warmup(("solo_chunk",), ctx=CTX, store=store, enabled=True)
+    # refused LOUDLY, then recompiled fresh and rewrote — never a crash,
+    # never silent stale execution
+    assert rep["refusals"] == 1
+    assert rep["fresh_compiles"] == 1
+    assert rep["errors"] == 0
+    assert "jax_version" in rep["entries"]["solo_chunk"]["refused"]
+    # the rewrite restored a loadable artifact under the CURRENT key
+    assert json.loads(meta_p.read_text())["key"] == good_key
+    rep2 = aot.warmup(("solo_chunk",), ctx=CTX, store=store, enabled=True)
+    assert rep2["artifact_hits"] == 1
+
+
+def test_load_entry_and_call_executes(warm_store):
+    import jax
+
+    store, _ = warm_store
+    exp = aot.load_entry("solo_chunk", ctx=CTX, store=store)
+    assert exp is not None
+    built = contracts_mod.REGISTRY["solo_chunk"].build(CTX)
+    out = aot.call_exported(exp, built)
+    assert out is not None
+    jax.block_until_ready(out)
+    assert len(out) > 0  # the flat sim-state leaves came back
+
+
+def test_load_entry_refuses_changed_config(warm_store):
+    store, _ = warm_store
+    other = contracts_mod.EntryContext.make(fast=True, n=32)
+    assert aot.load_entry("solo_chunk", ctx=other, store=store) is None
+
+
+def test_trace_spans_layout(warm_store):
+    from oversim_tpu import telemetry as telemetry_mod
+
+    _, rep = warm_store
+    trace = telemetry_mod.PerfettoTrace("t")
+    aot.trace_spans(trace, rep)
+    names = [ev.get("name") for ev in trace.events]
+    assert "aot.export:solo_chunk" in names

@@ -1,0 +1,77 @@
+"""hostcache: host-keyed cache dirs, device signatures, enable()."""
+
+import jax
+import pytest
+
+from oversim_tpu import hostcache
+
+FAKE_CPUINFO = """\
+processor\t: 0
+model name\t: FakeCPU 9000 @ 3.00GHz
+flags\t\t: fpu sse sse2 avx avx2
+"""
+
+
+@pytest.fixture
+def cpuinfo(tmp_path):
+    p = tmp_path / "cpuinfo"
+    p.write_text(FAKE_CPUINFO)
+    return str(p)
+
+
+def test_cache_dir_stable_for_same_host(cpuinfo):
+    a = hostcache.cache_dir("/tmp/x", cpuinfo_path=cpuinfo)
+    b = hostcache.cache_dir("/tmp/x", cpuinfo_path=cpuinfo)
+    assert a == b
+    assert a.startswith("/tmp/x_")
+    # a 10-hex-digit host hash suffix
+    suffix = a.rsplit("_", 1)[1]
+    assert len(suffix) == 10
+    assert int(suffix, 16) >= 0
+
+
+def test_cache_dir_rolls_when_isa_flags_change(tmp_path, cpuinfo):
+    before = hostcache.cache_dir("/tmp/x", cpuinfo_path=cpuinfo)
+    other = tmp_path / "cpuinfo2"
+    other.write_text(FAKE_CPUINFO.replace("avx2", "avx512f"))
+    after = hostcache.cache_dir("/tmp/x", cpuinfo_path=str(other))
+    # different machine features MUST land in a different cache dir —
+    # an AOT entry compiled for the other host would poison this one
+    assert before != after
+
+
+def test_cache_dir_oserror_fallback(tmp_path):
+    # unreadable cpuinfo (non-Linux, restricted /proc) degrades to
+    # platform.processor(), never raises
+    missing = str(tmp_path / "does_not_exist")
+    d = hostcache.cache_dir("/tmp/x", cpuinfo_path=missing)
+    assert d.startswith("/tmp/x_")
+    assert d == hostcache.cache_dir("/tmp/x", cpuinfo_path=missing)
+
+
+def test_device_signature_names_the_visible_set():
+    sig = hostcache.device_signature()
+    # conftest: CPU backend with 8 virtual devices
+    assert sig.startswith("cpu:")
+    assert sig.endswith(":x8")
+
+
+def test_enable_persistent_points_cache_at_host_dir(tmp_path):
+    prev_dir = jax.config.jax_compilation_cache_dir
+    try:
+        d = hostcache.enable(persistent=True,
+                             prefix=str(tmp_path / "cache"))
+        assert d == hostcache.cache_dir(str(tmp_path / "cache"))
+        assert jax.config.jax_compilation_cache_dir == d
+        # enable() must NOT flip the cache enable flag back on — the
+        # suite runs with it disabled (XLA-CPU serialize segfault,
+        # conftest note) and only sets the directory
+        assert jax.config.jax_enable_compilation_cache is False
+        assert jax.config.jax_enable_x64 is True
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+
+
+def test_enable_non_persistent_disables_cache():
+    assert hostcache.enable(persistent=False) is None
+    assert jax.config.jax_enable_compilation_cache is False
