@@ -545,9 +545,17 @@ def child_main():
     # is emitted as a telemetry_series side-channel line after the run
     tel_ticks = int(os.environ.get("OVERSIM_BENCH_TELEMETRY", "0"))
     tel_window = int(os.environ.get("OVERSIM_BENCH_TELEMETRY_WINDOW", "256"))
+    # OVERSIM_BENCH_INBOX_IMPL: scatter (default) | pallas (fused
+    # kernel plane, oversim_tpu/kernels/) | sort (oracle-only) —
+    # resolved like **.inboxImpl (pallas falls back to scatter when the
+    # plane is unavailable, sort warns)
+    from oversim_tpu.config import scenario as scenario_mod
+    inbox_impl = scenario_mod.resolve_inbox_impl(
+        os.environ.get("OVERSIM_BENCH_INBOX_IMPL", "scatter"))
     from oversim_tpu import telemetry as telemetry_mod
     ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
                               pool_factor=pool_f,
+                              inbox_impl=inbox_impl,
                               telemetry=telemetry_mod.TelemetryParams(
                                   sample_ticks=tel_ticks,
                                   window=tel_window))
@@ -585,6 +593,8 @@ def child_main():
     print(json.dumps(telemetry_mod.run_manifest(
         config={"n": n, "overlay": overlay, "interval": interval,
                 "window": window, "inbox": inbox, "pool_factor": pool_f,
+                "inbox_impl": inbox_impl,
+                "kernel_plane": inbox_impl == "pallas",
                 "chunk": chunk, "slots": slots,
                 "telemetry_sample_ticks": tel_ticks,
                 "telemetry_window": tel_window,
@@ -671,6 +681,7 @@ def child_main():
                 f"delivery {delivered}/{sent}, {out['_ticks']} ticks, "
                 f"{wall:.1f}s wall)")
         extra = {"delivery": round(delivery, 4),
+                 "inbox_impl": inbox_impl,
                  "measured_utc": time.strftime(
                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
         if camp is not None:

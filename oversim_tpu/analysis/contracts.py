@@ -57,6 +57,14 @@ class GraphContract:
     # whose census is mesh-dependent.
     allowed_collectives: frozenset = frozenset()
     collectives_enforced: bool = True
+    # custom-call allowlist (hlo_text.custom_call_census targets).  The
+    # kernel plane's entries enforce it: on TPU the fused Pallas
+    # kernels appear as Mosaic ``tpu_custom_call`` ops and NOTHING else
+    # may — under interpret mode (CPU CI) the census is empty, so the
+    # allowlist is an upper bound both backends satisfy.  Off by
+    # default: pre-kernel entries never audited their custom-calls.
+    allowed_custom_calls: frozenset = frozenset()
+    custom_calls_enforced: bool = False
     max_host_transfers: int = 0
     # donation: the optimized module header must carry input→output
     # buffer aliases (may-/must-alias) — dropped donation round-trips
@@ -291,6 +299,29 @@ def _build_resharded_resume(ctx):
               "devices": n_dev})
 
 
+def _build_fused_tick(ctx):
+    import jax
+    sim = build_sim(ctx, inbox_impl="pallas")
+    fn = jax.jit(sim.step)
+    s0 = sim.init(seed=7)
+    return EntryBuild(fn=fn, make_args=lambda: (s0,),
+                      pool_dim=sim.ep.pool_factor * ctx.n,
+                      info={"n": ctx.n, "overlay": ctx.overlay,
+                            "inbox_impl": "pallas"})
+
+
+def _build_fused_chunk(ctx):
+    sim = build_sim(ctx, inbox_impl="pallas")
+    # same static-self discipline as solo_chunk: ONE sim instance, the
+    # unbound class-level jit, fresh donated state per call
+    return EntryBuild(
+        fn=type(sim).run_chunk,
+        make_args=lambda: (sim, sim.init(seed=7), ctx.chunk),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay, "n_ticks": ctx.chunk,
+              "inbox_impl": "pallas"})
+
+
 def _build_service_window(ctx):
     import jax.numpy as jnp
     from oversim_tpu.engine.sim import NS
@@ -312,6 +343,19 @@ def _build_service_window(ctx):
 
 _TICK = GraphContract()
 _DONATED = GraphContract(require_donation=True)
+
+# the only custom-call the kernel plane may introduce: the Mosaic
+# lowering of pl.pallas_call on TPU.  Interpret mode (CPU CI) lowers
+# the kernels inline — zero custom-calls — so the allowlist holds on
+# both backends (oversim_tpu/kernels/).
+KERNEL_CUSTOM_CALLS = frozenset({"tpu_custom_call"})
+_FUSED_TICK = GraphContract(
+    custom_calls_enforced=True,
+    allowed_custom_calls=KERNEL_CUSTOM_CALLS)
+_FUSED_CHUNK = GraphContract(
+    require_donation=True,
+    custom_calls_enforced=True,
+    allowed_custom_calls=KERNEL_CUSTOM_CALLS)
 
 DEFAULT_ENTRIES = (
     EntryPoint(
@@ -348,6 +392,27 @@ DEFAULT_ENTRIES = (
         doc="service window: run_until_device with EXT_OUT hold armed",
         contract=_DONATED,
         build=_build_service_window),
+    EntryPoint(
+        name="fused_tick",
+        doc="jit(sim.step) with the Pallas kernel plane armed "
+            "(inbox_impl=\"pallas\"; interpret mode off-TPU): zero "
+            "full-pool sorts, Mosaic-custom-calls only, and a NEGATIVE "
+            "scatter delta vs solo_tick — the fused kernel must "
+            "actually replace the 2R scatter-min rounds + fslot "
+            "compaction",
+        contract=_FUSED_TICK,
+        build=_build_fused_tick,
+        # negative bound = a REQUIRED reduction: the fused tick must
+        # carry at least 2 fewer scatters than solo_tick (measured:
+        # 2R+1 fewer; tests/test_kernels.py pins the exact count)
+        delta=DeltaContract(base="solo_tick", max_scatter_delta=-2)),
+    EntryPoint(
+        name="fused_chunk",
+        doc="run_chunk with the kernel plane armed: donation must "
+            "survive the pallas path (the pool block stays in-place "
+            "across chunks)",
+        contract=_FUSED_CHUNK,
+        build=_build_fused_chunk),
     EntryPoint(
         name="resharded_resume",
         doc="campaign tick on a state reshard-restored from a "
@@ -398,7 +463,11 @@ def scenario_pins() -> list:
     """Config-level contract: the DEFAULT scenario resolution must never
     pick ``inbox_impl="sort"`` — the legacy sort path is oracle-only
     (ROADMAP item 6); only an explicit ``**.inboxImpl = "sort"`` key may
-    select it.  Returns Finding rows (empty = pinned)."""
+    select it.  The kernel plane adds two pins: an explicit
+    ``"pallas"`` key is honored when the plane is importable, and a
+    pallas request on a kernel-less install falls back to ``"scatter"``
+    (never to ``"sort"``, never an error).  Returns Finding rows
+    (empty = pinned)."""
     from oversim_tpu.analysis.findings import Finding
     from oversim_tpu.config import scenario
     from oversim_tpu.config.ini import IniFile
@@ -425,4 +494,28 @@ def scenario_pins() -> list:
             message="explicit **.inboxImpl = \"sort\" was not honored "
                     "— the oracle path became unreachable",
             measured=sim_sort.ep.inbox_impl, limit="sort"))
+    # kernel-plane availability fallback: a "pallas" request without
+    # the plane resolves to the scatter default, loudly but non-fatally
+    fallback = scenario.resolve_inbox_impl("pallas", available=False,
+                                           warn=False)
+    if fallback != "scatter":
+        out.append(Finding(
+            pass_name="hlo", rule="pallas-unavailable-fallback",
+            where="config/scenario.py",
+            message="inboxImpl \"pallas\" on a kernel-less install "
+                    f"resolved to {fallback!r} — must fall back to "
+                    "the scatter default",
+            measured=fallback, limit="scatter"))
+    from oversim_tpu import kernels
+    if kernels.available():
+        pallas_ini = IniFile.loads(_DEFAULT_INI
+                                   + '\n**.inboxImpl = "pallas"\n')
+        sim_k = scenario.build_simulation(pallas_ini, "General")
+        if sim_k.ep.inbox_impl != "pallas":
+            out.append(Finding(
+                pass_name="hlo", rule="inbox-impl-override",
+                where="config/scenario.py",
+                message="explicit **.inboxImpl = \"pallas\" was not "
+                        "honored despite an available kernel plane",
+                measured=sim_k.ep.inbox_impl, limit="pallas"))
     return out

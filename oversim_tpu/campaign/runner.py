@@ -150,6 +150,7 @@ class Campaign:
             "replica_ids": list(self.ids),
             "s": self.s,
             "total": self.total,
+            "inbox_impl": self.sim.ep.inbox_impl,
         }
 
     # -- init ---------------------------------------------------------------
@@ -255,6 +256,7 @@ class Campaign:
             "replicas": self.p.replicas,
             "grid": self.grid,
             "s": self.s,
+            "inbox_impl": self.sim.ep.inbox_impl,
             "replica_ids": list(self.ids),
             "base_seed": self.p.base_seed,
             "confidence": confidence,

@@ -34,6 +34,49 @@ class ScenarioError(ValueError):
     pass
 
 
+def resolve_inbox_impl(value: str, *, available: bool | None = None,
+                       warn: bool = True) -> str:
+    """Resolve a raw ``**.inboxImpl`` string to the impl the engine runs.
+
+    - ``"scatter"`` — the zero-sort scatter-min default.
+    - ``"pallas"`` — the fused kernel plane (oversim_tpu/kernels/).
+      Falls back to ``"scatter"`` with a stderr note when the plane is
+      unimportable (``available`` overrides the probe for tests/pins).
+    - ``"sort"`` — ORACLE-ONLY legacy full-pool sort; selecting it
+      outside the test tier prints a stderr deprecation warning
+      (suppressed under pytest and with ``warn=False``).
+
+    Anything else raises :class:`ScenarioError`.
+    """
+    import os
+    import sys
+
+    impl = str(value).strip().strip('"')
+    if impl not in ("scatter", "sort", "pallas"):
+        raise ScenarioError(f"unsupported inboxImpl: {impl!r} "
+                            "(expected \"scatter\", \"pallas\" or "
+                            "\"sort\")")
+    quiet = not warn or "PYTEST_CURRENT_TEST" in os.environ
+    if impl == "pallas":
+        if available is None:
+            from oversim_tpu import kernels
+            available = kernels.available()
+        if not available:
+            if not quiet:
+                print("oversim-tpu: inboxImpl \"pallas\" requested but "
+                      "the kernel plane is unavailable (no "
+                      "jax.experimental.pallas) — falling back to "
+                      "\"scatter\"", file=sys.stderr)
+            return "scatter"
+    elif impl == "sort" and not quiet:
+        print("oversim-tpu: inboxImpl \"sort\" is deprecated and "
+              "oracle-only — it exists to pin the scatter/pallas paths "
+              "bit-identical in tests, not to run simulations; use "
+              "\"scatter\" (default) or \"pallas\" (kernel plane)",
+              file=sys.stderr)
+    return impl
+
+
 def _get(ini, config, suffix, default=None):
     return _value(ini.get(f"{HOST}.{suffix}", config), default)
 
@@ -335,19 +378,17 @@ def build_simulation(ini: IniFile, config: str = "General",
         cp = build_churn(ini, config)
     ap = build_app(ini, config, spec, trace=workload)
     mp = build_malicious(ini, config)
-    inbox_impl = str(_value(
-        ini.get("**.inboxImpl", config), "scatter")).strip('"')
-    if inbox_impl not in ("scatter", "sort"):
-        raise ScenarioError(f"unsupported inboxImpl: {inbox_impl!r} "
-                            "(expected \"scatter\" or \"sort\")")
+    inbox_impl = resolve_inbox_impl(_value(
+        ini.get("**.inboxImpl", config), "scatter"))
     ep = engine_params or sim_mod.EngineParams(
         transition_time=float(_value(
             ini.get("**.transitionTime", config), 0.0)),
         measurement_time=float(_value(
             ini.get("**.measurementTime", config), -1.0)),
         # **.inboxImpl: inbox grouping algorithm — "scatter" (zero-sort
-        # scatter-min rounds, default) | "sort" (legacy full-pool sort);
-        # this framework's ini extension, engine/pool.py build_inbox
+        # scatter-min rounds, default) | "pallas" (fused kernel plane,
+        # oversim_tpu/kernels/) | "sort" (ORACLE-ONLY legacy full-pool
+        # sort); this framework's ini extension, engine/pool.py
         inbox_impl=inbox_impl,
         malicious=mp,
         telemetry=build_telemetry(ini, config),

@@ -242,8 +242,11 @@ def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive,
     """Group due messages by destination into an index table.
 
     ``impl`` selects the grouping algorithm: ``"scatter"`` (default,
-    zero-sort scatter-min rounds) or ``"sort"`` (legacy full-pool
-    lexicographic sort).  Both return bit-identical results.
+    zero-sort scatter-min rounds), ``"pallas"`` (the fused kernel-plane
+    selection, oversim_tpu/kernels/inbox.py — the fused payload gather
+    is dropped here; the engine's fused phase consumes it directly) or
+    ``"sort"`` (legacy full-pool lexicographic sort, ORACLE-ONLY).  All
+    three return bit-identical results.
     ``hold`` ([P] bool) excludes messages from delivery entirely — see
     :func:`_due_masks`.
 
@@ -258,8 +261,13 @@ def build_inbox(pool: MsgPool, n: int, r: int, t_end, alive,
         return build_inbox_sort(pool, n, r, t_end, alive, hold)
     if impl == "scatter":
         return build_inbox_scatter(pool, n, r, t_end, alive, hold)
+    if impl == "pallas":
+        from oversim_tpu import kernels
+        inbox, delivered, to_dead, _gblk = kernels.inbox.fused_inbox(
+            pool, n, r, t_end, alive, hold)
+        return inbox, delivered, to_dead
     raise ValueError(f"unknown inbox_impl: {impl!r} "
-                     "(expected 'scatter' or 'sort')")
+                     "(expected 'scatter', 'pallas' or 'sort')")
 
 
 def free(pool: MsgPool, mask) -> MsgPool:
@@ -269,7 +277,7 @@ def free(pool: MsgPool, mask) -> MsgPool:
         t_deliver=jnp.where(mask, T_INF, pool.t_deliver))
 
 
-def alloc(pool: MsgPool, out: dict, want):
+def alloc(pool: MsgPool, out: dict, want, impl: str = "scatter"):
     """Write the tick's outbox into free pool slots — SORT-FREE.
 
     ``out`` maps field name -> [Q, ...] flattened outbox arrays;
@@ -282,28 +290,39 @@ def alloc(pool: MsgPool, out: dict, want):
     O(P log P) full-pool sorts, the dominant per-tick cost at P = 8N.
     The payload write stays one gather + one scatter of the packed
     [·, W] block plus the two i64 fields and the valid mask.
+
+    ``impl="pallas"`` computes the destination mapping with the fused
+    compaction kernel (oversim_tpu/kernels/outbox.py) instead of the
+    cumsum/fslot-scatter trio — bit-identical destinations and
+    overflow count; the payload write is shared.
     """
     p = pool.capacity
-    n_want = jnp.sum(want.astype(I32))
-    free = ~pool.valid
-    n_free = jnp.sum(free.astype(I32))
+    if impl == "pallas":
+        from oversim_tpu import kernels
+        dest, overflow = kernels.outbox.alloc_dest(pool.valid, want)
+    else:
+        n_want = jnp.sum(want.astype(I32))
+        free = ~pool.valid
+        n_free = jnp.sum(free.astype(I32))
 
-    # rank of each free slot among free slots / of each wanted message
-    # among wanted messages (exclusive prefix sums)
-    free_i = free.astype(I32)
-    free_rank = jnp.cumsum(free_i) - free_i            # [P]
-    want_i = want.astype(I32)
-    want_rank = jnp.cumsum(want_i) - want_i            # [Q]
+        # rank of each free slot among free slots / of each wanted
+        # message among wanted messages (exclusive prefix sums)
+        free_i = free.astype(I32)
+        free_rank = jnp.cumsum(free_i) - free_i            # [P]
+        want_i = want.astype(I32)
+        want_rank = jnp.cumsum(want_i) - want_i            # [Q]
 
-    # compact free-slot list: fslot[j] = index of the j-th free slot
-    # (p elsewhere, which scatters/reads as "dropped")
-    fslot = jnp.full((p,), p, I32).at[
-        jnp.where(free, free_rank, p)].set(
-        jnp.arange(p, dtype=I32), mode="drop")
-    # destination slot per outbox message; p (out of bounds, dropped)
-    # for unwanted messages and for wanted ones past the free supply
-    dest = jnp.where(want & (want_rank < n_free),
-                     fslot[jnp.minimum(want_rank, p - 1)], p)
+        # compact free-slot list: fslot[j] = index of the j-th free slot
+        # (p elsewhere, which scatters/reads as "dropped")
+        fslot = jnp.full((p,), p, I32).at[
+            jnp.where(free, free_rank, p)].set(
+            jnp.arange(p, dtype=I32), mode="drop")
+        # destination slot per outbox message; p (out of bounds,
+        # dropped) for unwanted messages and for wanted ones past the
+        # free supply
+        dest = jnp.where(want & (want_rank < n_free),
+                         fslot[jnp.minimum(want_rank, p - 1)], p)
+        overflow = jnp.maximum(n_want - n_free, 0)
 
     out_blk = pack_block(out, pool.kl, pool.rmax)
     new_pool = dataclasses.replace(
@@ -314,5 +333,4 @@ def alloc(pool: MsgPool, out: dict, want):
         stamp=pool.stamp.at[dest].set(
             jnp.asarray(out["stamp"], I64), mode="drop"),
         valid=pool.valid.at[dest].set(True, mode="drop"))
-    overflow = jnp.maximum(n_want - n_free, 0)
     return new_pool, overflow

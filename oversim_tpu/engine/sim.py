@@ -62,8 +62,11 @@ class EngineParams:
     window: float = 0.010          # tick window (s)
     inbox_slots: int = 8           # R — msgs consumed per node per tick
     inbox_impl: str = "scatter"    # inbox grouping: "scatter" (zero-sort
-                                   # scatter-min rounds, default) | "sort"
-                                   # (legacy full-pool lexicographic sort)
+                                   # scatter-min rounds, default) |
+                                   # "pallas" (fused kernel plane,
+                                   # oversim_tpu/kernels/ — also arms the
+                                   # fused outbox allocator) | "sort"
+                                   # (legacy full-pool sort, ORACLE-ONLY)
     outbox_slots: int = 16         # MOUT — msgs emitted per node per tick
     pool_factor: int = 8           # P = pool_factor * N message slots
     rmax: int = 16                 # node-list payload width
@@ -253,27 +256,31 @@ class Simulation:
                                   r_reset)
         return churn_state, alive, pre_killed, node_keys, ul_state, logic_state
 
+    def _hold_mask(self, s: SimState):
+        """[P] hold mask for the service plane's parked EXT_OUT
+        responses, or None when ``ext_hold_slot`` is disarmed."""
+        if self.ep.ext_hold_slot < 0:
+            return None
+        return ((s.pool.kind == EXT_OUT_KIND)
+                & (s.pool.dst == self.ep.ext_hold_slot))
+
     def _phase_inbox_select(self, s: SimState, t_end, alive):
         """Phase 3a: pick each destination's R earliest due messages
         (scatter-min rounds by default — zero full-pool sorts; see
         engine/pool.py and ``EngineParams.inbox_impl``)."""
-        hold = None
-        if self.ep.ext_hold_slot >= 0:
-            hold = ((s.pool.kind == EXT_OUT_KIND)
-                    & (s.pool.dst == self.ep.ext_hold_slot))
         return pool_mod.build_inbox(
             s.pool, self.n, self.ep.inbox_slots, t_end, alive,
-            impl=self.ep.inbox_impl, hold=hold)
+            impl=self.ep.inbox_impl, hold=self._hold_mask(s))
 
-    def _phase_inbox_gather(self, s: SimState, t_next, inbox):
-        """Phase 3b: ONE gather of the packed [P, W] block for all the
-        32-bit fields of the selected messages (pool.py packed layout,
-        PERFORMANCE.md lever #3) into the [N, R] Msg view."""
+    def _msgs_from_block(self, s: SimState, t_next, inbox, blk):
+        """[N, R] index table + gathered [N, R, W] payload block → the
+        Msg view (shared by the lax gather and the fused kernel path;
+        the two i64 fields are always gathered here off the index
+        table — the Pallas core has no 64-bit lanes)."""
         safe = jnp.maximum(inbox, 0)
-        blk = s.pool.blk[safe]                        # [N, R, W]
         ncol = len(pool_mod.SCAL_COLS)
         col = lambda name: blk[..., pool_mod._COL[name]]  # noqa: E731
-        msgs = Msg(
+        return Msg(
             valid=inbox >= 0,
             t_deliver=jnp.maximum(s.pool.t_deliver[safe], t_next),
             src=col("src"), dst=col("dst"),
@@ -285,11 +292,33 @@ class Simulation:
             c=col("c"), d=col("d"),
             nodes=blk[..., ncol + s.pool.kl:], size_b=col("size_b"),
             stamp=s.pool.stamp[safe])
-        return msgs
+
+    def _phase_inbox_gather(self, s: SimState, t_next, inbox):
+        """Phase 3b: ONE gather of the packed [P, W] block for all the
+        32-bit fields of the selected messages (pool.py packed layout,
+        PERFORMANCE.md lever #3) into the [N, R] Msg view."""
+        blk = s.pool.blk[jnp.maximum(inbox, 0)]       # [N, R, W]
+        return self._msgs_from_block(s, t_next, inbox, blk)
+
+    def _phase_inbox_fused(self, s: SimState, t_next, t_end, alive):
+        """Phase 3 (kernel plane): selection AND the [P, W] payload
+        gather in one Pallas kernel (oversim_tpu/kernels/inbox.py) —
+        bit-identical to select+gather, pinned in tests/test_kernels.py
+        under interpret mode."""
+        from oversim_tpu import kernels
+        inbox, delivered, to_dead, gblk = kernels.inbox.fused_inbox(
+            s.pool, self.n, self.ep.inbox_slots, t_end, alive,
+            hold=self._hold_mask(s))
+        return (self._msgs_from_block(s, t_next, inbox, gblk),
+                delivered, to_dead)
 
     def _phase_inbox(self, s: SimState, t_next, t_end, alive):
         """Phase 3: inbox select + gather composed (profiling.py times
-        the two halves separately)."""
+        the two halves separately; ``inbox_impl="pallas"`` fuses them
+        into one kernel and is timed as a single ``inbox_fused``
+        phase)."""
+        if self.ep.inbox_impl == "pallas":
+            return self._phase_inbox_fused(s, t_next, t_end, alive)
         inbox, delivered, to_dead = self._phase_inbox_select(s, t_end, alive)
         msgs = self._phase_inbox_gather(s, t_next, inbox)
         return msgs, delivered, to_dead
@@ -365,7 +394,9 @@ class Simulation:
         flat["src"] = jnp.broadcast_to(node_idx[:, None],
                                        out_valid.shape).reshape(-1)
         new_pool, pool_overflow = pool_mod.alloc(
-            new_pool, flat, (out_valid & ok).reshape(-1))
+            new_pool, flat, (out_valid & ok).reshape(-1),
+            impl=("pallas" if self.ep.inbox_impl == "pallas"
+                  else "scatter"))
 
         # stats
         new_stats = stats_mod.record(s.stats, events, measuring)

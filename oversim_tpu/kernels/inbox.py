@@ -1,0 +1,165 @@
+"""Fused inbox kernel: top-R selection + packed payload gather in ONE
+Pallas pass over the pool.
+
+The scatter-min oracle (engine/pool.py ``build_inbox_scatter``) builds
+the [N, R] inbox table in R rounds of two [P]->[N] scatter-mins each,
+then ``Simulation._phase_inbox_gather`` issues a separate [P, W] block
+gather — 2R+1 independent XLA ops, each streaming the pool through HBM.
+This kernel keeps the per-destination top-R registers in VMEM and does
+everything in one serial sweep:
+
+  pass 1 (over P): for each due message, a stable insertion into its
+    destination's R-row register file sorted by (t_deliver, pool index).
+    Pool indices arrive in increasing order and (t, idx) keys are
+    unique, so "count of existing entries with key <= mine" IS the
+    insertion position — exactly the oracle's stable tie-break.  An
+    insertion into a full row evicts the current last entry, whose
+    delivered flag is undone (R-overflow retention: the evicted message
+    stays pooled for next tick).
+  pass 2 (over N*R): gather the packed [P, W] payload rows of the
+    selected indices into the [N, R, W] message block (row 0 for empty
+    slots, masked by ``inbox < 0`` downstream — the oracle's
+    ``jnp.maximum(inbox, 0)`` gather semantics).
+
+i64 on Pallas-TPU: the core has no 64-bit lanes, so ``t_deliver`` is
+decomposed OUTSIDE the kernel into two non-negative i32 halves
+(hi = t >> 31, lo = t & 0x7fffffff; t < 2^62 so both fit signed i32)
+— lexicographic (hi, lo) compare reproduces the i64 order exactly.
+The two i64 fields themselves (t_deliver, stamp) are gathered outside
+the kernel off the returned index table ([N, R] gathers from [P], tiny
+next to the [P, W] block).
+
+Bit-identity with the oracle — including t ties, R-overflow eviction,
+dead destinations and the ``ext_hold_slot`` hold mask (both applied
+outside via ``pool._due_masks``) — is pinned by
+tests/test_kernels.py under ``pallas_call(interpret=True)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from oversim_tpu.engine import pool as pool_mod
+
+I32 = jnp.int32
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _inbox_kernel(due_ref, dst_ref, thi_ref, tlo_ref, blk_ref,
+                  inbox_ref, delivered_ref, gblk_ref,
+                  khi_ref, klo_ref, *, p, n, r, w):
+    """One program: select pass over P, then gather pass over N*R.
+
+    khi/klo are the VMEM [N, R] sort-key registers mirroring inbox_ref
+    (i32 max = empty, so any real key inserts before them).  All loop
+    indices are cast to i32 — under x64 ``fori_loop`` counts in i64,
+    which must not leak into i32 ref stores.
+    """
+    inbox_ref[:] = jnp.full((n, r), -1, I32)
+    delivered_ref[:] = jnp.zeros((p,), I32)
+    khi_ref[:] = jnp.full((n, r), _I32_MAX, I32)
+    klo_ref[:] = jnp.full((n, r), _I32_MAX, I32)
+    pos_iota = jax.lax.broadcasted_iota(I32, (r, 1), 0).reshape(r)
+
+    def select_body(iv, carry):
+        i = iv.astype(I32)
+
+        @pl.when(due_ref[i] != 0)
+        def _():
+            d = dst_ref[i]
+            hi = thi_ref[i]
+            lo = tlo_ref[i]
+            row_hi = khi_ref[d, :]
+            row_lo = klo_ref[d, :]
+            row_ix = inbox_ref[d, :]
+            # stable position: entries with key <= (hi, lo) stay ahead;
+            # earlier pool indices inserted at equal t compare <= via lo
+            le = (row_hi < hi) | ((row_hi == hi) & (row_lo <= lo))
+            pos = jnp.sum(le.astype(I32))
+
+            @pl.when(pos < r)
+            def _():
+                evict = row_ix[r - 1]
+                keep = pos_iota < pos
+                shift = pos_iota > pos
+                prev_hi = pltpu.roll(row_hi, 1, 0)
+                prev_lo = pltpu.roll(row_lo, 1, 0)
+                prev_ix = pltpu.roll(row_ix, 1, 0)
+                khi_ref[d, :] = jnp.where(
+                    keep, row_hi, jnp.where(shift, prev_hi, hi))
+                klo_ref[d, :] = jnp.where(
+                    keep, row_lo, jnp.where(shift, prev_lo, lo))
+                inbox_ref[d, :] = jnp.where(
+                    keep, row_ix, jnp.where(shift, prev_ix, i))
+                delivered_ref[i] = I32(1)
+
+                @pl.when(evict >= 0)
+                def _():
+                    # R-overflow: the displaced last entry goes back to
+                    # "not delivered" — it stays pooled for next tick
+                    delivered_ref[evict] = I32(0)
+
+        return carry
+
+    jax.lax.fori_loop(0, p, select_body, None)
+
+    def gather_body(jv, carry):
+        j = jv.astype(I32)
+        nn = j // I32(r)
+        rr = j % I32(r)
+        ix = inbox_ref[nn, rr]
+        gblk_ref[nn, rr, :] = blk_ref[jnp.maximum(ix, 0), :]
+        return carry
+
+    jax.lax.fori_loop(0, n * r, gather_body, None)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "r", "interpret"))
+def _fused_call(due, dstc, thi, tlo, blk, *, n, r, interpret):
+    p, w = blk.shape
+    kernel = functools.partial(_inbox_kernel, p=p, n=n, r=r, w=w)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, r), I32),      # inbox
+            jax.ShapeDtypeStruct((p,), I32),        # delivered
+            jax.ShapeDtypeStruct((n, r, w), I32),   # gathered block
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n, r), I32),                # khi
+            pltpu.VMEM((n, r), I32),                # klo
+        ],
+        interpret=interpret,
+    )(due, dstc, thi, tlo, blk)
+
+
+def fused_inbox(pool, n: int, r: int, t_end, alive, hold=None,
+                interpret: bool | None = None):
+    """Fused inbox select + gather.
+
+    Same contract as ``pool.build_inbox`` plus the gathered payload:
+    returns ``(inbox [N,R] i32, delivered [P] bool, dropped_dead [P]
+    bool, gblk [N,R,W] i32)``.  ``interpret=None`` auto-selects the
+    Pallas interpreter off-TPU (kernels.interpret_default)."""
+    from oversim_tpu import kernels
+
+    if interpret is None:
+        interpret = kernels.interpret_default()
+    due, to_dead = pool_mod._due_masks(pool, n, t_end, alive, hold)
+    # oracle semantics: destinations clip into [0, n) BEFORE grouping
+    dstc = jnp.clip(pool.dst, 0, n - 1).astype(I32)
+    # hi/lo i32 halves of t_deliver; non-due slots masked to 0 so the
+    # T_INF sentinel (2^62) never overflows the decomposition — the
+    # kernel only reads keys where due != 0
+    t_m = jnp.where(due, pool.t_deliver, 0)
+    thi = (t_m >> 31).astype(I32)
+    tlo = (t_m & jnp.int64(0x7FFFFFFF)).astype(I32)
+    inbox, delivered, gblk = _fused_call(
+        due.astype(I32), dstc, thi, tlo, pool.blk,
+        n=n, r=r, interpret=bool(interpret))
+    return inbox, delivered.astype(bool), to_dead, gblk

@@ -1,0 +1,105 @@
+"""Fused outbox-allocation kernel: free-slot compaction + destination
+assignment in one Pallas pass.
+
+The sort-free allocator (engine/pool.py ``alloc``) builds the
+wanted-message -> free-slot mapping from two full-length exclusive
+cumsums plus a compaction scatter (``fslot``).  This kernel replaces
+that trio with two serial counting passes — the compacted free-slot
+list lives in VMEM, the two running counters in SMEM:
+
+  pass 1 (over P): append each free slot's index to the fslot list;
+  pass 2 (over Q): each wanted message takes the next fslot entry (or
+    the out-of-bounds sentinel ``p`` once the free supply is exhausted
+    — exactly the oracle's ``mode="drop"`` overflow semantics).
+
+The payload write itself (one gather + one scatter of the packed
+[·, W] block plus the i64 fields) stays outside: it is already a
+single fused scatter per field group, and keeping it in lax means the
+kernel output is just the [Q] destination vector + the overflow count,
+bit-identical to the cumsum path (tests/test_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+I32 = jnp.int32
+
+
+def _dest_kernel(valid_ref, want_ref, dest_ref, over_ref,
+                 fslot_ref, cnt_ref, *, p, q):
+    """cnt_ref (SMEM): [0] = free slots seen, [1] = wanted msgs seen."""
+    cnt_ref[0] = I32(0)
+    cnt_ref[1] = I32(0)
+    fslot_ref[:] = jnp.full((p,), p, I32)
+
+    def free_body(iv, carry):
+        i = iv.astype(I32)
+
+        @pl.when(valid_ref[i] == 0)
+        def _():
+            fslot_ref[cnt_ref[0]] = i
+            cnt_ref[0] = cnt_ref[0] + 1
+
+        return carry
+
+    jax.lax.fori_loop(0, p, free_body, None)
+    n_free = cnt_ref[0]
+
+    def want_body(jv, carry):
+        j = jv.astype(I32)
+
+        @pl.when(want_ref[j] != 0)
+        def _():
+            wr = cnt_ref[1]
+            dest_ref[j] = jnp.where(wr < n_free,
+                                    fslot_ref[jnp.minimum(wr, p - 1)],
+                                    I32(p))
+            cnt_ref[1] = wr + 1
+
+        @pl.when(want_ref[j] == 0)
+        def _():
+            dest_ref[j] = I32(p)
+
+        return carry
+
+    jax.lax.fori_loop(0, q, want_body, None)
+    over_ref[0] = jnp.maximum(cnt_ref[1] - n_free, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dest_call(valid, want, *, interpret):
+    p = valid.shape[0]
+    q = want.shape[0]
+    kernel = functools.partial(_dest_kernel, p=p, q=q)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((q,), I32),        # dest
+            jax.ShapeDtypeStruct((1,), I32),        # overflow
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((p,), I32),                  # fslot
+            pltpu.SMEM((2,), I32),                  # counters
+        ],
+        interpret=interpret,
+    )(valid, want)
+
+
+def alloc_dest(valid, want, interpret: bool | None = None):
+    """(dest [Q] i32, overflow i32 scalar) — the j-th wanted message maps
+    to the j-th free slot, ``p`` (dropped) for unwanted/overflowed
+    messages; bit-identical to the cumsum/fslot path in
+    ``pool.alloc``."""
+    from oversim_tpu import kernels
+
+    if interpret is None:
+        interpret = kernels.interpret_default()
+    dest, over = _dest_call(valid.astype(I32), want.astype(I32),
+                            interpret=bool(interpret))
+    return dest, over[0]

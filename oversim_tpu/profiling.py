@@ -15,6 +15,11 @@ diagnosis).  This module times the phases of ``Simulation.step``
   node_step     tick context + the vmapped per-node logic sweep
   alloc_stats   underlay send, sort-free pool alloc, stat folding
 
+Under the kernel plane (``inbox_impl="pallas"``) selection and gather
+are ONE fused Pallas kernel — the report then carries a single
+``inbox_fused`` phase in their place (plus ``kernel_plane: true``)
+instead of silently attributing the kernel time to neither half.
+
 Each phase is jitted SEPARATELY and timed with ``block_until_ready``
 over ``n_ticks`` real ticks.  Sub-jits lose cross-phase fusion, so the
 phase sum exceeds the fused tick cost — the per-phase SHARES are the
@@ -44,6 +49,14 @@ import jax
 
 PHASES = ("horizon", "churn", "inbox_select", "inbox_gather", "node_step",
           "alloc_stats")
+# kernel-plane layout: the fused Pallas kernel owns both inbox halves
+PHASES_FUSED = ("horizon", "churn", "inbox_fused", "node_step",
+                "alloc_stats")
+
+
+def phases_for(inbox_impl: str) -> tuple:
+    """The phase layout a Simulation's tick decomposes into."""
+    return PHASES_FUSED if inbox_impl == "pallas" else PHASES
 
 
 def enabled() -> bool:
@@ -64,6 +77,9 @@ def _jit_phases(sim):
             lambda s, te, alive: sim._phase_inbox_select(s, te, alive)),
         "inbox_gather": jax.jit(
             lambda s, tn, inbox: sim._phase_inbox_gather(s, tn, inbox)),
+        "inbox_fused": jax.jit(
+            lambda s, tn, te, alive: sim._phase_inbox_fused(
+                s, tn, te, alive)),
         "node_step": jax.jit(
             lambda s, tn, te, alive, pk, cs, nk, ul, lg, msgs, rn:
             sim._phase_node_step(s, tn, te, alive, pk, cs, nk, ul, lg,
@@ -102,7 +118,9 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
     pays all phase compiles and is EXCLUDED from the averages.
     """
     fns = _jit_phases(sim)
-    totals = {p: 0.0 for p in PHASES}
+    fused_inbox = sim.ep.inbox_impl == "pallas"
+    phases = phases_for(sim.ep.inbox_impl)
+    totals = {p: 0.0 for p in phases}
     compile_s = 0.0
     measured = 0
     tick_rows = []    # per measured tick: {phase: ms} — Perfetto feed
@@ -123,15 +141,21 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
             fns["churn"](s, t_next, t_end, r_churn, r_keys, r_reset, r_mig))
         dt_c = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        inbox, delivered, to_dead = jax.block_until_ready(
-            fns["inbox_select"](s, t_end, alive))
-        dt_is = time.perf_counter() - t0
+        if fused_inbox:
+            t0 = time.perf_counter()
+            msgs, delivered, to_dead = jax.block_until_ready(
+                fns["inbox_fused"](s, t_next, t_end, alive))
+            inbox_dts = (time.perf_counter() - t0,)
+        else:
+            t0 = time.perf_counter()
+            inbox, delivered, to_dead = jax.block_until_ready(
+                fns["inbox_select"](s, t_end, alive))
+            dt_is = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        msgs = jax.block_until_ready(
-            fns["inbox_gather"](s, t_next, inbox))
-        dt_ig = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            msgs = jax.block_until_ready(
+                fns["inbox_gather"](s, t_next, inbox))
+            inbox_dts = (dt_is, time.perf_counter() - t0)
 
         t0 = time.perf_counter()
         (logic_state, out_fields, out_valid, out_overflow, events,
@@ -154,21 +178,22 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
             continue
         measured += 1
         row = {}
-        for p, dt in zip(PHASES, (dt_h, dt_c, dt_is, dt_ig, dt_n, dt_a)):
+        for p, dt in zip(phases, (dt_h, dt_c, *inbox_dts, dt_n, dt_a)):
             totals[p] += dt
             row[p] = round(dt * 1e3, 3)
         tick_rows.append(row)
 
     denom = max(measured, 1)
-    phase_ms = {p: round(totals[p] / denom * 1e3, 3) for p in PHASES}
+    phase_ms = {p: round(totals[p] / denom * 1e3, 3) for p in phases}
     split_sum = sum(totals.values()) / denom
     report = {
         "metric": "tick_phase_breakdown",
         "n_ticks": measured,
         "inbox_impl": sim.ep.inbox_impl,
+        "kernel_plane": fused_inbox,
         "phase_ms_per_tick": phase_ms,
         "phase_frac": {p: round(totals[p] / max(sum(totals.values()), 1e-12),
-                                4) for p in PHASES},
+                                4) for p in phases},
         "split_sum_ms_per_tick": round(split_sum * 1e3, 3),
         # per-tick phase rows (ms) — telemetry.PerfettoTrace.add_profile
         # lays them out as back-to-back tick.<phase> spans

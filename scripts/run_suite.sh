@@ -76,6 +76,21 @@ else
   status=1
   echo "FAIL  fleet_smoke  $(tail -1 "$STATE/fleet_smoke.log")"
 fi
+# fused-tick kernel gate (scripts/fused_gate.py): 64 churned chord
+# ticks under pallas_call(interpret=True) must be bit-identical to the
+# lax-scatter oracle, and the compiled fused tick must drop >= 2R+1
+# scatter ops (zero sorts, zero custom-calls in interpret mode)
+fused_marker="$STATE/fused_gate.ok"
+if [ -f "$fused_marker" ]; then
+  echo "skip  fused_gate (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/fused_gate.py > "$STATE/fused_gate.log" 2>&1; then
+  touch "$fused_marker"
+  echo "PASS  fused_gate  $(tail -1 "$STATE/fused_gate.log")"
+else
+  status=1
+  echo "FAIL  fused_gate  $(tail -1 "$STATE/fused_gate.log")"
+fi
 # AOT compile-plane smoke (scripts/aot_smoke.py): the same tiny scenario
 # in TWO processes sharing one artifact store — the second must pre-warm
 # every registered entry from exported artifacts with ZERO fresh

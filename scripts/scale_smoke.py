@@ -48,7 +48,9 @@ def _merge(rows, row):
             if not (r.get("n") == row.get("n")
                     and r.get("mode") == row.get("mode")
                     and r.get("overlay") == row.get("overlay")
-                    and r.get("platform") == row.get("platform"))] + [row]
+                    and r.get("platform") == row.get("platform")
+                    and r.get("inbox_impl", "scatter")
+                    == row.get("inbox_impl", "scatter"))] + [row]
 
 
 def _save_row(row):
@@ -98,7 +100,8 @@ def _setup_jax(platform):
     return jax
 
 
-def _build(jax, overlay, n, churn, window, interval=0.2):
+def _build(jax, overlay, n, churn, window, interval=0.2,
+           inbox_impl="scatter"):
     from oversim_tpu import churn as churn_mod
     from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
     from oversim_tpu.common import lookup as lk_mod
@@ -115,16 +118,18 @@ def _build(jax, overlay, n, churn, window, interval=0.2):
     cp = churn_mod.ChurnParams(
         model=churn, target_num=n,
         lifetime_mean=10_000.0, init_interval=10.0 / n)
-    ep = sim_mod.EngineParams(window=window, inbox_slots=8, pool_factor=8)
+    ep = sim_mod.EngineParams(window=window, inbox_slots=8, pool_factor=8,
+                              inbox_impl=inbox_impl)
     return sim_mod.Simulation(logic, cp, engine_params=ep), cp
 
 
-def ladder_row(jax, overlay, n, measure_wall):
+def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter"):
     """Throughput measurement at N: warm, then measured windows — both
     device-resident (run_until_device; one dispatch + one device_get of
     the counter leaves per window, the bench.py round-7 loop)."""
     from bench import _fetch_window_leaves, _summary_from_leaves
-    sim, cp = _build(jax, overlay, n, "none", window=0.2)
+    sim, cp = _build(jax, overlay, n, "none", window=0.2,
+                     inbox_impl=inbox_impl)
     dev = jax.devices()[0]
     st = sim.init(seed=7)
     warm_until = cp.init_finished_time + 20.0
@@ -151,6 +156,8 @@ def ladder_row(jax, overlay, n, measure_wall):
     return {
         "mode": "ladder", "overlay": overlay, "n": n,
         "platform": dev.platform,
+        "inbox_impl": inbox_impl,
+        "kernel_plane": inbox_impl == "pallas",
         "lookups_per_sec": round(rate, 1),
         "delivered": int(delivered), "sent": int(sent),
         "warm_wall_s": round(compile_wall, 1),
@@ -161,10 +168,10 @@ def ladder_row(jax, overlay, n, measure_wall):
     }
 
 
-def churn_row(jax, overlay, n, t_sim):
+def churn_row(jax, overlay, n, t_sim, inbox_impl="scatter"):
     """LifetimeChurn bounds smoke at N (config #2 envelope)."""
     sim, cp = _build(jax, overlay, n, "lifetime", window=0.2,
-                     interval=60.0)
+                     interval=60.0, inbox_impl=inbox_impl)
     dev = jax.devices()[0]
     t0 = time.time()
     st = sim.init(seed=1)
@@ -187,6 +194,8 @@ def churn_row(jax, overlay, n, t_sim):
     row = {
         "mode": "churn_smoke", "overlay": overlay, "n": n,
         "platform": dev.platform,
+        "inbox_impl": inbox_impl,
+        "kernel_plane": inbox_impl == "pallas",
         "t_sim": out["_t_sim"], "wall_s": round(time.time() - t0, 1),
         "alive": out["_alive"],
         "sent": int(out.get("kbr_sent", 0)),
@@ -265,6 +274,10 @@ def main():
     ap.add_argument("--t", type=float, default=600.0)
     ap.add_argument("--measure", type=float, default=60.0)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--inbox-impl", default="scatter",
+                    choices=["scatter", "pallas", "sort"],
+                    help="inbox implementation (pallas = fused kernel "
+                    "plane; falls back to scatter when unavailable)")
     args = ap.parse_args()
 
     if os.environ.get("OVERSIM_SCALE_CHILD") != "1":
@@ -278,6 +291,8 @@ def main():
 
     try:
         jax = _setup_jax(args.platform)
+        from oversim_tpu.config import scenario as scenario_mod
+        inbox_impl = scenario_mod.resolve_inbox_impl(args.inbox_impl)
         # run manifest — the orchestrator routes this line to the
         # artifact's top-level "manifest" key (telemetry.run_manifest)
         from oversim_tpu import telemetry as telemetry_mod
@@ -285,21 +300,25 @@ def main():
             config={"mode": "ladder" if args.ladder else "churn_smoke",
                     "ns": args.ns if args.ladder else None, "n": args.n,
                     "overlay": args.overlay, "t": args.t,
-                    "measure": args.measure, "platform": args.platform},
+                    "measure": args.measure, "platform": args.platform,
+                    "inbox_impl": inbox_impl,
+                    "kernel_plane": inbox_impl == "pallas"},
             artifacts={"artifact":
                        os.environ.get("OVERSIM_SCALE_ARTIFACT")}))
         if args.ladder:
             for n in [int(x) for x in args.ns.split(",") if x]:
                 if _remaining() < 120:
                     break
-                row = ladder_row(jax, args.overlay, n, args.measure)
+                row = ladder_row(jax, args.overlay, n, args.measure,
+                                 inbox_impl=inbox_impl)
                 if row is None:
                     continue
                 _save_row(row)
                 rows = _merge(rows, row)
                 _emit({"rows": rows})
         else:
-            row = churn_row(jax, args.overlay, args.n, args.t)
+            row = churn_row(jax, args.overlay, args.n, args.t,
+                            inbox_impl=inbox_impl)
             _save_row(row)
             rows = _merge(rows, row)
             _emit({"rows": rows})

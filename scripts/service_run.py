@@ -82,8 +82,10 @@ def _build_sim(args):
     cp = churn_mod.ChurnParams(model=args.churn, target_num=args.n,
                                lifetime_mean=args.lifetime,
                                init_interval=10.0 / args.n)
+    from oversim_tpu.config import scenario as scenario_mod
     ep = sim_mod.EngineParams(
         window=args.engine_window, inbox_slots=8, pool_factor=8,
+        inbox_impl=scenario_mod.resolve_inbox_impl(args.inbox_impl),
         telemetry=telemetry_mod.TelemetryParams(
             sample_ticks=args.telemetry,
             window=args.telemetry_window))
@@ -128,6 +130,10 @@ def main():
     ap.add_argument("--lifetime", type=float, default=10_000.0)
     ap.add_argument("--interval", type=float, default=0.2)
     ap.add_argument("--engine-window", type=float, default=0.2)
+    ap.add_argument("--inbox-impl", default="scatter",
+                    choices=["scatter", "pallas", "sort"],
+                    help="inbox implementation (pallas = fused kernel "
+                    "plane; falls back to scatter when unavailable)")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--out", default=None, help="incremental atomic "
                     "artifact path")
@@ -171,6 +177,12 @@ def main():
             checkpoint_every=args.checkpoint_every,
             checkpoint_path=args.checkpoint,
             double_buffer=not args.single_buffer)
+
+    # record the ACTIVE impl (ini key or --inbox-impl, after any
+    # pallas→scatter availability fallback) — resume recomputes the
+    # same value from the same flags/ini, so the config hash matches
+    config["inbox_impl"] = sim.ep.inbox_impl
+    config["kernel_plane"] = sim.ep.inbox_impl == "pallas"
 
     summarize = None
     if args.replicas:

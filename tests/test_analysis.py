@@ -17,8 +17,8 @@ import pytest
 from oversim_tpu.analysis import ast_pass, findings as findings_mod
 from oversim_tpu.analysis import contracts as contracts_mod
 from oversim_tpu.analysis.hlo_text import (
-    collective_census, donated_leaf_count, dtype_census,
-    host_transfer_count)
+    collective_census, custom_call_census, donated_leaf_count,
+    dtype_census, host_transfer_count)
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -195,6 +195,23 @@ def test_host_transfer_count():
     assert host_transfer_count(txt) == 3
 
 
+def test_custom_call_census_by_target():
+    txt = ("ENTRY %e {\n"
+           "  %m = f32[8]{0} custom-call(%x), "
+           "custom_call_target=\"tpu_custom_call\"\n"
+           "  %m2 = f32[8]{0} custom-call-start(%y), "
+           "custom_call_target=\"tpu_custom_call\"\n"
+           "  %r = f32[] custom-call(%x), "
+           "custom_call_target=\"rogue_vendor_kernel\"\n"
+           "  %u = f32[] custom-call(%x)\n"
+           "}\n")
+    assert custom_call_census(txt) == {"tpu_custom_call": 2,
+                                       "rogue_vendor_kernel": 1,
+                                       "<unknown>": 1}
+    assert custom_call_census("ENTRY %e { ROOT %r = f32[] add(%x,%y) }\n") \
+        == {}
+
+
 def test_dtype_census_and_allowlist():
     txt = ("  %a = f64[8]{0} add(%x, %y)\n"
            "  %b = bf16[4]{0} convert(%a)\n"
@@ -234,14 +251,24 @@ def test_registry_shape():
     names = list(contracts_mod.REGISTRY)
     assert names == ["solo_tick", "solo_chunk", "run_until_device",
                      "campaign_tick", "telemetry_tick", "service_window",
-                     "resharded_resume"]
+                     "fused_tick", "fused_chunk", "resharded_resume"]
     tel = contracts_mod.REGISTRY["telemetry_tick"]
     assert tel.delta is not None and tel.delta.base == "solo_tick"
-    for donated in ("solo_chunk", "run_until_device", "service_window"):
+    for donated in ("solo_chunk", "run_until_device", "service_window",
+                    "fused_chunk"):
         assert contracts_mod.REGISTRY[donated].contract.require_donation
     camp = contracts_mod.REGISTRY["campaign_tick"].contract
     assert camp.collectives_enforced
     assert camp.allowed_collectives == frozenset()
+    # kernel-plane entries: custom-call allowlist armed, and the fused
+    # tick must DROP scatters vs solo_tick (negative delta bound)
+    for kname in ("fused_tick", "fused_chunk"):
+        kc = contracts_mod.REGISTRY[kname].contract
+        assert kc.custom_calls_enforced
+        assert kc.allowed_custom_calls == frozenset({"tpu_custom_call"})
+    fused = contracts_mod.REGISTRY["fused_tick"]
+    assert fused.delta is not None and fused.delta.base == "solo_tick"
+    assert fused.delta.max_scatter_delta < 0
 
 
 def test_register_entry_validation():
@@ -336,6 +363,17 @@ def test_seeded_trace_breach_exits_nonzero(tmp_path):
     assert rc == 1 and doc["ok"] is False
     [f] = [f for f in doc["findings"] if f["rule"] == "recompile"]
     assert f["pass"] == "trace" and f["measured"] == 1
+
+
+def test_seeded_kernel_breach_exits_nonzero(tmp_path):
+    """--seed-breach kernel: a planted off-allowlist custom-call vs the
+    fused_tick allowlist — pure-text, no backend, exits non-zero."""
+    rc, doc = _run_seed("kernel", tmp_path)
+    assert rc == 1 and doc["ok"] is False
+    [f] = [f for f in doc["findings"] if f["rule"] == "custom-calls"]
+    assert f["pass"] == "hlo"
+    assert f["measured"] == {"rogue_vendor_kernel": 1}
+    assert f["limit"] == ["tpu_custom_call"]
 
 
 def test_seeded_compile_breach_exits_nonzero(tmp_path):

@@ -104,11 +104,26 @@ def test_scenario_kademlia(ini):
 
 def test_scenario_inbox_impl_key(ini):
     """``**.inboxImpl`` selects the inbox grouping implementation
-    (engine/pool.py); anything but scatter/sort is a config error."""
+    (engine/pool.py); anything but scatter/pallas/sort is a config
+    error."""
     sim = scenario.build_simulation(ini, "KadSortInbox")
     assert sim.ep.inbox_impl == "sort"
     with pytest.raises(scenario.ScenarioError):
         scenario.build_simulation(ini, "KadBadInbox")
+
+
+def test_resolve_inbox_impl_kernel_plane():
+    """The pallas key resolves by kernel-plane availability: honored
+    when importable, a loud scatter fallback when not (never an
+    error, never sort)."""
+    assert scenario.resolve_inbox_impl(
+        "pallas", available=True, warn=False) == "pallas"
+    assert scenario.resolve_inbox_impl(
+        "pallas", available=False, warn=False) == "scatter"
+    assert scenario.resolve_inbox_impl('"scatter"') == "scatter"
+    assert scenario.resolve_inbox_impl("sort") == "sort"
+    with pytest.raises(scenario.ScenarioError):
+        scenario.resolve_inbox_impl("quantum")
 
 
 @pytest.mark.skipif(
