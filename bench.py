@@ -588,6 +588,29 @@ def child_main():
     if trace is not None and aot_rep["enabled"]:
         aot.trace_spans(trace, aot_rep)
 
+    # Live observability plane (OVERSIM_METRICS_PORT / OVERSIM_FLIGHT):
+    # metrics endpoint + flight recorder, fed strictly from the
+    # on_window host sync below — started before the manifest so the
+    # bound port rides the manifest's artifacts
+    from oversim_tpu.obs import runtime as obs_runtime
+    from oversim_tpu.obs import xprof as xprof_mod
+    metrics_port = os.environ.get("OVERSIM_METRICS_PORT")
+    flight_path = os.environ.get("OVERSIM_FLIGHT") or None
+    obs = None
+    if metrics_port is not None or flight_path is not None:
+        obs = obs_runtime.RunObserver(
+            role="bench",
+            port=int(metrics_port) if metrics_port is not None else None,
+            flight_path=flight_path)
+        obs.set_static(n=n, overlay=overlay, inbox_impl=inbox_impl,
+                       replicas=int(os.environ.get(
+                           "OVERSIM_BENCH_REPLICAS", "0")),
+                       degraded_to_cpu=on_cpu)
+        obs.start()
+        obs.record("aot", enabled=aot_rep.get("enabled"),
+                   artifact_hits=aot_rep.get("artifact_hits"),
+                   fresh_compiles=aot_rep.get("fresh_compiles"))
+
     # RunManifest side-channel line — the orchestrator attaches it to
     # the artifact's top-level "manifest" key
     print(json.dumps(telemetry_mod.run_manifest(
@@ -600,7 +623,10 @@ def child_main():
                 "telemetry_window": tel_window,
                 "replicas": os.environ.get("OVERSIM_BENCH_REPLICAS", "0")},
         artifacts={"artifact": os.environ.get("OVERSIM_BENCH_ARTIFACT"),
-                   "trace": trace_path},
+                   "trace": trace_path,
+                   "metrics_port": obs.port if obs is not None else None,
+                   "flight": flight_path,
+                   "xprof": xprof_mod.xprof_dir()},
         extra={"aot": aot_rep, "elastic": elastic_ann})), flush=True)
     camp = None
     summarize_leaves = _summary_from_leaves
@@ -661,7 +687,12 @@ def child_main():
     # measure in wall-clock windows (each ONE device dispatch + ONE host
     # sync, run_measurement_windows), emitting an updated JSON line after
     # each — the orchestrator relays them, the driver takes the last
+    windows_seen = [0]
+
     def on_window(out, wall):
+        if obs is not None:
+            obs.on_window(windows_seen[0], out, wall)
+            windows_seen[0] += 1
         delivered = out["kbr_delivered"] - base["kbr_delivered"]
         sent = out["kbr_sent"] - base["kbr_sent"]
         rate = delivered / wall if wall > 0 else 0.0
@@ -714,13 +745,28 @@ def child_main():
     import signal as signal_mod
     import threading
     stop_evt = threading.Event()
-    signal_mod.signal(signal_mod.SIGTERM, lambda *_: stop_evt.set())
 
-    s, _ = run_measurement_windows(
-        runner, s, start_sim_t=warm_until, window_sim_s=chunk * window,
-        measure_wall=measure_wall, chunk=chunk, on_window=on_window,
-        host_loop=host_loop, summarize_leaves=summarize_leaves,
-        trace=trace, stop=stop_evt)
+    def _on_term(*_):
+        stop_evt.set()
+        if obs is not None:
+            obs.draining()
+
+    signal_mod.signal(signal_mod.SIGTERM, _on_term)
+
+    # OVERSIM_XPROF=dir: on-chip capture of exactly the measurement
+    # windows — host metrics see window walls, the xprof sees inside them
+    with xprof_mod.capture("bench_measure") as xprof_info:
+        s, _ = run_measurement_windows(
+            runner, s, start_sim_t=warm_until, window_sim_s=chunk * window,
+            measure_wall=measure_wall, chunk=chunk, on_window=on_window,
+            host_loop=host_loop, summarize_leaves=summarize_leaves,
+            trace=trace, stop=stop_evt)
+    if xprof_info["dir"]:
+        print(json.dumps({"metric": "xprof_capture",
+                          "kind": "xprof_capture", **xprof_info}),
+              flush=True)
+        if obs is not None:
+            obs.record("xprof_capture", **xprof_info)
 
     ckpt_path = os.environ.get("OVERSIM_BENCH_CHECKPOINT")
     if ckpt_path:
@@ -733,6 +779,9 @@ def child_main():
         ckpt_mod.save(ckpt_path, s, meta=meta)
         sys.stderr.write("bench: final checkpoint -> %s (sigterm=%s)\n"
                          % (ckpt_path, stop_evt.is_set()))
+
+    if obs is not None:
+        obs.close(dump_tail=stop_evt.is_set())
 
     if tel_ticks > 0 and getattr(s, "telemetry", None) is not None:
         # KPI time series off the ring buffers — for the campaign tier

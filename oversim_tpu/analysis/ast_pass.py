@@ -73,11 +73,16 @@ RULES = {
     "untracked-pycache": "__pycache__ not git-ignored — stray bytecode "
                          "pollutes grep/status and is one `git add .` "
                          "from being committed",
+    "obs-import": "oversim_tpu.obs import outside the obs package — the "
+                  "live observability plane is host-runner-only "
+                  "(scripts/, bench.py); in-package code takes "
+                  "tracer/observer objects as duck-typed parameters",
 }
 
 HOT_RULES = ("host-numpy", "host-item", "host-float", "host-device-get",
-             "wall-clock", "sort-call", "undonated-jit", "device-sync")
-WIDE_RULES = ("host-item", "wall-clock", "device-sync")
+             "wall-clock", "sort-call", "undonated-jit", "device-sync",
+             "obs-import")
+WIDE_RULES = ("host-item", "wall-clock", "device-sync", "obs-import")
 
 # hot-path layers (ISSUE/ROADMAP: the modules whose compiled graphs the
 # HLO contracts pin) — paths relative to the repo root
@@ -203,12 +208,26 @@ class _Linter(ast.NodeVisitor):
             if alias.name.split(".")[0] == "numpy":
                 self._emit(node, "host-numpy",
                            "imports numpy in a hot-path module")
+            if alias.name.split(".")[:2] == ["oversim_tpu", "obs"]:
+                self._emit(node, "obs-import",
+                           f"imports {alias.name} inside the package")
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node):
         if node.module and node.module.split(".")[0] == "numpy":
             self._emit(node, "host-numpy",
                        "imports from numpy in a hot-path module")
+        if node.module:
+            parts = node.module.split(".")
+            if parts[:2] == ["oversim_tpu", "obs"]:
+                self._emit(node, "obs-import",
+                           f"imports from {node.module} inside the "
+                           f"package")
+            elif parts == ["oversim_tpu"] and any(
+                    alias.name == "obs" for alias in node.names):
+                self._emit(node, "obs-import",
+                           "imports obs from oversim_tpu inside the "
+                           "package")
         self.generic_visit(node)
 
     # attribute / call rules ------------------------------------------------
@@ -304,7 +323,11 @@ def iter_targets(root: Path):
         if "__pycache__" in path.parts:
             continue
         rel = str(path.relative_to(root))
-        yield path, rel, (HOT_RULES if _is_hot(rel) else WIDE_RULES)
+        rules = HOT_RULES if _is_hot(rel) else WIDE_RULES
+        if rel.replace("\\", "/").startswith("oversim_tpu/obs/"):
+            # the plane may of course import itself
+            rules = tuple(r for r in rules if r != "obs-import")
+        yield path, rel, rules
 
 
 def bytecode_findings(root: Path,

@@ -150,7 +150,8 @@ class Campaign:
             "replica_ids": list(self.ids),
             "s": self.s,
             "total": self.total,
-            "inbox_impl": self.sim.ep.inbox_impl,
+            "inbox_impl": (self.sim.ep.inbox_impl
+                           if self.sim is not None else None),
         }
 
     # -- init ---------------------------------------------------------------

@@ -100,6 +100,44 @@ def heartbeat_age(path: str, now: float | None = None):  # analysis: allow(wall-
     return (time.time() if now is None else now) - float(doc["wall"])
 
 
+def aggregate_heartbeats(docs: dict, now: float | None = None) -> dict:  # analysis: allow(wall-clock)
+    """Fleet-level rollup of per-worker heartbeat docs.
+
+    ``docs`` maps worker index → parsed heartbeat JSON (or None for a
+    worker that never wrote / whose file is torn).  Pure host-side
+    arithmetic: the supervisor polls this into fleet-level metric
+    series (obs plane), ``fleet_report.json``, and the watcher."""
+    t = time.time() if now is None else now
+    out = {"workers_total": len(docs), "workers_reporting": 0,
+           "ticks_done": 0, "ticks_target": 0, "retries": 0,
+           "degraded_to_cpu": 0, "heartbeat_age_max_s": None,
+           "per_worker": {}}
+    ages = []
+    for widx, doc in sorted(docs.items()):
+        if not doc:
+            out["per_worker"][str(widx)] = None
+            continue
+        out["workers_reporting"] += 1
+        age = (t - float(doc["wall"])) if "wall" in doc else None
+        if age is not None:
+            ages.append(age)
+        out["ticks_done"] += int(doc.get("ticks_done", 0))
+        out["ticks_target"] += int(doc.get("ticks", 0))
+        out["retries"] += int(doc.get("retries", 0))
+        out["degraded_to_cpu"] += 1 if doc.get("degraded_to_cpu") else 0
+        out["per_worker"][str(widx)] = {
+            "age_s": round(age, 3) if age is not None else None,
+            "ticks_done": int(doc.get("ticks_done", 0)),
+            "ticks": int(doc.get("ticks", 0)),
+            "retries": int(doc.get("retries", 0)),
+            "chunk_wall_s": doc.get("chunk_wall_s"),
+            "degraded_to_cpu": bool(doc.get("degraded_to_cpu", False)),
+        }
+    if ages:
+        out["heartbeat_age_max_s"] = round(max(ages), 3)
+    return out
+
+
 # -------------------------------------------------------------- chaos --
 
 

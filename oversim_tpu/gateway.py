@@ -181,10 +181,15 @@ class RealtimeGateway:
                  udp_port: int = 0, tcp_port: int | None = None,
                  host: str = "127.0.0.1",
                  stun_server: tuple | None = None,
-                 crypto=None, parser: GenericPacketParser | None = None):
+                 crypto=None, parser: GenericPacketParser | None = None,
+                 tracer=None):
         self.sim = sim
         self.state = state
         self.gw = gw_slot
+        # request tracing (duck-typed obs.RequestTracer: mint/settle per
+        # sid) — a plain parameter so this module never imports obs; the
+        # gateway has no window index, so latencies here are wall-only
+        self.tracer = tracer
         # pluggable wire codec (GenericPacketParser.h parserType)
         self.parser = parser or GenericPacketParser()
         # real-signature path (common/crypto.py CryptoModule — the
@@ -332,6 +337,8 @@ class RealtimeGateway:
             sid = self._next_session
             self._next_session += 1
             self._sessions[sid] = ("udp", addr)
+            if self.tracer is not None:
+                self.tracer.mint(sid)
             self._rx.append(ExtFrame(a=sid, b=b, c=c))
 
     def _poll_tcp(self):
@@ -384,6 +391,10 @@ class RealtimeGateway:
                 if parsed is None:
                     continue
                 b, c = parsed
+                if self.tracer is not None:
+                    # per-FRAME mint on the per-connection sid: a fresh
+                    # request on a kept-alive stream re-opens the trace
+                    self.tracer.mint(sid)
                 self._rx.append(ExtFrame(a=sid, b=b, c=c))
         for sid in dead:
             self._tcp_conns.pop(sid, None)
@@ -400,6 +411,8 @@ class RealtimeGateway:
                 return False          # not ours — leave for the bridge
             if sess is None:
                 return True           # orphan: free, nothing to send
+            if self.tracer is not None:
+                self.tracer.settle(sid)
             payload = self.parser.encapsulate(sid, b, c)
             if self.crypto is not None:
                 payload = self.crypto.sign_frame(payload)

@@ -1,0 +1,39 @@
+"""Live observability plane — host-side only, stdlib-only.
+
+The post-hoc artifacts (telemetry rings, .vec files, Perfetto traces,
+manifests) materialize after a run ends; this package is the LIVE half:
+a process-wide metrics registry with OpenMetrics exposition
+(``metrics``), an HTTP endpoint thread serving ``/metrics`` /
+``/healthz`` / ``/statusz`` (``server``), a JSONL flight recorder with
+a crash-tail dump (``flight``), EXT_IN→EXT_OUT request tracing
+(``requests``), the :class:`RunObserver` glue runners publish into
+(``runtime``), and the ``OVERSIM_XPROF`` on-chip capture hatch
+(``xprof``).
+
+Contract: everything here updates strictly at EXISTING host-sync
+points and never enters a compiled graph.  The analysis plane enforces
+it — the ``obs-import`` AST rule (analysis/ast_pass.py) fails any
+``oversim_tpu`` module outside this package that imports it; runners
+under ``scripts/`` and ``bench.py`` are the intended consumers, and
+in-package code (gateway, ingest) takes tracer/observer objects as
+plain duck-typed parameters instead of importing the plane.
+"""
+
+from oversim_tpu.obs.flight import FlightRecorder
+from oversim_tpu.obs.metrics import (LATENCY_BUCKETS_S, REGISTRY,
+                                     WINDOW_BUCKETS, Counter, Gauge,
+                                     Histogram, Registry, get_registry,
+                                     parse_exposition)
+from oversim_tpu.obs.requests import RequestTracer, SyntheticLoad
+from oversim_tpu.obs.runtime import RunObserver
+from oversim_tpu.obs.server import DRAINING, READY, ObsServer
+from oversim_tpu.obs.xprof import capture as xprof_capture
+from oversim_tpu.obs.xprof import xprof_dir
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "get_registry", "parse_exposition", "LATENCY_BUCKETS_S",
+    "WINDOW_BUCKETS", "ObsServer", "READY", "DRAINING",
+    "FlightRecorder", "RequestTracer", "SyntheticLoad", "RunObserver",
+    "xprof_capture", "xprof_dir",
+]

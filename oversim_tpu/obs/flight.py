@@ -1,0 +1,149 @@
+"""JSONL flight recorder: bounded ring + streamed event log + crash tail.
+
+Long-lived runners emit structured events at their host-sync points —
+window dispatched/fetched, checkpoint written, retry/backoff, chaos
+kill, AOT hit/miss, contract verdict — and the recorder does two things
+with each:
+
+  1. streams it to disk as one JSON line (append + flush, so a SIGKILL
+     loses at most the in-flight line), and
+  2. keeps the last ``capacity`` events in an in-memory ring, dumped as
+     ``<path>.tail.json`` on SIGTERM/fatal error (``install()``) or on
+     demand (``dump_tail``) — the "what were the last 512 things this
+     process did" artifact the post-mortem starts from.
+
+Event volume is window-cadence (a handful per second at most), so the
+per-event flush is noise; the recorder must never be put on a per-tick
+path.  Stdlib-only, thread-safe, and deliberately non-throwing: a
+recorder error must never take down the run it is observing.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal as signal_mod
+import sys
+import threading
+import time
+
+
+class FlightRecorder:
+    def __init__(self, path: str | None = None, *, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.path = str(path) if path else None
+        self.capacity = capacity
+        self.events_total = 0
+        self._ring = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._file = None
+        self._prev_handlers = {}
+        self._prev_excepthook = None
+
+    # --------------------------------------------------------- record --
+    def event(self, kind: str, **fields) -> dict:  # analysis: allow(wall-clock)
+        """Record one structured event (wall + monotonic stamped).  The
+        wall clock is deliberate here: flight logs are correlated with
+        external logs/scrapes, not used for intervals."""
+        ev = {"kind": kind, "wall": time.time(),
+              "mono": time.monotonic(), **fields}
+        with self._lock:
+            self.events_total += 1
+            self._ring.append(ev)
+            if self.path is not None:
+                try:
+                    if self._file is None:
+                        self._file = open(self.path, "a", buffering=1)
+                    self._file.write(json.dumps(ev, default=str) + "\n")
+                    self._file.flush()
+                except OSError:
+                    self._file = None       # keep the ring; retry later
+        return ev
+
+    @property
+    def dropped(self) -> int:
+        """Events no longer in the ring (streamed to disk, if a path
+        was configured)."""
+        return max(0, self.events_total - len(self._ring))
+
+    def tail(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def summary(self) -> dict:
+        return {"path": self.path, "events_total": self.events_total,
+                "ring": len(self._ring), "capacity": self.capacity}
+
+    # ----------------------------------------------------------- dump --
+    def dump_tail(self, path: str | None = None) -> str | None:
+        """Write the ring tail as ONE JSON array.  Default target is
+        ``<path>.tail.json`` next to the stream; with neither, the tail
+        goes to stderr.  Returns the written path (None for stderr)."""
+        doc = {"kind": "flight_tail", "events_total": self.events_total,
+               "tail": self.tail()}
+        target = path or (self.path + ".tail.json" if self.path else None)
+        blob = json.dumps(doc, indent=1, default=str)
+        if target is None:
+            sys.stderr.write(blob + "\n")
+            return None
+        try:
+            tmp = target + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, target)
+            return target
+        except OSError:
+            sys.stderr.write(blob + "\n")
+            return None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    # -------------------------------------------- signal / fatal hooks --
+    def install(self, signals=(signal_mod.SIGTERM,),
+                excepthook: bool = True) -> None:
+        """Dump the tail on fatal paths, CHAINING whatever was installed
+        before: the previous signal handler / excepthook still runs, so
+        a runner's own SIGTERM graceful-stop logic is preserved.  Use
+        only from the main thread (CPython signal rule); runners that
+        own their SIGTERM handler should instead call ``event`` +
+        ``dump_tail`` from it directly."""
+        for sig in signals:
+            prev = signal_mod.getsignal(sig)
+            self._prev_handlers[sig] = prev
+
+            def _handler(signum, frame, _prev=prev):
+                self.event("signal", signum=signum)
+                self.dump_tail()
+                if callable(_prev):
+                    _prev(signum, frame)
+                elif _prev == signal_mod.SIG_DFL:
+                    signal_mod.signal(signum, signal_mod.SIG_DFL)
+                    signal_mod.raise_signal(signum)
+
+            signal_mod.signal(sig, _handler)
+        if excepthook:
+            self._prev_excepthook = sys.excepthook
+
+            def _hook(tp, value, tb):
+                self.event("fatal", error=f"{tp.__name__}: {value}")
+                self.dump_tail()
+                (self._prev_excepthook or sys.__excepthook__)(tp, value, tb)
+
+            sys.excepthook = _hook
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            signal_mod.signal(sig, prev)
+        self._prev_handlers = {}
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None

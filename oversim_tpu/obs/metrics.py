@@ -1,0 +1,318 @@
+"""Process-wide live metrics registry with OpenMetrics exposition.
+
+The GlobalStatistics equivalent for LONG-LIVED processes: the batch
+tiers already emit post-hoc artifacts (telemetry rings, .vec files,
+manifests), but the service loop, fleet supervisor and bench drivers
+run for minutes-to-days and need metrics while they run.  This module
+is the host-side half of that: three metric kinds —
+
+  * :class:`Counter`   — monotonic; ``inc()`` refuses negative deltas,
+  * :class:`Gauge`     — last-write-wins scalar,
+  * :class:`Histogram` — fixed upper-bound buckets with cumulative
+                         counts, ``_sum`` and ``_count`` samples,
+
+— registered in a :class:`Registry` and rendered as Prometheus/
+OpenMetrics text (``render()``), ready for ``/metrics`` scrapes
+(obs/server.py).
+
+Strictly host-side and stdlib-only: no jax, no numpy, no third-party
+client library.  Updates happen ONLY at existing host-sync points
+(window drains, measurement windows, heartbeat polls) — the registry
+must never introduce a device sync of its own, which is why it takes
+plain Python numbers, never array leaves.
+
+Label support is deliberately minimal: a metric instance carries one
+frozen label dict (e.g. ``labels={"worker": "0"}``); each distinct
+``(name, labels)`` pair is its own series, grouped under a single
+``# HELP``/``# TYPE`` header per family at exposition time.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# request-to-response window latency: serving answers within a handful
+# of windows; the +Inf bucket catches pathologically parked responses
+WINDOW_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+# wall-clock request latency in seconds (sub-ms to a minute)
+LATENCY_BUCKETS_S = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping per the Prometheus text format."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def escape_label_value(text: str) -> str:
+    """Label-value escaping: backslash, double quote, newline."""
+    return (text.replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def format_value(v: float) -> str:
+    """Sample-value formatting: integers render bare, +Inf as ``+Inf``."""
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metric:
+    """Common identity/labels machinery of the three metric kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        for k in (labels or {}):
+            if not _LABEL_RE.match(k):
+                raise ValueError(f"bad label name {k!r}")
+        self.name = name
+        self.help = help
+        self.labels = dict(sorted((labels or {}).items()))
+        self._lock = threading.Lock()
+
+    def label_suffix(self) -> str:
+        if not self.labels:
+            return ""
+        inner = ",".join(
+            f'{k}="{escape_label_value(str(v))}"'
+            for k, v in self.labels.items())
+        return "{" + inner + "}"
+
+    def samples(self) -> list:
+        """``[(sample_name, label_suffix, value), ...]`` for exposition."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic counter.  Name it ``*_total`` (OpenMetrics idiom)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc({v}))")
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self):
+        return [(self.name, self.label_suffix(), self._value)]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def samples(self):
+        return [(self.name, self.label_suffix(), self._value)]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram: ``buckets`` are ascending finite upper
+    bounds; an implicit ``+Inf`` bucket tops them off.  Exposed as
+    cumulative ``_bucket{le=...}`` samples plus ``_sum``/``_count``."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labels=None, buckets=LATENCY_BUCKETS_S):
+        super().__init__(name, help, labels)
+        bs = tuple(float(b) for b in buckets)
+        if not bs or list(bs) != sorted(set(bs)) or bs[-1] == math.inf:
+            raise ValueError(f"buckets must be ascending finite: {bs}")
+        self.buckets = bs
+        self._counts = [0] * (len(bs) + 1)     # per-bucket, +Inf last
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            i = len(self.buckets)
+            for j, le in enumerate(self.buckets):
+                if v <= le:
+                    i = j
+                    break
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def bucket_counts(self) -> list:
+        """NON-cumulative per-bucket counts (``+Inf`` last) — the shape
+        ``vis.histogram_svg`` draws."""
+        return list(self._counts)
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-interpolated quantile estimate in [0, 1]; None when
+        empty.  Values beyond the last finite bound clamp to it."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        if self._count == 0:
+            return None
+        rank = q * self._count
+        cum = 0
+        lo = 0.0
+        for j, le in enumerate(self.buckets):
+            prev = cum
+            cum += self._counts[j]
+            if cum >= rank:
+                frac = ((rank - prev) / self._counts[j]
+                        if self._counts[j] else 0.0)
+                return lo + (le - lo) * frac
+        return self.buckets[-1]
+
+    def samples(self):
+        out = []
+        base = dict(self.labels)
+        cum = 0
+        for j, le in enumerate(list(self.buckets) + [math.inf]):
+            cum += self._counts[j]
+            labels = dict(base)
+            labels["le"] = format_value(le)
+            inner = ",".join(f'{k}="{escape_label_value(str(v))}"'
+                             for k, v in labels.items())
+            out.append((self.name + "_bucket", "{" + inner + "}", cum))
+        suffix = self.label_suffix()
+        out.append((self.name + "_sum", suffix, self._sum))
+        out.append((self.name + "_count", suffix, self._count))
+        return out
+
+
+class Registry:
+    """Get-or-create registry of metric families.
+
+    ``counter``/``gauge``/``histogram`` return the EXISTING instance for
+    an already-registered ``(name, labels)`` pair — call sites stay
+    idempotent — and raise when the same name is re-registered as a
+    different kind (a family must have one type)."""
+
+    def __init__(self):
+        self._metrics: dict = {}      # (name, labels-tuple) -> metric
+        self._kinds: dict = {}        # name -> kind
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, labels, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            existing = self._metrics.get(key)
+            if existing is not None:
+                if existing.kind != cls.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}, not {cls.kind}")
+                return existing
+            if self._kinds.get(name, cls.kind) != cls.kind:
+                raise ValueError(
+                    f"metric family {name!r} already registered as "
+                    f"{self._kinds[name]}, not {cls.kind}")
+            m = cls(name, help=help, labels=labels, **kw)
+            self._metrics[key] = m
+            self._kinds[name] = cls.kind
+            return m
+
+    def counter(self, name, help="", labels=None) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name, help="", labels=None) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name, help="", labels=None,
+                  buckets=LATENCY_BUCKETS_S) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def collect(self) -> list:
+        """Metric instances grouped by family name, registration-stable."""
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        """Prometheus/OpenMetrics text exposition of every registered
+        series, one ``# HELP``/``# TYPE`` header per family, terminated
+        with ``# EOF``."""
+        families: dict = {}
+        for m in self.collect():
+            families.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(families):
+            members = families[name]
+            help_text = next((m.help for m in members if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {name} {escape_help(help_text)}")
+            lines.append(f"# TYPE {name} {members[0].kind}")
+            for m in members:
+                for sname, suffix, value in m.samples():
+                    lines.append(f"{sname}{suffix} {format_value(value)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+# the process-wide default registry every runner publishes into
+REGISTRY = Registry()
+
+
+def get_registry() -> Registry:
+    return REGISTRY
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse exposition text back into ``{sample_key: float}`` —
+    ``sample_key`` is the sample name plus its literal label suffix.
+    The scrape-side half used by scripts/obs_watch.py and the
+    monotonicity assertions in scripts/obs_smoke.py."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, value = line.rpartition(" ")
+        if not key:
+            continue
+        try:
+            out[key] = float(value)
+        except ValueError:
+            continue
+    return out

@@ -1,0 +1,183 @@
+"""Request-level tracing: EXT_IN mint → EXT_OUT settle latency.
+
+Every external request already carries a process-unique session id
+(``sid``): ``InProcessIngest.submit`` and the RealtimeGateway's socket
+pollers mint one per EXT_IN frame, and the EXT_OUT drain hands it back.
+The :class:`RequestTracer` piggybacks on that id as the trace id —
+``mint(sid)`` at ingest, ``settle(sid)`` at the drain — and feeds two
+request-to-response latency histograms:
+
+  * ``oversim_request_latency_seconds``  — wall clock, and
+  * ``oversim_request_window_latency``   — WINDOWS between injection
+    and drain (the serving tier's native latency unit: a request
+    injected before window k and drained after window k took 1).
+
+Both ingest paths take the tracer as a plain parameter (duck-typed), so
+``gateway.py``/``service/ingest.py`` never import ``obs`` — the AST
+``obs-import`` rule keeps the plane confined to host-side runners.
+
+``keep_samples=True`` additionally retains raw per-request samples so
+``scripts/loadgen.py`` can report EXACT p50/p99 instead of the
+histogram's bucket-interpolated estimate.  Stdlib-only, host-side.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from oversim_tpu.obs import metrics as metrics_mod
+
+
+def percentile(sorted_vals: list, q: float) -> float | None:
+    """Exact linear-interpolated percentile over a SORTED list."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return float(sorted_vals[0])
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return float(sorted_vals[lo]) * (1 - frac) + float(sorted_vals[hi]) * frac
+
+
+class RequestTracer:
+    """Mint/settle matcher with latency histograms.
+
+    ``mint(sid, window=k)`` records the ingest instant; ``settle(sid,
+    window=k')`` observes ``k' - k + 1`` window latency plus the wall
+    latency and returns ``(wall_s, windows)``.  An unknown/duplicate
+    sid settles to None (and counts as ``unmatched``) — the drain
+    offers every parked EXT_OUT, not only traced ones."""
+
+    def __init__(self, registry=None, *, keep_samples: bool = False,
+                 max_samples: int = 65536, clock=time.monotonic):
+        self.registry = registry or metrics_mod.get_registry()
+        self.clock = clock
+        self.keep_samples = keep_samples
+        self.max_samples = max_samples
+        self.samples_wall_s: list = []
+        self.samples_windows: list = []
+        self._open: dict = {}             # sid -> (t_mono, window)
+        self._lock = threading.Lock()
+        r = self.registry
+        self.minted = r.counter(
+            "oversim_requests_minted_total",
+            "EXT_IN frames assigned a trace id at ingest")
+        self.settled = r.counter(
+            "oversim_requests_settled_total",
+            "EXT_OUT responses matched back to a minted trace id")
+        self.unmatched = r.counter(
+            "oversim_requests_unmatched_total",
+            "EXT_OUT drains with no (or an already-settled) trace id")
+        self.latency_s = r.histogram(
+            "oversim_request_latency_seconds",
+            "request-to-response wall latency",
+            buckets=metrics_mod.LATENCY_BUCKETS_S)
+        self.latency_windows = r.histogram(
+            "oversim_request_window_latency",
+            "request-to-response latency in serving windows",
+            buckets=metrics_mod.WINDOW_BUCKETS)
+
+    def mint(self, sid, *, window: int | None = None) -> None:
+        with self._lock:
+            self._open[sid] = (self.clock(), window)
+        self.minted.inc()
+
+    def settle(self, sid, *, window: int | None = None):
+        with self._lock:
+            rec = self._open.pop(sid, None)
+        if rec is None:
+            self.unmatched.inc()
+            return None
+        t0, w0 = rec
+        wall_s = self.clock() - t0
+        windows = None
+        if window is not None and w0 is not None:
+            windows = int(window) - int(w0) + 1
+            self.latency_windows.observe(windows)
+        self.latency_s.observe(wall_s)
+        self.settled.inc()
+        if self.keep_samples and len(self.samples_wall_s) < self.max_samples:
+            self.samples_wall_s.append(wall_s)
+            if windows is not None:
+                self.samples_windows.append(windows)
+        return wall_s, windows
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self._open)
+
+    def percentiles(self, qs=(0.5, 0.9, 0.99)) -> dict:
+        """Exact percentiles over the kept samples (keep_samples=True);
+        falls back to histogram bucket estimates otherwise."""
+        if self.samples_wall_s:
+            wall = sorted(self.samples_wall_s)
+            wins = sorted(self.samples_windows)
+            return {"exact": True, "count": len(wall),
+                    "wall_s": {f"p{round(q * 100)}": percentile(wall, q)
+                               for q in qs},
+                    "windows": {f"p{round(q * 100)}": percentile(wins, q)
+                                for q in qs}}
+        return {"exact": False, "count": self.latency_s.count,
+                "wall_s": {f"p{round(q * 100)}": self.latency_s.quantile(q)
+                           for q in qs},
+                "windows": {f"p{round(q * 100)}":
+                            self.latency_windows.quantile(q) for q in qs}}
+
+    def table(self, qs=(0.5, 0.9, 0.99)) -> str:
+        """The human p50/p99 request-to-response latency table
+        (ROADMAP item 4's deliverable; printed by scripts/loadgen.py)."""
+        p = self.percentiles(qs)
+        cols = [f"p{round(q * 100)}" for q in qs]
+        head = "metric      " + "".join(f"{c:>12}" for c in cols)
+        wall = "wall_ms     " + "".join(
+            f"{(p['wall_s'][c] or 0.0) * 1e3:>12.2f}" for c in cols)
+        wins = "windows     " + "".join(
+            f"{(p['windows'][c] if p['windows'][c] is not None else 0):>12.2f}"
+            for c in cols)
+        tag = "exact" if p["exact"] else "histogram-estimated"
+        return "\n".join(
+            [f"request-to-response latency ({p['count']} settled, {tag})",
+             head, wall, wins])
+
+
+class SyntheticLoad:
+    """N synthetic clients driving an InProcessIngest-shaped source.
+
+    An ingest-protocol wrapper: before every window boundary it submits
+    ``per_window`` fresh requests round-robin across ``clients``
+    synthetic client ids (``b`` = client id, ``c`` = request serial —
+    the echo app answers ``c + transform``, so payloads are checkable),
+    then delegates to the wrapped source.  Attach the tracer to the
+    INNER ingest; this wrapper only generates load."""
+
+    def __init__(self, inner, *, clients: int = 4, per_window: int = 8,
+                 max_requests: int | None = None):
+        if clients < 1 or per_window < 0:
+            raise ValueError("need clients >= 1 and per_window >= 0")
+        self.inner = inner
+        self.clients = clients
+        self.per_window = per_window
+        self.max_requests = max_requests
+        self.submitted = 0
+        self.sids: list = []
+
+    @property
+    def responses(self):
+        return self.inner.responses
+
+    def before_window(self, state, target_ns: int):
+        for _ in range(self.per_window):
+            if (self.max_requests is not None
+                    and self.submitted >= self.max_requests):
+                break
+            client = self.submitted % self.clients
+            self.sids.append(
+                self.inner.submit(b=client, c=self.submitted))
+            self.submitted += 1
+        return self.inner.before_window(state, target_ns)
+
+    def after_window(self, state):
+        return self.inner.after_window(state)

@@ -1,0 +1,59 @@
+"""On-chip profiler escape hatch: ``OVERSIM_XPROF=dir``.
+
+The host-side metrics plane sees window walls, not what the chip did
+inside them; ``OVERSIM_XPROF=<dir>`` wraps the measurement windows in
+``jax.profiler.trace`` so a real XLA capture (HLO timelines, on-device
+annotations) lands in ``dir``, and the capture path is attached to the
+run artifact — the measurement-debt bridge for ROADMAP items 2-3
+(on-chip window-wall breakdown / device-timeline pipelining proof).
+
+The capture is strictly best-effort: a missing/broken profiler backend
+degrades to a disabled capture with the error recorded, never a dead
+run.  jax is imported lazily INSIDE the capture so the rest of ``obs``
+stays importable without a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+ENV = "OVERSIM_XPROF"
+
+
+def xprof_dir(environ=None) -> str | None:
+    """The capture directory, or None when the hatch is closed."""
+    return (environ or os.environ).get(ENV) or None
+
+
+@contextlib.contextmanager
+def capture(label: str = "measure", *, out_dir: str | None = None):
+    """Wrap a measurement region in ``jax.profiler.trace`` when armed.
+
+    Yields an info dict: ``{"enabled", "dir", "label", "error"}`` —
+    check ``enabled`` after the block; ``dir`` is what the artifact
+    records.  With no $OVERSIM_XPROF (and no explicit ``out_dir``) the
+    body runs untouched."""
+    d = out_dir or xprof_dir()
+    info = {"enabled": False, "dir": d, "label": label, "error": None}
+    if not d:
+        yield info
+        return
+    started = False
+    try:
+        import jax
+        os.makedirs(d, exist_ok=True)
+        jax.profiler.start_trace(d)
+        started = True
+        info["enabled"] = True
+    except Exception as e:  # noqa: BLE001 — profiling must never kill a run
+        info["error"] = f"{type(e).__name__}: {e}"
+    try:
+        yield info
+    finally:
+        if started:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                info["error"] = f"{type(e).__name__}: {e}"

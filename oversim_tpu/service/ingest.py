@@ -38,11 +38,20 @@ class InProcessIngest:
 
     ``submit`` assigns a session id and buffers the frame;
     ``responses[sid]`` holds the drained ``(b, c)`` answer after the
-    window that served it."""
+    window that served it.
 
-    def __init__(self, gw_slot: int = 0, collect_responses: bool = True):
+    ``tracer`` is duck-typed (obs.RequestTracer-shaped: ``mint(sid,
+    window=)`` / ``settle(sid, window=)``) so this module never imports
+    the observability plane; ``windows`` counts completed after_window
+    drains and is the window index the tracer latencies are phrased in.
+    """
+
+    def __init__(self, gw_slot: int = 0, collect_responses: bool = True,
+                 tracer=None):
         self.gw = gw_slot
         self.collect = collect_responses
+        self.tracer = tracer
+        self.windows = 0              # after_window drains completed
         self.responses: dict = {}     # sid -> (b, c)
         self.num_batches = 0          # batched pool writes performed
         self.num_injected = 0         # frames injected across batches
@@ -57,6 +66,8 @@ class InProcessIngest:
         self._next_sid += 1
         self._pending.append(gateway_mod.ExtFrame(
             a=sid, b=b, c=c, kind=kind, dst=dst, key=key))
+        if self.tracer is not None:
+            self.tracer.mint(sid, window=self.windows)
         return sid
 
     def overflow(self) -> int:
@@ -79,13 +90,18 @@ class InProcessIngest:
 
     def after_window(self, state):
         if not self.collect:
+            self.windows += 1
             return state
 
         def handler(sid, b, c):
             self.responses[sid] = (b, c)
+            if self.tracer is not None:
+                self.tracer.settle(sid, window=self.windows)
             return True
 
-        return gateway_mod.drain_ext_out(state, self.gw, handler)
+        state = gateway_mod.drain_ext_out(state, self.gw, handler)
+        self.windows += 1
+        return state
 
 
 class GatewayIngest:

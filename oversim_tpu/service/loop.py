@@ -171,6 +171,13 @@ class ServiceLoop:
                     ``after_window(state)``); forces single-buffering
     trace           telemetry.PerfettoTrace — window_dispatch /
                     window_fetch / checkpoint_write spans
+    events          ``f(kind, **fields)`` lifecycle sink — fired at the
+                    loop's EXISTING host-sync points only
+                    (``window_dispatched`` / ``window_fetched`` /
+                    ``checkpoint_written``); the live observability
+                    plane plugs its flight recorder in here
+                    (oversim_tpu/obs/ RunObserver.loop_event) without
+                    this module ever importing ``obs``
     summarize       fetched-leaves → dict (campaign_summarize_leaves for
                     a Campaign runner)
     fetch / copy    host-sync and device-copy hooks (fake harnesses)
@@ -183,7 +190,7 @@ class ServiceLoop:
 
     def __init__(self, runner, state, params: ServiceParams, *,
                  config=None, on_window=None, ingest=None, trace=None,
-                 summarize=None, fetch=None, copy=None,
+                 events=None, summarize=None, fetch=None, copy=None,
                  checkpoint_meta=None, now=time.perf_counter,
                  windows_done: int = 0, start_sim_t: float | None = None):
         self.runner = runner
@@ -197,6 +204,7 @@ class ServiceLoop:
         self.on_window = on_window
         self.ingest = ingest
         self.trace = trace
+        self.events = events
         self.now = now
         self.checkpoint_meta = dict(checkpoint_meta or {})
         self.summarize = summarize or summarize_counter_leaves
@@ -377,6 +385,9 @@ class ServiceLoop:
             ckpt = self.copy(self.state)
         rec = _Pending(window=k, target_sim_t=target, t_d0=t_d0,
                        t_d1=t_d1, snap=snap, ckpt=ckpt)
+        if self.events is not None:
+            self.events("window_dispatched", window=k,
+                        target_sim_t=target)
         if p.double_buffer and self.ingest is None:
             prev, self._pending = self._pending, rec
             if prev is not None:
@@ -404,6 +415,9 @@ class ServiceLoop:
                             args={"window": rec.window})
         summary = self.summarize(leaves)
         self.windows_done = rec.window + 1
+        if self.events is not None:
+            self.events("window_fetched", window=rec.window,
+                        fetch_s=t_f1 - t_f0)
         if rec.ckpt is not None:
             t_c0 = self.now()
             self._write_checkpoint(rec.ckpt)
@@ -434,3 +448,7 @@ class ServiceLoop:
         ckpt_mod.save(p.checkpoint_path, snapshot, meta=meta)
         self.checkpoints_written += 1
         self.last_checkpoint = self.windows_done
+        if self.events is not None:
+            self.events("checkpoint_written",
+                        windows_done=self.windows_done,
+                        path=p.checkpoint_path)

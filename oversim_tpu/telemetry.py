@@ -365,7 +365,25 @@ class PerfettoTrace:
                    names: tuple | None = None):
         """KPI counter tracks from a ``kpi_series`` dict — the time axis
         is SIMULATED seconds (its own pid so sim-time tracks don't
-        interleave with wall-clock spans)."""
+        interleave with wall-clock spans).  An ``ensemble_series``
+        record (``bands``) emits ``name.mean`` plus ``name.ci_lo`` /
+        ``name.ci_hi`` band-edge tracks instead of raw values."""
+        if "bands" in ks:
+            t = np.asarray(ks["t_s"][0] if ks.get("t_s") else [], float)
+            for name in (names or sorted(ks["bands"])):
+                b = ks["bands"][name]
+                mean = np.asarray(b["mean"], float)
+                ci = b.get("ci")
+                ci = np.asarray(ci if ci is not None
+                                else [np.nan] * len(mean), float)
+                for ti, m, c in zip(t, mean, ci):
+                    if m != m:                     # skip NaN gaps
+                        continue
+                    self.counter(f"{name}.mean", ti, m, pid=pid)
+                    if c == c:
+                        self.counter(f"{name}.ci_lo", ti, m - c, pid=pid)
+                        self.counter(f"{name}.ci_hi", ti, m + c, pid=pid)
+            return
         t = np.asarray(ks["t_s"], float)
         for name in (names or sorted(ks["series"])):
             vals = np.asarray(ks["series"][name], float)
@@ -456,14 +474,24 @@ def analysis_verdict(path=None):
     return verdict_summary(doc)
 
 
+def env_knobs(environ=None) -> dict:
+    """Every effective ``OVERSIM_*`` environment knob, sorted — the
+    run-shaping side channel (OVERSIM_AOT, OVERSIM_BENCH_*,
+    OVERSIM_XPROF, OVERSIM_METRICS_PORT, ...) that the flags/ini config
+    does NOT capture, so a manifest alone reproduces the run."""
+    env = os.environ if environ is None else environ
+    return {k: env[k] for k in sorted(env) if k.startswith("OVERSIM")}
+
+
 def run_manifest(*, config=None, mesh=None, hlo_budget=None,
                  artifacts=None, extra=None) -> dict:
     """The unified RunManifest attached to every bench/campaign/
     scale_smoke artifact: enough provenance to re-run or audit the
     measurement — config hash (and the config itself), mesh/sharding
-    layout, HLO op-budget results, git rev, artifact paths, runtime
-    versions.  ``hlo_budget`` defaults to :func:`analysis_verdict` (the
-    graph-contract analyzer's verdict document, when one is present)."""
+    layout, HLO op-budget results, git rev, artifact paths, effective
+    OVERSIM_* env knobs, runtime versions.  ``hlo_budget`` defaults to
+    :func:`analysis_verdict` (the graph-contract analyzer's verdict
+    document, when one is present)."""
     import platform as _platform
     if hlo_budget is None:
         hlo_budget = analysis_verdict()
@@ -476,6 +504,7 @@ def run_manifest(*, config=None, mesh=None, hlo_budget=None,
         "mesh": mesh_layout(mesh),
         "hlo_budget": hlo_budget,
         "artifacts": artifacts or {},
+        "env": env_knobs(),
         "versions": {"python": _platform.python_version(),
                      "jax": getattr(jax, "__version__", None)},
     }

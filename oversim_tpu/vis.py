@@ -222,3 +222,71 @@ def write_series_svg(rec, path, names=None, **kw) -> str:
     with open(path, "w") as f:
         f.write(svg)
     return str(path)
+
+
+def histogram_svg(counts, uppers, *, title=None, unit="",
+                  width=720, height=320) -> str:
+    """Render one histogram (per-bucket counts) as a standalone SVG.
+
+    ``counts[i]`` is the NON-cumulative count of bucket i and
+    ``uppers[i]`` its inclusive upper bound (``obs.Histogram
+    .bucket_counts()`` shape; the final ``inf`` bucket renders as
+    ``>last``).  Same dependency-free style as :func:`series_svg` —
+    the run-artifact home for scripts/loadgen.py's request-latency
+    histogram."""
+    counts = [int(c) for c in counts]
+    if len(counts) != len(uppers) or not counts:
+        return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+                f'height="{height}"><text x="10" y="20">no histogram '
+                f'samples</text></svg>')
+    labels = []
+    for u in uppers:
+        u = float(u)
+        if u == float("inf"):
+            labels.append(f">{float(uppers[-2]):.4g}" if len(uppers) > 1
+                          else ">0")
+        else:
+            labels.append(f"{u:.4g}")
+    top = max(max(counts), 1)
+    ml, mr, mt, mb = 50, 20, 24, 46             # margins (labels below)
+    plot_w = width - ml - mr
+    plot_h = height - mt - mb
+    slot = plot_w / len(counts)
+    bar_w = max(slot * 0.8, 1.0)
+
+    out = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+           f'height="{height}" font-family="sans-serif" font-size="10">',
+           f'<rect x="{ml}" y="{mt}" width="{plot_w}" '
+           f'height="{plot_h}" fill="none" stroke="#999"/>']
+    if title:
+        out.append(f'<text x="{ml}" y="{mt - 8}">{title}</text>')
+    for frac in (0.0, 0.5, 1.0):                # count axis
+        y = height - mb - frac * plot_h
+        out.append(f'<text x="{ml - 4}" y="{y + 3:.0f}" '
+                   f'text-anchor="end">{frac * top:.4g}</text>')
+    color = _PALETTE[0]
+    for i, c in enumerate(counts):
+        h = c / top * plot_h
+        x = ml + i * slot + (slot - bar_w) / 2
+        y = height - mb - h
+        out.append(f'<rect x="{x:.1f}" y="{y:.1f}" width="{bar_w:.1f}" '
+                   f'height="{h:.1f}" fill="{color}">'
+                   f'<title>&#8804;{labels[i]}{unit}: {c}</title></rect>')
+        out.append(f'<text x="{ml + (i + 0.5) * slot:.1f}" '
+                   f'y="{height - mb + 12}" text-anchor="end" '
+                   f'transform="rotate(-45 {ml + (i + 0.5) * slot:.1f} '
+                   f'{height - mb + 12})">{labels[i]}</text>')
+    if unit:
+        out.append(f'<text x="{ml + plot_w / 2:.0f}" y="{height - 4}" '
+                   f'text-anchor="middle">bucket upper bound ({unit})'
+                   f'</text>')
+    out.append("</svg>")
+    return "\n".join(out)
+
+
+def write_histogram_svg(counts, uppers, path, **kw) -> str:
+    """histogram_svg to a file; returns the path."""
+    svg = histogram_svg(counts, uppers, **kw)
+    with open(path, "w") as f:
+        f.write(svg)
+    return str(path)

@@ -281,6 +281,17 @@ def main(argv) -> int:
         passes["ast"] = summary
     if run_hlo or run_trace:
         _setup_jax()
+        # OVERSIM_OBS_ARMED=1: run the whole compile/trace census with a
+        # live RunObserver in-process (metrics endpoint + flight ring on
+        # an ephemeral port) — the obs_smoke gate compares this verdict
+        # against the obs-off baseline to prove the observability plane
+        # changes NOTHING in the compiled graphs
+        obs = None
+        if os.environ.get("OVERSIM_OBS_ARMED") == "1":
+            from oversim_tpu.obs import RunObserver
+            obs = RunObserver(role="analyze", port=0)
+            log(f"obs armed: metrics endpoint on port {obs.start()}")
+            obs.record("analysis_start", fast=args.fast)
         builds = {}
         if run_hlo:
             from oversim_tpu.analysis import hlo_pass
@@ -299,6 +310,10 @@ def main(argv) -> int:
                 f"{len(f)} finding(s)")
             findings.extend(f)
             passes["trace"] = summary
+
+        if obs is not None:
+            obs.record("analysis_done", findings=len(findings))
+            obs.close()
 
     doc = findings_mod.document(findings, passes, fast=args.fast)
     return _emit(doc, args.json_path)

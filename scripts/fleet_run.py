@@ -152,17 +152,25 @@ def _worker_main(spec_path: str) -> int:
                       "retries": retries,
                       "degraded_to_cpu": ann["degraded_to_cpu"]}})
 
+    last_chunk_wall = None
+
     def heartbeat():
+        # chunk_wall_s/degraded_to_cpu feed the supervisor's fleet-level
+        # metric rollup (fleet.aggregate_heartbeats → obs plane)
         fleet.write_heartbeat(spec["heartbeat"], worker=widx,
                               ticks_done=ticks_done, ticks=ticks,
-                              retries=retries)
+                              retries=retries,
+                              chunk_wall_s=last_chunk_wall,
+                              degraded_to_cpu=ann["degraded_to_cpu"])
 
     heartbeat()
     delays = backoff_delays(policy)
     while ticks_done < ticks:
         try:
+            t_c0 = time.monotonic()
             nxt = camp.run_chunk(state, chunk)
             jax.block_until_ready(nxt)
+            last_chunk_wall = round(time.monotonic() - t_c0, 4)
         except Exception as exc:  # noqa: BLE001 — classified below
             # run_chunk DONATES its input: after any failure the old
             # buffers are unusable, so transient recovery is restore-
@@ -251,12 +259,62 @@ def _supervise(args) -> int:
         fleet.write_json_atomic(spec_path, spec)
         workers.append(_Worker(w, spec_path, str(out / f"shard{w}.log")))
 
+    # live observability: the supervisor aggregates per-worker heartbeat
+    # JSON into fleet-level series each poll; chaos/respawn/hang events
+    # land in the flight ring (--metrics-port 0 = ephemeral port)
+    obs = None
+    fleet_gauges = {}
+    if args.metrics_port is not None or args.flight:
+        from oversim_tpu.obs import runtime as obs_runtime
+        obs = obs_runtime.RunObserver(role="fleet",
+                                      port=args.metrics_port,
+                                      flight_path=args.flight)
+        obs.set_static(workers=len(workers), replicas=args.replicas,
+                       ticks=args.ticks, chaos=bool(args.chaos))
+        r = obs.registry
+        fleet_gauges = {
+            "reporting": r.gauge("oversim_fleet_workers_reporting",
+                                 "workers with a readable heartbeat"),
+            "ticks_done": r.gauge("oversim_fleet_ticks_done",
+                                  "summed ticks_done across heartbeats"),
+            "ticks_target": r.gauge("oversim_fleet_ticks_target",
+                                    "summed per-worker tick targets"),
+            "retries": r.gauge("oversim_fleet_retries",
+                               "summed transient-retry counts"),
+            "age_max": r.gauge("oversim_fleet_heartbeat_age_max_s",
+                               "oldest heartbeat age"),
+            "degraded": r.gauge("oversim_fleet_degraded_to_cpu",
+                                "workers running on the CPU fallback"),
+        }
+        print(json.dumps({"phase": "obs", "metrics_port": obs.start(),
+                          "flight": args.flight}), flush=True)
+
+    hb_paths = {w.idx: str(out / f"shard{w.idx}.heartbeat.json")
+                for w in workers}
+
+    def poll_obs():
+        if obs is None:
+            return
+        agg = fleet.aggregate_heartbeats(
+            {idx: fleet.read_json(p) for idx, p in hb_paths.items()})
+        fleet_gauges["reporting"].set(agg["workers_reporting"])
+        fleet_gauges["ticks_done"].set(agg["ticks_done"])
+        fleet_gauges["ticks_target"].set(agg["ticks_target"])
+        fleet_gauges["retries"].set(agg["retries"])
+        fleet_gauges["degraded"].set(agg["degraded_to_cpu"])
+        if agg["heartbeat_age_max_s"] is not None:
+            fleet_gauges["age_max"].set(agg["heartbeat_age_max_s"])
+        obs.set_static(fleet=agg)     # /statusz carries the full rollup
+
     chaos = (fleet.chaos_schedule(args.kills, len(workers),
                                   args.chaos_seed, span_s=args.chaos_span)
              if args.chaos else [])
     print(json.dumps({"phase": "fleet_start", "workers": len(workers),
                       "shards": [list(s) for s in shards],
                       "chaos": chaos}), flush=True)
+    if obs is not None:
+        obs.record("fleet_start", workers=len(workers),
+                   chaos_kills=len(chaos))
 
     t0 = time.monotonic()
     for w in workers:
@@ -278,6 +336,9 @@ def _supervise(args) -> int:
                 print(json.dumps({"phase": "chaos_kill",
                                   "worker": w_idx,
                                   "t": round(now, 2)}), flush=True)
+                if obs is not None:
+                    obs.record("chaos_kill", worker=w_idx,
+                               t=round(now, 2))
         for w in workers:
             if w.done:
                 continue
@@ -297,6 +358,8 @@ def _supervise(args) -> int:
                 w.respawns += 1
                 print(json.dumps({"phase": "respawn", "worker": w.idx,
                                   "n": w.respawns}), flush=True)
+                if obs is not None:
+                    obs.record("respawn", worker=w.idx, n=w.respawns)
                 w.spawn()
             elif (time.monotonic() - w.spawned_at
                     > args.heartbeat_timeout):
@@ -309,7 +372,11 @@ def _supervise(args) -> int:
                                       "worker": w.idx,
                                       "heartbeat_age_s": round(age, 1)}),
                           flush=True)
+                    if obs is not None:
+                        obs.record("hang_kill", worker=w.idx,
+                                   heartbeat_age_s=round(age, 1))
                     w.kill()
+        poll_obs()
         if fail:
             break
         if all(w.done for w in workers):
@@ -324,6 +391,9 @@ def _supervise(args) -> int:
             w.kill()
         print(json.dumps({"phase": "fleet_fail", "error": fail}),
               flush=True)
+        if obs is not None:
+            obs.record("fleet_fail", error=fail)
+            obs.close(dump_tail=True)
         return 1
 
     # ------------------------------------------------------- merge ----
@@ -387,6 +457,11 @@ def _supervise(args) -> int:
                       "kills_landed": elastic_ann["kills_landed"],
                       "respawns": sum(w.respawns for w in workers),
                       "wall_s": report["fleet"]["wall_s"]}), flush=True)
+    if obs is not None:
+        obs.record("fleet_done",
+                   kills_landed=elastic_ann["kills_landed"],
+                   respawns=sum(w.respawns for w in workers))
+        obs.close()
     return verdict
 
 
@@ -419,6 +494,12 @@ def main() -> int:
     ap.add_argument("--verify", action="store_true",
                     help="also run the uninterrupted reference and "
                     "demand exact ensemble equality")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics /healthz /statusz with "
+                    "fleet-level heartbeat rollups (0 = ephemeral)")
+    ap.add_argument("--flight", default=None,
+                    help="JSONL flight-recorder path (chaos/respawn/"
+                    "hang events)")
     ap.add_argument("--heartbeat-timeout", type=float, default=120.0)
     ap.add_argument("--max-respawns", type=int, default=8)
     ap.add_argument("--deadline", type=float, default=900.0)

@@ -1,0 +1,144 @@
+"""Synthetic load generator: N clients against the echo serving loop.
+
+ROADMAP item 4's deliverable: drive request traffic through the
+gateway/ingest tier and report the request-to-response latency
+distribution.  The generator runs the serving scenario in-process
+(RealworldEchoApp + ``ext_hold_slot``, the service_run --ingest-rate
+sim), submits ``--rate`` requests per window round-robin across
+``--clients`` synthetic clients (obs.SyntheticLoad), traces every
+request EXT_IN→EXT_OUT (obs.RequestTracer with exact samples), and
+prints the p50/p90/p99 table in wall-ms AND serving windows.
+
+Artifacts: ``--out`` writes a JSON record (percentiles, counts,
+per-bucket histogram, response-correctness check) and ``--svg`` renders
+the wall-latency histogram with ``vis.write_histogram_svg`` — both
+referenced from the record itself.  ``--metrics-port`` additionally
+serves the live /metrics endpoint while the run is in flight.
+
+Usage:
+  python scripts/loadgen.py --clients 8 --rate 16 --windows 12 \
+      [--n 4] [--out /tmp/loadgen.json] [--svg /tmp/loadgen_hist.svg] \
+      [--metrics-port 0] [--platform cpu]
+"""
+
+import argparse
+import json
+import math
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "scripts"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8, metavar="N",
+                    help="synthetic client ids (round-robin)")
+    ap.add_argument("--rate", type=int, default=16, metavar="R",
+                    help="requests submitted per serving window")
+    ap.add_argument("--windows", type=int, default=8)
+    ap.add_argument("--max-requests", type=int, default=None)
+    ap.add_argument("--n", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--window-sim-s", type=float, default=1.0)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--engine-window", type=float, default=0.02)
+    ap.add_argument("--telemetry", type=int, default=0)
+    ap.add_argument("--telemetry-window", type=int, default=256)
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="JSON report (percentiles + histogram)")
+    ap.add_argument("--svg", default=None, metavar="PATH",
+                    help="wall-latency histogram SVG (vis.histogram_svg)")
+    ap.add_argument("--metrics-port", type=int, default=None)
+    ap.add_argument("--flight", default=None)
+    args = ap.parse_args()
+
+    import service_run
+    service_run._setup_jax(args.platform)
+    from oversim_tpu.obs import RequestTracer, RunObserver, SyntheticLoad
+    from oversim_tpu.service import ServiceLoop, ServiceParams
+    from oversim_tpu.service.ingest import InProcessIngest
+
+    sim = service_run._build_echo_sim(args)
+    tracer = RequestTracer(keep_samples=True)
+    load = SyntheticLoad(InProcessIngest(gw_slot=0, tracer=tracer),
+                         clients=args.clients, per_window=args.rate,
+                         max_requests=args.max_requests)
+    obs = None
+    if args.metrics_port is not None or args.flight:
+        obs = RunObserver(role="loadgen", port=args.metrics_port,
+                          flight_path=args.flight, tracer=tracer)
+        obs.set_static(clients=args.clients, rate=args.rate, n=args.n)
+        print(json.dumps({"phase": "obs", "metrics_port": obs.start(),
+                          "flight": args.flight}), flush=True)
+
+    t0 = time.perf_counter()
+    state = sim.init(seed=args.seed)
+    # warm until every node has joined so the echo app answers from the
+    # first served window (churn init_interval * n = 10 sim-seconds)
+    state = sim.run_until(state, 10.0 + args.engine_window,
+                          chunk=args.chunk)
+    loop = ServiceLoop(
+        sim, state, ServiceParams(window_sim_s=args.window_sim_s,
+                                  chunk=args.chunk),
+        ingest=load,
+        events=obs.loop_event if obs is not None else None,
+        on_window=(obs.on_window if obs is not None else None))
+    loop.run(n_windows=args.windows)
+    wall_s = time.perf_counter() - t0
+
+    # response correctness: request i went out as (b=i%clients, c=i)
+    # and the echo app (transform=1) must answer (b, i+1)
+    answered = sum(1 for sid in load.sids if sid in load.responses)
+    wrong = sum(1 for i, sid in enumerate(load.sids)
+                if (resp := load.responses.get(sid)) is not None
+                and resp != (i % args.clients, i + 1))
+
+    table = tracer.table()
+    print(table, flush=True)
+    pct = tracer.percentiles()
+
+    counts = tracer.latency_s.bucket_counts()
+    uppers = list(tracer.latency_s.buckets) + [math.inf]
+    report = {
+        "kind": "loadgen_report",
+        "clients": args.clients, "rate": args.rate,
+        "windows": args.windows,
+        "submitted": load.submitted, "answered": answered,
+        "wrong_payloads": wrong,
+        "settled": int(tracer.settled.value),
+        "unmatched": int(tracer.unmatched.value),
+        "outstanding": tracer.outstanding(),
+        "percentiles": pct,
+        "latency_s_hist": {"counts": counts,
+                           "le": [u if u != math.inf else "inf"
+                                  for u in uppers]},
+        "wall_s": round(wall_s, 2),
+        "svg": args.svg,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    if args.svg:
+        from oversim_tpu import vis
+        vis.write_histogram_svg(
+            counts, uppers, args.svg,
+            title=(f"request-to-response wall latency "
+                   f"({report['settled']} settled)"), unit="s")
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "latency_s_hist"}), flush=True)
+    if obs is not None:
+        obs.close()
+    if answered < load.submitted or wrong:
+        print(f"loadgen: {load.submitted - answered} unanswered, "
+              f"{wrong} wrong payloads", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

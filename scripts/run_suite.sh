@@ -107,4 +107,21 @@ else
   status=1
   echo "FAIL  aot_smoke  $(tail -1 "$STATE/aot_smoke.log")"
 fi
+# live-observability smoke (scripts/obs_smoke.py): a real service_run
+# with ephemeral /metrics + synthetic ingest — counters monotone across
+# two scrapes, SIGTERM flips /healthz to draining, flight JSONL + tail
+# dump parse; loadgen prints the p50/p99 table; and the analyzer verdict
+# with the obs plane armed in-process is identical to the obs-off one
+# (reuses $OVERSIM_ANALYSIS_VERDICT from the analyze gate as baseline)
+obs_marker="$STATE/obs_smoke.ok"
+if [ -f "$obs_marker" ]; then
+  echo "skip  obs_smoke (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/obs_smoke.py > "$STATE/obs_smoke.log" 2>&1; then
+  touch "$obs_marker"
+  echo "PASS  obs_smoke  $(tail -1 "$STATE/obs_smoke.log")"
+else
+  status=1
+  echo "FAIL  obs_smoke  $(tail -1 "$STATE/obs_smoke.log")"
+fi
 exit $status

@@ -22,6 +22,17 @@ Common:  [--window-sim-s 1.0] [--chunk 32] [--checkpoint ck.npz]
          [--platform cpu|axon] [--out artifact.json] [--trace t.json]
          [--telemetry K] [--telemetry-window W] [--single-buffer]
 
+Live observability (oversim_tpu/obs/): ``--metrics-port P`` serves
+/metrics (OpenMetrics), /healthz (ready → draining on SIGTERM) and
+/statusz (tick/window/checkpoint-age JSON) from a stdlib HTTP thread
+(P=0 picks an ephemeral port, announced in the ``"phase": "obs"``
+line); ``--flight F`` streams the structured event ring to F as JSONL
+and dumps its tail on SIGTERM/fatal.  ``--ingest-rate R`` switches the
+scenario to the echo serving app (RealworldEchoApp + ext_hold_slot)
+and drives R traced synthetic requests per window from
+``--ingest-clients`` clients — request-to-response latency lands in
+the metrics and the final artifact record.
+
 ``--replicas S`` serves the stacked campaign state (S replicas as one
 vmapped program, cross-replica summaries per window); checkpoints then
 snapshot the whole [S]-stacked state, and resume restores every
@@ -92,6 +103,29 @@ def _build_sim(args):
     return sim_mod.Simulation(logic, cp, engine_params=ep)
 
 
+def _build_echo_sim(args):
+    """The serving scenario: every EXT_IN answered with EXT_OUT
+    (RealworldEchoApp), responses parked by ext_hold_slot until the
+    boundary drain (service/ingest.py module docstring)."""
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu.apps.realworld import RealworldEchoApp
+    from oversim_tpu.engine import sim as sim_mod
+    from oversim_tpu.overlay.myoverlay import (MyOverlayLogic,
+                                               MyOverlayParams)
+
+    logic = MyOverlayLogic(params=MyOverlayParams(),
+                           app=RealworldEchoApp(transform=1))
+    cp = churn_mod.ChurnParams(model="none", target_num=args.n,
+                               init_interval=10.0 / args.n)
+    ep = sim_mod.EngineParams(
+        window=args.engine_window, ext_hold_slot=0,
+        telemetry=telemetry_mod.TelemetryParams(
+            sample_ticks=args.telemetry,
+            window=args.telemetry_window))
+    return sim_mod.Simulation(logic, cp, engine_params=ep)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ini", default=None, help="build from ini "
@@ -142,6 +176,22 @@ def main():
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="Perfetto trace: window_dispatch/window_fetch/"
                     "checkpoint_write spans (overlap = pipelining)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="P", help="serve /metrics /healthz /statusz "
+                    "on P (0 = ephemeral; bound port in the obs phase "
+                    "line)")
+    ap.add_argument("--flight", default=None, metavar="PATH",
+                    help="JSONL flight-recorder path (tail dumped on "
+                    "SIGTERM/fatal)")
+    ap.add_argument("--ingest-rate", type=int, default=0, metavar="R",
+                    help="serve R traced synthetic echo requests per "
+                    "window (switches to the echo serving scenario)")
+    ap.add_argument("--ingest-clients", type=int, default=4)
+    ap.add_argument("--term-grace", type=float, default=0.0,
+                    metavar="S", help="keep /healthz serving the "
+                    "draining state S seconds after a SIGTERMed loop "
+                    "stops (deterministic scrape window for smoke "
+                    "gates)")
     args = ap.parse_args()
 
     _setup_jax(args.platform)
@@ -171,7 +221,14 @@ def main():
         sim = build_simulation(ini, args.config)
         params = build_service(ini, args.config)
     else:
-        sim = _build_sim(args)
+        if args.ingest_rate:
+            if args.replicas:
+                raise SystemExit("--ingest-rate serves the SOLO echo "
+                                 "state (no campaign session plumbing)")
+            sim = _build_echo_sim(args)
+            config["app"] = "echo"
+        else:
+            sim = _build_sim(args)
         params = ServiceParams(
             window_sim_s=args.window_sim_s, chunk=args.chunk,
             checkpoint_every=args.checkpoint_every,
@@ -197,9 +254,40 @@ def main():
     trace = (telemetry_mod.PerfettoTrace("service_run")
              if args.trace else None)
 
+    # live observability plane: tracer for the synthetic serving load,
+    # observer for /metrics + /healthz + /statusz + the flight ring —
+    # all updates happen at the loop's existing host-sync points
+    tracer = None
+    ingest = None
+    if args.ingest_rate:
+        from oversim_tpu.obs import RequestTracer, SyntheticLoad
+        from oversim_tpu.service.ingest import InProcessIngest
+        tracer = RequestTracer(keep_samples=True)
+        ingest = SyntheticLoad(
+            InProcessIngest(gw_slot=0, tracer=tracer),
+            clients=args.ingest_clients, per_window=args.ingest_rate)
+    obs = None
+    if args.metrics_port is not None or args.flight:
+        from oversim_tpu.obs import RunObserver
+        obs = RunObserver(role="service", port=args.metrics_port,
+                          flight_path=args.flight, tracer=tracer)
+        obs.set_static(n=args.n, overlay=args.overlay,
+                       inbox_impl=sim.ep.inbox_impl,
+                       replicas=args.replicas,
+                       ingest_rate=args.ingest_rate)
+        obs_rec = {"phase": "obs", "metrics_port": obs.start(),
+                   "flight": args.flight}
+        print(json.dumps(obs_rec), flush=True)
+        artifact.add(obs_rec)
+
     t0 = time.perf_counter()
     example = (runner.init() if args.replicas
                else runner.init(seed=args.seed))
+    if args.ingest_rate and not args.resume:
+        # warm until every node has joined (init_interval * n) so the
+        # echo app answers from the first served window
+        example = runner.run_until(example, 10.0 + args.engine_window,
+                                   chunk=params.chunk)
     init_rec = {"phase": "init", "resume": bool(args.resume),
                 "replicas": args.replicas,
                 "init_wall_s": round(time.perf_counter() - t0, 2)}
@@ -210,23 +298,36 @@ def main():
     # entry this service will compile (oversim_tpu/aot/); report → manifest
     from oversim_tpu import aot
     from oversim_tpu.analysis import contracts as contracts_mod
-    aot_rep = aot.warmup(
-        ("campaign_tick",) if args.replicas else ("service_window",),
-        ctx=contracts_mod.EntryContext(
-            n=args.n, overlay=args.overlay, window=args.engine_window,
-            inbox=8, pool_factor=8, replicas=max(args.replicas, 1),
-            chunk=params.chunk))
-    if trace and aot_rep["enabled"]:
-        aot.trace_spans(trace, aot_rep)
+    if args.ingest_rate:
+        # the echo serving graph is not a registered AOT entry
+        aot_rep = {"enabled": False, "skipped": "echo serving scenario"}
+    else:
+        aot_rep = aot.warmup(
+            ("campaign_tick",) if args.replicas else ("service_window",),
+            ctx=contracts_mod.EntryContext(
+                n=args.n, overlay=args.overlay,
+                window=args.engine_window,
+                inbox=8, pool_factor=8, replicas=max(args.replicas, 1),
+                chunk=params.chunk))
+        if trace and aot_rep["enabled"]:
+            aot.trace_spans(trace, aot_rep)
+    if obs is not None and aot_rep.get("enabled"):
+        obs.record("aot", artifact_hits=aot_rep.get("artifact_hits"),
+                   fresh_compiles=aot_rep.get("fresh_compiles"))
 
+    from oversim_tpu.obs import xprof_dir
     manifest = telemetry_mod.run_manifest(
         config=config,
         artifacts={"artifact": args.out, "trace": args.trace,
-                   "checkpoint": params.checkpoint_path},
+                   "checkpoint": params.checkpoint_path,
+                   "metrics_port": obs.port if obs is not None else None,
+                   "flight": args.flight, "xprof": xprof_dir()},
         extra={"aot": aot_rep})
     artifact.set_manifest(manifest)
 
     def on_window(window, summary, wall):
+        if obs is not None:
+            obs.on_window(window, summary, wall)
         rec = {"window": window, "wall_s": round(wall, 3), **summary}
         print(json.dumps(rec), flush=True)
         artifact.add(rec)
@@ -234,7 +335,8 @@ def main():
             trace.write(args.trace)  # atomic: valid trace after every window
 
     kw = dict(config=config, on_window=on_window, trace=trace,
-              summarize=summarize)
+              summarize=summarize, ingest=ingest,
+              events=obs.loop_event if obs is not None else None)
     if args.resume:
         if args.reshard and not args.replicas:
             raise SystemExit("--reshard needs --replicas (campaign "
@@ -258,24 +360,39 @@ def main():
 
     def _on_sigterm(signum, frame):
         got_term.append(signum)
+        if obs is not None:
+            obs.draining()      # /healthz → 503 before the stop lands
         loop.stop()
 
     import signal
     signal.signal(signal.SIGTERM, _on_sigterm)
 
-    state, done = loop.run(n_windows=args.windows)
+    from oversim_tpu.obs import xprof_capture
+    with xprof_capture("service_windows") as xprof_info:
+        state, done = loop.run(n_windows=args.windows)
     final = {"phase": "final", "windows_done": done,
              "checkpoints_written": loop.checkpoints_written,
              "last_checkpoint": loop.last_checkpoint,
              "wall_s": round(time.perf_counter() - t0, 2)}
+    if xprof_info["dir"]:
+        final["xprof"] = xprof_info
     if got_term:
         final["sigterm"] = True
         final["final_checkpoint"] = loop.checkpoint_now()
+    if tracer is not None:
+        final["requests"] = tracer.percentiles()
+        sys.stderr.write(tracer.table() + "\n")
     artifact.add(final)
     if trace is not None:
         trace.write(args.trace)
     artifact.finish()
     print(json.dumps(final), flush=True)
+    if obs is not None:
+        if got_term and args.term_grace > 0:
+            # hold the endpoint in the draining state so an external
+            # probe can observe the flip before the process exits
+            time.sleep(args.term_grace)
+        obs.close(dump_tail=bool(got_term))
     return 0
 
 

@@ -1,6 +1,11 @@
 #!/bin/bash
 # Probe the axon TPU tunnel until it answers; leave a flag file when up.
 # Each probe is bounded; the loop runs until success or 6h.
+#
+# This script is ONLY the tunnel keepalive/probe.  Watching a live RUN
+# (tick, window, checkpoint age, request latency) moved to the obs
+# plane: start the runner with --metrics-port and point
+# scripts/obs_watch.py at the announced endpoint.
 FLAG=/tmp/tpu_up.flag
 rm -f "$FLAG"
 for i in $(seq 1 240); do

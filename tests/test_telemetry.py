@@ -266,6 +266,90 @@ def test_run_manifest_and_config_hash():
     json.dumps(man)
 
 
+def test_perfetto_instant_and_counter_pins():
+    tr = telemetry.PerfettoTrace("t")
+    tr.instant("chaos_kill", 2.0, tid=3, args={"worker": 1})
+    tr.counter("aliveNodes", 2.0, 16)
+    inst = tr.events[0]
+    # thread-scoped instant ("s": "t") — the marker obs/flight events use
+    assert inst["ph"] == "i" and inst["s"] == "t"
+    assert inst["ts"] == 2.0e6 and inst["tid"] == 3
+    assert inst["args"] == {"worker": 1}
+    cnt = tr.events[1]
+    assert cnt["ph"] == "C"
+    assert cnt["args"] == {"aliveNodes": 16.0}   # counter value keyed by name
+
+
+def test_add_series_solo_counters():
+    tr = telemetry.PerfettoTrace("t")
+    tr.add_series({"t_s": [0.0, 1.0, 2.0],
+                   "series": {"aliveNodes": [8.0, float("nan"), 10.0]}})
+    names = [(e["name"], e["args"]["aliveNodes"]) for e in tr.events]
+    # NaN gap skipped, one counter sample per finite point
+    assert names == [("aliveNodes", 8.0), ("aliveNodes", 10.0)]
+    assert all(e["pid"] == 2 for e in tr.events)
+
+
+def test_add_series_ci_band_emission():
+    rec = {
+        "t_s": [[0.0, 1.0, 2.0], [0.0, 1.0, 2.0]],
+        "bands": {
+            "aliveNodes": {"mean": [24.0, float("nan"), 26.0],
+                           "ci": [2.0, 1.0, float("nan")]},
+            "kbr_delivery_ratio": {"mean": [0.9, 0.95, 1.0], "ci": None},
+        },
+    }
+    tr = telemetry.PerfettoTrace("t")
+    tr.add_series(rec)
+    by_name = {}
+    for e in tr.events:
+        assert e["ph"] == "C" and e["pid"] == 2
+        by_name.setdefault(e["name"], []).append(
+            (e["ts"] / 1e6, list(e["args"].values())[0]))
+    # mean track: NaN gap at t=1 skipped
+    assert by_name["aliveNodes.mean"] == [(0.0, 24.0), (2.0, 26.0)]
+    # ci band edges only where the ci itself is finite
+    assert by_name["aliveNodes.ci_lo"] == [(0.0, 22.0)]
+    assert by_name["aliveNodes.ci_hi"] == [(0.0, 26.0)]
+    # a band without ci still emits its mean, no edge tracks
+    assert by_name["kbr_delivery_ratio.mean"] == [
+        (0.0, 0.9), (1.0, 0.95), (2.0, 1.0)]
+    assert "kbr_delivery_ratio.ci_lo" not in by_name
+
+
+def test_add_series_ci_bands_from_real_ensemble():
+    tel = _fake_tel()
+    stacked = telemetry.TelemetryState(
+        n=np.array([3, 3], np.int64),
+        t_ns=np.stack([tel.t_ns, tel.t_ns]),
+        tick=np.stack([tel.tick, tel.tick]),
+        alive=np.stack([tel.alive, tel.alive * 2]),
+        series={k: np.stack([v, v]) for k, v in tel.series.items()},
+        counters={k: np.stack([v, v]) for k, v in tel.counters.items()},
+    )
+    rec = telemetry.ensemble_series(stacked)
+    tr = telemetry.PerfettoTrace("t")
+    tr.add_series(rec, names=("aliveNodes",))
+    names = {e["name"] for e in tr.events}
+    assert names == {"aliveNodes.mean", "aliveNodes.ci_lo",
+                     "aliveNodes.ci_hi"}
+    means = [e for e in tr.events if e["name"] == "aliveNodes.mean"]
+    assert [list(e["args"].values())[0] for e in means] == [24.0] * 3
+
+
+def test_env_knobs_and_manifest_env(monkeypatch):
+    env = {"OVERSIM_XPROF": "/tmp/x", "OVERSIM_AOT": "1",
+           "PATH": "/usr/bin", "HOME": "/root"}
+    knobs = telemetry.env_knobs(env)
+    assert knobs == {"OVERSIM_AOT": "1", "OVERSIM_XPROF": "/tmp/x"}
+    assert list(knobs) == sorted(knobs)          # stable key order
+    monkeypatch.setenv("OVERSIM_TEST_KNOB", "on")
+    man = telemetry.run_manifest(config={"n": 4})
+    assert man["env"]["OVERSIM_TEST_KNOB"] == "on"
+    assert all(k.startswith("OVERSIM") for k in man["env"])
+    json.dumps(man)
+
+
 def test_artifact_writer_manifest_key(tmp_path):
     from bench import ArtifactWriter
     p = tmp_path / "a.json"

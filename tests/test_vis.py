@@ -56,3 +56,41 @@ def test_json_roundtrips(chord_state):
     s, st = chord_state
     data = json.loads(vis.to_json(st))
     assert data["nodes"] and data["edges"]
+
+
+# ---------------------------------------------------------------------------
+# histogram_svg (obs/loadgen latency histograms)
+# ---------------------------------------------------------------------------
+
+def test_histogram_svg_bars_and_labels():
+    import math
+    svg = vis.histogram_svg([3, 0, 5, 1], [0.01, 0.1, 1.0, math.inf],
+                            title="request latency", unit="s")
+    assert svg.startswith("<svg") and svg.rstrip().endswith("</svg>")
+    # one bar per bucket (plus the frame rect)
+    assert svg.count("<rect") == 5
+    assert "request latency" in svg
+    # finite bounds label as numbers, the +Inf bucket as >last-finite
+    assert ">0.01</text>" in svg
+    assert ">>1</text>" in svg
+    # bar tooltips carry the per-bucket counts
+    assert "&#8804;0.01s: 3" in svg
+    assert "bucket upper bound (s)" in svg
+
+
+def test_histogram_svg_scales_to_top_count():
+    svg = vis.histogram_svg([10], [1.0])
+    # count axis ticks at 0 / half / top
+    assert ">0<" in svg and ">5<" in svg and ">10<" in svg
+
+
+def test_histogram_svg_empty_and_mismatch_fallback():
+    assert "no histogram samples" in vis.histogram_svg([], [])
+    assert "no histogram samples" in vis.histogram_svg([1, 2], [1.0])
+
+
+def test_write_histogram_svg(tmp_path):
+    p = tmp_path / "h.svg"
+    out = vis.write_histogram_svg([1, 2], [0.5, 1.0], p, title="t")
+    assert out == str(p)
+    assert p.read_text().startswith("<svg")
