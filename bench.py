@@ -34,7 +34,15 @@ Env overrides: OVERSIM_BENCH_N (nodes), OVERSIM_BENCH_MEASURE_WALL
 test period, s), OVERSIM_BENCH_PLATFORM ("axon" | "cpu" — skips probing),
 OVERSIM_BENCH_DEADLINE (orchestrator kill + exit-0 watchdog, s),
 OVERSIM_BENCH_CHUNK (scan ticks per while_loop body; default 256 TPU /
-32 CPU).
+32 CPU), OVERSIM_BENCH_TICK_IMPL ("dense" | "sparse" — the active-set
+tick plane, engine/sim.py) + OVERSIM_BENCH_ACTIVE_CAP (sparse lane
+bound, 0 = auto).
+
+OVERSIM_BENCH_ACTIVITY="0.01,0.1,1.0" switches to the ACTIVITY-SWEEP
+tier: one ms/tick row per activity fraction (per-node test interval =
+window / fraction) into the standard atomic artifact — the sparse
+plane's tick-cost-scales-with-traffic success metric, runnable on CPU
+today and TPU later unchanged.
 
 OVERSIM_BENCH_REPLICAS=S (S >= 1) switches to the CAMPAIGN tier: one
 vmapped program advances S independent replicas of the same scenario
@@ -383,6 +391,98 @@ def run_measurement_windows(sim, s, *, start_sim_t, window_sim_s,
 
 
 # ---------------------------------------------------------------------------
+# activity-sweep tier (OVERSIM_BENCH_ACTIVITY)
+# ---------------------------------------------------------------------------
+
+def run_activity_sweep(fracs, *, n, overlay, window, inbox, pool_f, slots,
+                       inbox_impl, tick_impl, active_cap, chunk, platform,
+                       warm_extra=5.0, reps=3):
+    """ms/tick vs activity fraction — the sparse plane's success metric
+    (ISSUE 16: steady ms/tick at N=65k with 1% activity within 2x of
+    N=1k) becomes measurable the moment a chip is available, and runs
+    on CPU today at small N.
+
+    Each fraction f drives a KBRTest workload whose per-node test
+    interval is ``window / f`` — the expected share of nodes with a due
+    app event per tick is f (maintenance timers add a floor on top).
+    Per fraction: fresh sim, device-resident warm past the init fill,
+    then ``reps`` timed ``run_chunk`` dispatches; one
+    ``activity_sweep`` JSON row per fraction (the orchestrator relays
+    them into the standard atomic artifact; ``tick_impl`` rides the
+    run manifest).  The measured awake share comes from the sparse
+    plane's own ``awake_nodes`` counter when available."""
+    import jax
+
+    from oversim_tpu import churn as churn_mod
+    from oversim_tpu import telemetry as telemetry_mod  # noqa: F401
+    from oversim_tpu.apps import kbrtest
+    from oversim_tpu.apps.kbrtest import KbrTestApp
+    from oversim_tpu.common import lookup as lk_mod
+    from oversim_tpu.engine import sim as sim_mod
+
+    for frac in fracs:
+        interval = window / max(frac, 1e-6)
+        app = KbrTestApp(kbrtest.KbrTestParams(test_interval=interval))
+        if overlay == "chord":
+            from oversim_tpu.overlay.chord import ChordLogic
+            logic = ChordLogic(app=app,
+                               lcfg=lk_mod.LookupConfig(slots=slots))
+        else:
+            from oversim_tpu.overlay.kademlia import KademliaLogic
+            logic = KademliaLogic(app=app,
+                                  lcfg=lk_mod.LookupConfig(slots=slots,
+                                                           merge=True))
+        cp = churn_mod.ChurnParams(model="none", target_num=n,
+                                   init_interval=20.0 / n,
+                                   init_deviation=2.0 / n)
+        ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
+                                  pool_factor=pool_f,
+                                  inbox_impl=inbox_impl,
+                                  tick_impl=tick_impl,
+                                  active_cap=active_cap)
+        sim = sim_mod.Simulation(logic, cp, engine_params=ep)
+        t0 = time.perf_counter()
+        s = sim.init(seed=7)
+        s = sim.run_until_device(s, cp.init_finished_time + warm_extra,
+                                 chunk=chunk)
+        jax.block_until_ready(s.t_now)
+        warm_wall = time.perf_counter() - t0
+        base = jax.device_get({"counters": s.counters, "tick": s.tick})
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s = jax.block_until_ready(sim.run_chunk(s, chunk))
+        wall = time.perf_counter() - t0
+        cur = jax.device_get({"counters": s.counters, "tick": s.tick})
+        ticks = int(cur["tick"]) - int(base["tick"])
+        row = {
+            "metric": "activity_sweep",
+            "activity": frac,
+            "test_interval": round(interval, 4),
+            "ms_per_tick": round(wall / max(ticks, 1) * 1e3, 3),
+            "ticks": ticks,
+            "n": n,
+            "overlay": overlay,
+            "window": window,
+            "tick_impl": tick_impl,
+            "inbox_impl": inbox_impl,
+            "active_cap": active_cap,
+            "platform": platform,
+            "warm_wall_s": round(warm_wall, 1),
+        }
+        if "awake_nodes" in cur["counters"]:
+            awake = (int(cur["counters"]["awake_nodes"])
+                     - int(base["counters"]["awake_nodes"]))
+            deferred = (int(cur["counters"]["active_deferred"])
+                        - int(base["counters"]["active_deferred"]))
+            row["awake_frac"] = round(awake / max(ticks, 1) / n, 4)
+            row["deferred"] = deferred
+        print(json.dumps(row), flush=True)
+        sys.stderr.write("bench: activity %.4f -> %.3f ms/tick "
+                         "(%d ticks)\n"
+                         % (frac, row["ms_per_tick"], ticks))
+
+
+# ---------------------------------------------------------------------------
 # child: probe + measure
 # ---------------------------------------------------------------------------
 
@@ -552,10 +652,19 @@ def child_main():
     from oversim_tpu.config import scenario as scenario_mod
     inbox_impl = scenario_mod.resolve_inbox_impl(
         os.environ.get("OVERSIM_BENCH_INBOX_IMPL", "scatter"))
+    # OVERSIM_BENCH_TICK_IMPL: dense (full-N oracle, default) | sparse
+    # (active-set plane — tick cost bounded by traffic, not N;
+    # engine/sim.py _step_sparse).  OVERSIM_BENCH_ACTIVE_CAP bounds the
+    # sparse lane count (0 = auto).
+    tick_impl = scenario_mod.resolve_tick_impl(
+        os.environ.get("OVERSIM_BENCH_TICK_IMPL", "dense"))
+    active_cap = int(os.environ.get("OVERSIM_BENCH_ACTIVE_CAP", "0"))
     from oversim_tpu import telemetry as telemetry_mod
     ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
                               pool_factor=pool_f,
                               inbox_impl=inbox_impl,
+                              tick_impl=tick_impl,
+                              active_cap=active_cap,
                               telemetry=telemetry_mod.TelemetryParams(
                                   sample_ticks=tel_ticks,
                                   window=tel_window))
@@ -573,18 +682,23 @@ def child_main():
     # device-resident only (no host-synced invariant tier).
     replicas = int(os.environ.get("OVERSIM_BENCH_REPLICAS", "0"))
 
-    # AOT pre-warm ($OVERSIM_AOT=1): deserialize-or-export the entry
-    # this run will compile, so a second process on the same config
-    # skips trace+lower entirely (oversim_tpu/aot/).  The report rides
-    # the manifest and the Perfetto trace.
+    # AOT pre-warm: deserialize-or-export the entry this run will
+    # compile, so a second process on the same config skips trace+lower
+    # entirely (oversim_tpu/aot/).  Default ON in the bench drivers
+    # (ROADMAP item 1 — BENCH_r05 spent its whole deadline warming up
+    # cold); OVERSIM_AOT=0 opts out.  The report rides the manifest and
+    # the Perfetto trace.
     from oversim_tpu import aot
     from oversim_tpu.analysis import contracts as contracts_mod
     aot_ctx = contracts_mod.EntryContext(
         n=n, overlay=overlay, window=window, inbox=inbox,
         pool_factor=pool_f, replicas=max(replicas, 1), tel_ticks=tel_ticks,
         chunk=chunk)
+    aot_on = aot.enabled_by_env({"OVERSIM_AOT":
+                                 os.environ.get("OVERSIM_AOT", "1")})
     aot_rep = aot.warmup(("campaign_tick",) if replicas >= 1
-                         else ("run_until_device",), ctx=aot_ctx)
+                         else ("run_until_device",), ctx=aot_ctx,
+                         enabled=aot_on)
     if trace is not None and aot_rep["enabled"]:
         aot.trace_spans(trace, aot_rep)
 
@@ -603,6 +717,7 @@ def child_main():
             port=int(metrics_port) if metrics_port is not None else None,
             flight_path=flight_path)
         obs.set_static(n=n, overlay=overlay, inbox_impl=inbox_impl,
+                       tick_impl=tick_impl,
                        replicas=int(os.environ.get(
                            "OVERSIM_BENCH_REPLICAS", "0")),
                        degraded_to_cpu=on_cpu)
@@ -618,6 +733,7 @@ def child_main():
                 "window": window, "inbox": inbox, "pool_factor": pool_f,
                 "inbox_impl": inbox_impl,
                 "kernel_plane": inbox_impl == "pallas",
+                "tick_impl": tick_impl, "active_cap": active_cap,
                 "chunk": chunk, "slots": slots,
                 "telemetry_sample_ticks": tel_ticks,
                 "telemetry_window": tel_window,
@@ -628,6 +744,23 @@ def child_main():
                    "flight": flight_path,
                    "xprof": xprof_mod.xprof_dir()},
         extra={"aot": aot_rep, "elastic": elastic_ann})), flush=True)
+
+    # OVERSIM_BENCH_ACTIVITY="0.01,0.1,1.0": the activity-sweep tier
+    # REPLACES the measurement loop — one ms/tick row per fraction into
+    # the same atomic artifact (tick_impl rides the manifest above)
+    activity_env = os.environ.get("OVERSIM_BENCH_ACTIVITY")
+    if activity_env:
+        fracs = [float(x) for x in activity_env.split(",") if x.strip()]
+        run_activity_sweep(
+            fracs, n=n, overlay=overlay, window=window, inbox=inbox,
+            pool_f=pool_f, slots=slots, inbox_impl=inbox_impl,
+            tick_impl=tick_impl, active_cap=active_cap, chunk=chunk,
+            platform=dev.platform)
+        if obs is not None:
+            obs.close()
+        if trace is not None:
+            trace.write(trace_path)
+        return
     camp = None
     summarize_leaves = _summary_from_leaves
     if replicas >= 1:
@@ -713,6 +846,7 @@ def child_main():
                 f"{wall:.1f}s wall)")
         extra = {"delivery": round(delivery, 4),
                  "inbox_impl": inbox_impl,
+                 "tick_impl": tick_impl,
                  "measured_utc": time.strftime(
                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
         if camp is not None:

@@ -102,6 +102,12 @@ class DeltaContract:
     max_sort_delta: int = 0
     max_scatter_delta: int = 64
     max_collective_delta: int = 0
+    # wide-gather delta (hlo_text.gather_counts: gathers whose result
+    # keeps a full-width leading dim — N or P).  None = recorded in the
+    # verdict JSON but unenforced; a NEGATIVE bound is a REQUIRED
+    # reduction (sparse_tick must actually drop the [N, R, W] payload
+    # gather, not just add compaction on top of it).
+    max_wide_gather_delta: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -156,7 +162,8 @@ class EntryPoint:
 # ---------------------------------------------------------------------------
 
 def build_sim(ctx: EntryContext, *, inbox_impl: str = "scatter",
-              telemetry_ticks: int = 0, ext_hold_slot: int = -1):
+              telemetry_ticks: int = 0, ext_hold_slot: int = -1,
+              tick_impl: str = "dense", active_cap: int = 0):
     """The bench-shaped Simulation every entry compiles (KbrTestApp over
     chord/kademlia, churn off — the same construction the historical
     hlo_breakdown modes used)."""
@@ -181,7 +188,8 @@ def build_sim(ctx: EntryContext, *, inbox_impl: str = "scatter",
     ep = sim_mod.EngineParams(
         window=ctx.window, inbox_slots=ctx.inbox,
         pool_factor=ctx.pool_factor, inbox_impl=inbox_impl,
-        ext_hold_slot=ext_hold_slot,
+        ext_hold_slot=ext_hold_slot, tick_impl=tick_impl,
+        active_cap=active_cap,
         telemetry=telemetry_mod.TelemetryParams(
             sample_ticks=telemetry_ticks))
     return sim_mod.Simulation(logic, cp, engine_params=ep)
@@ -322,6 +330,33 @@ def _build_fused_chunk(ctx):
               "inbox_impl": "pallas"})
 
 
+def _build_sparse_tick(ctx):
+    import jax
+    # a genuinely sparse lane count (cap < n) so the compiled graph has
+    # the [A]-shaped step, not a full-width alias of the dense tick
+    cap = max(8, ctx.n // 4)
+    sim = build_sim(ctx, tick_impl="sparse", active_cap=cap)
+    # donation REQUIRED by the contract: the sparse plane exists for the
+    # steady-state loop, where the full-width state must update in place
+    fn = jax.jit(sim.step, donate_argnums=(0,))
+    return EntryBuild(fn=fn, make_args=lambda: (sim.init(seed=7),),
+                      pool_dim=sim.ep.pool_factor * ctx.n,
+                      info={"n": ctx.n, "overlay": ctx.overlay,
+                            "tick_impl": "sparse", "active_cap": cap})
+
+
+def _build_sparse_chunk(ctx):
+    cap = max(8, ctx.n // 4)
+    sim = build_sim(ctx, tick_impl="sparse", active_cap=cap)
+    # same static-self discipline as solo_chunk/fused_chunk
+    return EntryBuild(
+        fn=type(sim).run_chunk,
+        make_args=lambda: (sim, sim.init(seed=7), ctx.chunk),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay, "n_ticks": ctx.chunk,
+              "tick_impl": "sparse", "active_cap": cap})
+
+
 def _build_service_window(ctx):
     import jax.numpy as jnp
     from oversim_tpu.engine.sim import NS
@@ -413,6 +448,29 @@ DEFAULT_ENTRIES = (
             "across chunks)",
         contract=_FUSED_CHUNK,
         build=_build_fused_chunk),
+    EntryPoint(
+        name="sparse_tick",
+        doc="jit(sim.step, donate) with the sparse active-set plane "
+            "armed (tick_impl=\"sparse\"): donation required, zero "
+            "full-pool sorts, no new collectives, and a NEGATIVE "
+            "wide-gather delta vs solo_tick — the [A]-lane step must "
+            "actually replace the full [N, R, W] payload gather",
+        contract=GraphContract(require_donation=True,
+                               max_scatters=DEFAULT_MAX_SCATTERS + 128),
+        build=_build_sparse_tick,
+        # scatter delta bounded, not negative: the A-lane scatter-backs
+        # (logic-state leaves + outbox/event planes) are each one gated
+        # drop-scatter; the REQUIRED reduction is the wide-gather one
+        delta=DeltaContract(base="solo_tick", max_scatter_delta=128,
+                            max_wide_gather_delta=-1)),
+    EntryPoint(
+        name="sparse_chunk",
+        doc="run_chunk with the sparse plane armed: donation must "
+            "survive the compacted step (the full-width state updates "
+            "in place across chunks)",
+        contract=GraphContract(require_donation=True,
+                               max_scatters=DEFAULT_MAX_SCATTERS + 128),
+        build=_build_sparse_chunk),
     EntryPoint(
         name="resharded_resume",
         doc="campaign tick on a state reshard-restored from a "

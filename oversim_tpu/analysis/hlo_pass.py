@@ -17,9 +17,14 @@ from oversim_tpu.analysis import hlo_text
 from oversim_tpu.analysis.findings import Finding
 
 
-def measure_entry(txt: str, pool_dim: int) -> dict:
-    """Every census the contracts can pin, from one optimized module."""
+def measure_entry(txt: str, pool_dim: int, wide_dims=()) -> dict:
+    """Every census the contracts can pin, from one optimized module.
+
+    ``wide_dims`` feeds the gather census (hlo_text.gather_counts):
+    the full-width leading dims — node count N and pool capacity P —
+    whose gathers the sparse plane exists to eliminate."""
     m = dict(hlo_text.hlo_op_counts(txt, pool_dim))
+    m.update(hlo_text.gather_counts(txt, wide_dims))
     m["collectives"] = hlo_text.collective_census(txt)
     m["custom_calls"] = hlo_text.custom_call_census(txt)
     m["host_transfers"] = hlo_text.host_transfer_count(txt)
@@ -102,6 +107,13 @@ def check_delta(name: str, delta, base_m: dict, m: dict) -> list:
         "scatter_delta": m["scatter_count"] - base_m["scatter_count"],
         "collective_delta": (m["collective_count"]
                              - base_m["collective_count"]),
+        # gather deltas are RECORDED for every delta entry (the verdict
+        # JSON carries the sparse plane's measured reduction); only
+        # max_wide_gather_delta != None enforces one
+        "gather_delta": (m.get("gather_count", 0)
+                         - base_m.get("gather_count", 0)),
+        "wide_gather_delta": (m.get("wide_gather_count", 0)
+                              - base_m.get("wide_gather_count", 0)),
     }
 
     def breach(rule, message, measured, limit):
@@ -125,6 +137,14 @@ def check_delta(name: str, delta, base_m: dict, m: dict) -> list:
         breach("delta-collectives",
                "new cross-device collectives relative to the base entry",
                d["collective_delta"], delta.max_collective_delta)
+    if delta.max_wide_gather_delta is not None and \
+            d["wide_gather_delta"] > delta.max_wide_gather_delta:
+        breach("delta-wide-gathers",
+               "full-width gather delta over budget — a negative bound "
+               "is a REQUIRED reduction: the sparse tick must replace "
+               "the [N, R, W] payload gather with the [A]-lane one, "
+               "not stack compaction on top of it",
+               d["wide_gather_delta"], delta.max_wide_gather_delta)
     return out, d
 
 
@@ -191,7 +211,8 @@ def run(ctx, selected=None, *, progress=None, builds=None,
         if progress:
             progress(f"hlo: compiling {entry.name} ...")
         txt, built = lower_entry(entry, ctx, builds)
-        m = measure_entry(txt, built.pool_dim)
+        m = measure_entry(txt, built.pool_dim,
+                          wide_dims=(built.info.get("n"), built.pool_dim))
         measured[entry.name] = m
         findings.extend(check_contract(entry.name, entry.contract, m))
         timing = built.info.get("compile_seconds",
@@ -217,7 +238,8 @@ def run(ctx, selected=None, *, progress=None, builds=None,
         entries_summary[entry.name] = {
             "counts": {k: m[k] for k in
                        ("sort_count", "full_pool_sort_count",
-                        "scatter_count", "collective_count")},
+                        "scatter_count", "collective_count",
+                        "gather_count", "wide_gather_count")},
             "collectives": m["collectives"],
             "custom_calls": m["custom_calls"],
             "host_transfers": m["host_transfers"],

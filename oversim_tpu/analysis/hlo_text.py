@@ -87,6 +87,35 @@ def hlo_op_counts(txt: str, pool_dim: int | None = None) -> dict:
             "scatter_count": scatters, "collective_count": collectives}
 
 
+_GATHER_RESULT = re.compile(r"=\s*(?:pred|[sfuc]\d+|u\d+|bf16)\[(\d+)[,\]]")
+
+
+def gather_counts(txt: str, wide_dims=()) -> dict:
+    """Gather census: ``{"gather_count", "wide_gather_count"}``.
+
+    A SEPARATE function from :func:`hlo_op_counts` — its return keys are
+    pinned by synthetic-HLO tests and by every recorded artifact, so the
+    sparse-plane gather census (ISSUE 16) adds a new dict instead of
+    widening the old one.  ``wide_gather_count`` counts gathers whose
+    RESULT's leading dimension is in ``wide_dims`` (the full node count
+    N or the pool capacity P): the dense tick's [N, R, W] payload gather
+    is wide, the sparse tick's [A, R, W] gather is not — the
+    ``sparse_tick`` delta contract pins that replacement as a REQUIRED
+    wide-gather reduction vs ``solo_tick``.  ``" gather("`` with the
+    leading space keeps ``all-gather(`` out of the census.
+    """
+    wide = {int(d) for d in wide_dims if d}
+    gathers = wides = 0
+    for ln in txt.splitlines():
+        if " gather(" not in ln:
+            continue
+        gathers += 1
+        m = _GATHER_RESULT.search(ln)
+        if m and int(m.group(1)) in wide:
+            wides += 1
+    return {"gather_count": gathers, "wide_gather_count": wides}
+
+
 def collective_census(txt: str) -> dict:
     """Per-opcode collective census, all-reduce refined by its reduce
     computation when recognizable.

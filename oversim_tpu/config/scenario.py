@@ -77,6 +77,20 @@ def resolve_inbox_impl(value: str, *, available: bool | None = None,
     return impl
 
 
+def resolve_tick_impl(value: str) -> str:
+    """Resolve a raw ``**.tickImpl`` string to the tick plane the engine
+    runs — ``"dense"`` (the full-N vmapped sweep, the bit-identity
+    oracle) or ``"sparse"`` (the active-set plane: only awake nodes run
+    the logic step; engine/sim.py ``_step_sparse``).  Both planes are
+    pure-lax with Pallas variants, so there is no availability fallback
+    to resolve; anything else raises :class:`ScenarioError`."""
+    impl = str(value).strip().strip('"')
+    if impl not in ("dense", "sparse"):
+        raise ScenarioError(f"unsupported tickImpl: {impl!r} "
+                            "(expected \"dense\" or \"sparse\")")
+    return impl
+
+
 def _get(ini, config, suffix, default=None):
     return _value(ini.get(f"{HOST}.{suffix}", config), default)
 
@@ -380,6 +394,8 @@ def build_simulation(ini: IniFile, config: str = "General",
     mp = build_malicious(ini, config)
     inbox_impl = resolve_inbox_impl(_value(
         ini.get("**.inboxImpl", config), "scatter"))
+    tick_impl = resolve_tick_impl(_value(
+        ini.get("**.tickImpl", config), "dense"))
     ep = engine_params or sim_mod.EngineParams(
         transition_time=float(_value(
             ini.get("**.transitionTime", config), 0.0)),
@@ -390,6 +406,11 @@ def build_simulation(ini: IniFile, config: str = "General",
         # oversim_tpu/kernels/) | "sort" (ORACLE-ONLY legacy full-pool
         # sort); this framework's ini extension, engine/pool.py
         inbox_impl=inbox_impl,
+        # **.tickImpl: "dense" (full-N oracle, default) | "sparse"
+        # (active-set plane); **.activeCap bounds the sparse lane count
+        # (0 = auto) — this framework's ini extension, engine/sim.py
+        tick_impl=tick_impl,
+        active_cap=int(_value(ini.get("**.activeCap", config), 0)),
         malicious=mp,
         telemetry=build_telemetry(ini, config),
     )

@@ -67,6 +67,18 @@ class EngineParams:
                                    # oversim_tpu/kernels/ — also arms the
                                    # fused outbox allocator) | "sort"
                                    # (legacy full-pool sort, ORACLE-ONLY)
+    tick_impl: str = "dense"       # node-step execution: "dense" (vmapped
+                                   # full-N sweep, the bit-identity
+                                   # ORACLE) | "sparse" (active-set plane:
+                                   # compact the awake nodes into A dense
+                                   # lanes, step only those, scatter the
+                                   # results back — tick cost scales with
+                                   # traffic, not N)
+    active_cap: int = 0            # A — sparse active-set lane count;
+                                   # 0 = auto (min(n, max(64, n // 8))).
+                                   # Awake nodes past the cap DEFER to
+                                   # the next tick (never dropped; see
+                                   # _phase_active_compact)
     outbox_slots: int = 16         # MOUT — msgs emitted per node per tick
     pool_factor: int = 8           # P = pool_factor * N message slots
     rmax: int = 16                 # node-list payload width
@@ -111,6 +123,12 @@ class SimState:
 ENGINE_COUNTERS = ("queue_lost", "bit_error_lost", "dest_unavailable_lost",
                    "partition_lost", "pool_overflow", "outbox_overflow",
                    "inbox_deferred")
+# sparse-plane accounting, carried in SimState.counters ONLY when
+# tick_impl == "sparse" (the dense SimState layout stays bit-identical
+# to the pre-sparse engine): cumulative awake-node and active-inbox-
+# destination lane counts per run, plus the count of awake nodes
+# deferred past ``active_cap`` (deferral, never loss)
+SPARSE_COUNTERS = ("awake_nodes", "active_dst", "active_deferred")
 
 
 def _dedupe_buffers(state):
@@ -154,6 +172,24 @@ class Simulation:
         self.n = churn_params.num_slots
         self.spec = logic.key_spec
 
+    @property
+    def counter_names(self) -> tuple:
+        """Counter keys carried in SimState.counters for this engine
+        config (the sparse plane rides its active-set accounting along;
+        the dense layout is untouched)."""
+        if self.ep.tick_impl == "sparse":
+            return ENGINE_COUNTERS + SPARSE_COUNTERS
+        return ENGINE_COUNTERS
+
+    @property
+    def acap(self) -> int:
+        """A — static sparse active-set capacity (lanes per tick).
+        ``active_cap=0`` auto-sizes: full-N at small n (bit-identity is
+        then unconditional), N/8 once n outgrows 8*64."""
+        if self.ep.active_cap > 0:
+            return min(self.ep.active_cap, self.n)
+        return min(self.n, max(64, self.n // 8))
+
     # -- init ---------------------------------------------------------------
 
     def init(self, seed: int = 1, ov=None) -> SimState:
@@ -188,9 +224,10 @@ class Simulation:
                        < self.ep.malicious.probability),
             logic=self.logic.init(r_logic, n),
             stats=stats,
-            counters={name: jnp.zeros((), I64) for name in ENGINE_COUNTERS},
+            counters={name: jnp.zeros((), I64)
+                      for name in self.counter_names},
             telemetry=telemetry_mod.init(
-                stats, ENGINE_COUNTERS, self.ep.telemetry,
+                stats, self.counter_names, self.ep.telemetry,
                 app=getattr(self.logic, "app", None)),
         )
 
@@ -323,10 +360,14 @@ class Simulation:
         msgs = self._phase_inbox_gather(s, t_next, inbox)
         return msgs, delivered, to_dead
 
-    def _phase_node_step(self, s: SimState, t_next, t_end, alive, pre_killed,
-                         churn_state, node_keys, ul_state, logic_state, msgs,
-                         r_nodes, *, ov=None):
-        """Phase 4/5: tick context + the vmapped per-node logic step."""
+    def _make_ctx(self, s: SimState, t_next, t_end, alive, pre_killed,
+                  churn_state, node_keys, ul_state, logic_state, *, ov=None):
+        """Tick context shared by the dense and sparse node-step phases.
+
+        The Ctx is always FULL-WIDTH — node handlers index the ready/
+        bootstrap vectors by true node id, so the sparse path can
+        broadcast the same ctx over its compacted lanes.  Returns
+        ``(ctx, node_part, glob, measuring)``."""
         n, ep, up, cp = self.n, self.ep, self.up, self.cp
         logic = self.logic
         ready = logic.ready_mask(logic_state) & alive & ~pre_killed
@@ -360,8 +401,26 @@ class Simulation:
                   graceful=pre_killed & alive & churn_state.graceful,
                   malicious=s.malicious, ov=ov,
                   **part_kw)
-        node_rngs = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-            jax.random.fold_in(r_nodes, s.tick), jnp.arange(n))
+        return ctx, node_part, glob, measuring
+
+    def _node_rngs(self, r_nodes, tick, idx):
+        """Per-node rng streams: fold tick, then node index.  The sparse
+        path folds the TRUE node index of each compacted lane (same
+        dtype as the dense ``jnp.arange``), so the streams are
+        bit-identical between tick impls."""
+        return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            jax.random.fold_in(r_nodes, tick), idx)
+
+    def _phase_node_step(self, s: SimState, t_next, t_end, alive, pre_killed,
+                         churn_state, node_keys, ul_state, logic_state, msgs,
+                         r_nodes, *, ov=None):
+        """Phase 4/5: tick context + the vmapped per-node logic step."""
+        n = self.n
+        logic = self.logic
+        ctx, node_part, glob, measuring = self._make_ctx(
+            s, t_next, t_end, alive, pre_killed, churn_state, node_keys,
+            ul_state, logic_state, ov=ov)
+        node_rngs = self._node_rngs(r_nodes, s.tick, jnp.arange(n))
         node_idx = jnp.arange(n, dtype=I32)
 
         node_part, out_fields, out_valid, out_overflow, events = jax.vmap(
@@ -374,10 +433,121 @@ class Simulation:
         return (logic_state, out_fields, out_valid, out_overflow, events,
                 measuring)
 
+    # -- sparse active-set plane (tick_impl="sparse") -----------------------
+
+    def _phase_inbox_select_sparse(self, s: SimState, t_end, alive):
+        """Sparse phase 3: selection WITHOUT the full [N, R, W] payload
+        gather — the sparse step gathers only the A compacted rows.
+        Under ``inbox_impl="pallas"`` the fused kernel runs in
+        select-only mode (occupancy-bounded walk, no gather pass)."""
+        if self.ep.inbox_impl == "pallas":
+            from oversim_tpu import kernels
+            return kernels.inbox.fused_select(
+                s.pool, self.n, self.ep.inbox_slots, t_end, alive,
+                hold=self._hold_mask(s))
+        return self._phase_inbox_select(s, t_end, alive)
+
+    def _phase_active_compact(self, s: SimState, t_end, alive, pre_killed,
+                              logic_state, inbox, delivered):
+        """Sparse phase 4a: compact the awake node set into A dense
+        lanes (the ``pool.alloc`` cumsum-compaction idiom; the kernel
+        plane uses the serial-counting compaction in
+        kernels/outbox.py).
+
+        A node is awake when it has inbox traffic this window
+        (``inbox[:, 0] >= 0`` — the selectors fill slot 0 first), a due
+        local timer (``logic.next_event < t_end`` — the same oracle the
+        event horizon trusts), or churn touched its slot this tick
+        (created, killed, or pre-killed).  Every other node is an exact
+        fixed point of ``_node_step``, pinned bit-for-bit against the
+        dense oracle by tests/test_zz_sparse.py and
+        scripts/sparse_gate.py.
+
+        Awake nodes past the cap DEFER, never drop: their timers stay
+        due, their selected messages revert to "not delivered" (the
+        R-overflow retention mechanism), and the compaction walk starts
+        at a per-tick rotating offset so persistent overload
+        round-robins the active set instead of starving the tail.
+        Returns ``(act [A] i32 lane->node map (sentinel n), delivered
+        [P] bool trimmed to stepped destinations, active
+        (awake, active_dst, deferred) i64 tallies)``."""
+        n, cap = self.n, self.acap
+        has_msg = inbox[:, 0] >= 0
+        timer_due = alive & (self.logic.next_event(logic_state) < t_end)
+        churned = (alive ^ s.alive) | (pre_killed & alive)
+        awake = has_msg | timer_due | churned
+        n_awake = jnp.sum(awake.astype(I32))
+        off = (s.tick % n).astype(I32)
+        perm = (jnp.arange(n, dtype=I32) + off) % n
+        aw_r = awake[perm]
+        if self.ep.inbox_impl == "pallas":
+            from oversim_tpu import kernels
+            act, _cnt = kernels.outbox.compact_indices(aw_r, perm, cap,
+                                                       sentinel=n)
+        else:
+            aw_i = aw_r.astype(I32)
+            rank = jnp.cumsum(aw_i) - aw_i
+            act = jnp.full((cap,), n, I32).at[
+                jnp.where(aw_r & (rank < cap), rank, cap)].set(
+                    perm, mode="drop")
+        taken = jnp.zeros((n,), bool).at[act].set(True, mode="drop")
+        # messages selected for a deferred destination stay pooled with
+        # their original timestamps and are re-offered next tick
+        delivered = delivered & taken[jnp.clip(s.pool.dst, 0, n - 1)]
+        active = (n_awake.astype(I64),
+                  jnp.sum(has_msg.astype(I32)).astype(I64),
+                  (n_awake - jnp.minimum(n_awake, cap)).astype(I64))
+        return act, delivered, active
+
+    def _phase_sparse_step(self, s: SimState, t_next, t_end, alive,
+                           pre_killed, churn_state, node_keys, ul_state,
+                           logic_state, inbox, act, r_nodes, *, ov=None):
+        """Sparse phase 4b: the vmapped logic step over the COMPACTED
+        [A] lane set only, scattered back into full-width state.
+
+        Sentinel lanes (``act == n``) clamp to node n-1 for the compute
+        and drop at every scatter-back; the outbox/event bases are
+        zeros, which is write-equivalent to the dense path's idle-lane
+        junk because every downstream consumer (send_batch, alloc,
+        stats.record) is mask-gated."""
+        n = self.n
+        logic = self.logic
+        ctx, node_part, glob, measuring = self._make_ctx(
+            s, t_next, t_end, alive, pre_killed, churn_state, node_keys,
+            ul_state, logic_state, ov=ov)
+        act_c = jnp.minimum(act, n - 1)
+        lane_ok = act < n
+        inbox_act = jnp.where(lane_ok[:, None], inbox[act_c], -1)
+        gblk = s.pool.blk[jnp.maximum(inbox_act, 0)]       # [A, R, W]
+        msgs = self._msgs_from_block(s, t_next, inbox_act, gblk)
+        part_act = jax.tree_util.tree_map(lambda x: x[act_c], node_part)
+        node_rngs = self._node_rngs(r_nodes, s.tick, act_c.astype(jnp.int_))
+
+        part_act, out_f, out_v, out_o, ev = jax.vmap(
+            self._node_step, in_axes=(None, 0, 0, 0, 0))(
+                ctx, part_act, msgs, node_rngs, act_c)
+
+        scat = lambda base, upd: base.at[act].set(upd, mode="drop")  # noqa: E731
+        node_part = jax.tree_util.tree_map(scat, node_part, part_act)
+        full = lambda x: jnp.zeros((n,) + x.shape[1:], x.dtype)  # noqa: E731
+        out_fields = jax.tree_util.tree_map(
+            lambda x: scat(full(x), x), out_f)
+        out_valid = scat(full(out_v), out_v)
+        out_overflow = scat(full(out_o), out_o)
+        events = jax.tree_util.tree_map(lambda x: scat(full(x), x), ev)
+
+        logic_state = (logic.merge(node_part, glob)
+                       if hasattr(logic, "merge") else node_part)
+        if hasattr(logic, "post_step"):
+            logic_state = logic.post_step(ctx, logic_state, events)
+        return (logic_state, out_fields, out_valid, out_overflow, events,
+                measuring)
+
     def _phase_alloc_stats(self, s: SimState, t_end, rng, r_send, alive,
                            pre_killed, node_keys, ul_state, churn_state,
                            logic_state, delivered, to_dead, out_fields,
-                           out_valid, out_overflow, events, measuring):
+                           out_valid, out_overflow, events, measuring, *,
+                           active=None):
         """Phase 5/5: free delivered slots, send the outbox through the
         underlay into free pool slots (sort-free alloc), fold stats."""
         ep, up = self.ep, self.up
@@ -417,6 +587,14 @@ class Simulation:
             counters["inbox_deferred"],
             (jnp.sum(s.pool.valid & (s.pool.t_deliver < t_end)) -
              jnp.sum(delivered | to_dead)).astype(jnp.int64))
+        if active is not None:
+            # sparse-plane accounting (tick_impl="sparse" only): lane
+            # tallies from _phase_active_compact — cumulative like the
+            # loss counters, so the telemetry rings carry the series
+            n_awake, active_dst, n_deferred = active
+            counters["awake_nodes"] += n_awake
+            counters["active_dst"] += active_dst
+            counters["active_deferred"] += n_deferred
 
         # telemetry sample point (telemetry.py): END-of-tick snapshot of
         # the accumulators into the ring buffers, gated on the sampling
@@ -450,6 +628,8 @@ class Simulation:
         ``app.*`` key a handler reads via ``Ctx.ov_get``.  ``None``
         (the default everywhere) keeps the trace bit-identical to the
         pre-campaign engine."""
+        if self.ep.tick_impl == "sparse":
+            return self._step_sparse(s, ov=ov)
         t_next, t_end, rngs = self._phase_horizon(s, ov=ov)
         (rng, r_churn, r_keys, r_reset, r_nodes, r_mig, r_send) = rngs
         (churn_state, alive, pre_killed, node_keys, ul_state,
@@ -464,6 +644,32 @@ class Simulation:
             s, t_end, rng, r_send, alive, pre_killed, node_keys, ul_state,
             churn_state, logic_state, delivered, to_dead, out_fields,
             out_valid, out_overflow, events, measuring)
+
+    def _step_sparse(self, s: SimState, *, ov=None) -> SimState:
+        """One sparse tick: horizon/churn/alloc phases are shared with
+        the dense oracle; the inbox skips the full-width gather, the
+        awake set compacts into A lanes, and only those lanes run
+        ``_node_step``.  Bit-identical to ``step`` whenever the awake
+        count fits ``active_cap`` (unconditional at the auto cap for
+        n <= 64); beyond the cap, deterministic rotation-fair
+        deferral."""
+        t_next, t_end, rngs = self._phase_horizon(s, ov=ov)
+        (rng, r_churn, r_keys, r_reset, r_nodes, r_mig, r_send) = rngs
+        (churn_state, alive, pre_killed, node_keys, ul_state,
+         logic_state) = self._phase_churn(s, t_next, t_end, r_churn, r_keys,
+                                          r_reset, r_mig, ov=ov)
+        inbox, delivered, to_dead = self._phase_inbox_select_sparse(
+            s, t_end, alive)
+        act, delivered, active = self._phase_active_compact(
+            s, t_end, alive, pre_killed, logic_state, inbox, delivered)
+        (logic_state, out_fields, out_valid, out_overflow, events,
+         measuring) = self._phase_sparse_step(
+            s, t_next, t_end, alive, pre_killed, churn_state, node_keys,
+            ul_state, logic_state, inbox, act, r_nodes, ov=ov)
+        return self._phase_alloc_stats(
+            s, t_end, rng, r_send, alive, pre_killed, node_keys, ul_state,
+            churn_state, logic_state, delivered, to_dead, out_fields,
+            out_valid, out_overflow, events, measuring, active=active)
 
     def _node_step(self, ctx, state_n, msgs_n, rng_n, node_idx):
         """Single-node step (vmapped): logic consumes inbox + timers."""

@@ -50,16 +50,26 @@ I32 = jnp.int32
 _I32_MAX = jnp.iinfo(jnp.int32).max
 
 
-def _inbox_kernel(due_ref, dst_ref, thi_ref, tlo_ref, blk_ref,
-                  inbox_ref, delivered_ref, gblk_ref,
-                  khi_ref, klo_ref, *, p, n, r, w):
-    """One program: select pass over P, then gather pass over N*R.
+def _inbox_kernel(occ_ref, due_ref, dst_ref, thi_ref, tlo_ref, *refs,
+                  p, n, r, w, gather):
+    """One program: select pass over P, then (optional) gather over N*R.
 
     khi/klo are the VMEM [N, R] sort-key registers mirroring inbox_ref
     (i32 max = empty, so any real key inserts before them).  All loop
     indices are cast to i32 — under x64 ``fori_loop`` counts in i64,
     which must not leak into i32 ref stores.
+
+    ``occ_ref`` (SMEM scalar) is the OCCUPANCY early-out: the highest
+    due pool index + 1, computed outside.  The select walk runs to occ,
+    not capacity P — bit-identity is free (slots past the last due
+    index can never insert) and a near-empty pool costs a near-empty
+    walk.  ``gather=False`` (the sparse tick's select-only mode) skips
+    the N*R gather pass entirely and takes no blk input.
     """
+    if gather:
+        blk_ref, inbox_ref, delivered_ref, gblk_ref, khi_ref, klo_ref = refs
+    else:
+        inbox_ref, delivered_ref, khi_ref, klo_ref = refs
     inbox_ref[:] = jnp.full((n, r), -1, I32)
     delivered_ref[:] = jnp.zeros((p,), I32)
     khi_ref[:] = jnp.full((n, r), _I32_MAX, I32)
@@ -106,46 +116,67 @@ def _inbox_kernel(due_ref, dst_ref, thi_ref, tlo_ref, blk_ref,
 
         return carry
 
-    jax.lax.fori_loop(0, p, select_body, None)
+    jax.lax.fori_loop(0, occ_ref[0], select_body, None)
 
-    def gather_body(jv, carry):
-        j = jv.astype(I32)
-        nn = j // I32(r)
-        rr = j % I32(r)
-        ix = inbox_ref[nn, rr]
-        gblk_ref[nn, rr, :] = blk_ref[jnp.maximum(ix, 0), :]
-        return carry
+    if gather:
+        def gather_body(jv, carry):
+            j = jv.astype(I32)
+            nn = j // I32(r)
+            rr = j % I32(r)
+            ix = inbox_ref[nn, rr]
+            gblk_ref[nn, rr, :] = blk_ref[jnp.maximum(ix, 0), :]
+            return carry
 
-    jax.lax.fori_loop(0, n * r, gather_body, None)
+        jax.lax.fori_loop(0, n * r, gather_body, None)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "r", "interpret"))
-def _fused_call(due, dstc, thi, tlo, blk, *, n, r, interpret):
+@functools.partial(jax.jit,
+                   static_argnames=("n", "r", "interpret", "gather"))
+def _fused_call(due, dstc, thi, tlo, blk, *, n, r, interpret, gather=True):
     p, w = blk.shape
-    kernel = functools.partial(_inbox_kernel, p=p, n=n, r=r, w=w)
+    kernel = functools.partial(_inbox_kernel, p=p, n=n, r=r, w=w,
+                               gather=gather)
+    # occupancy bound: highest due index + 1 — the select walk's true
+    # extent (SMEM scalar; kernel work scales with traffic, not P)
+    occ = jnp.max(jnp.where(due != 0, jnp.arange(p, dtype=I32) + 1,
+                            0)).reshape((1,))
+    # array operands stay whole-array in VMEM (the pre-occupancy
+    # default); only the occ scalar needs an explicit SMEM placement
+    arr = pl.BlockSpec(memory_space=pltpu.VMEM)
+    out_shape = [
+        jax.ShapeDtypeStruct((n, r), I32),          # inbox
+        jax.ShapeDtypeStruct((p,), I32),            # delivered
+    ]
+    operands = (occ, due, dstc, thi, tlo)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), arr, arr, arr, arr]
+    if gather:
+        out_shape.append(
+            jax.ShapeDtypeStruct((n, r, w), I32))   # gathered block
+        operands += (blk,)
+        in_specs.append(arr)
     return pl.pallas_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((n, r), I32),      # inbox
-            jax.ShapeDtypeStruct((p,), I32),        # delivered
-            jax.ShapeDtypeStruct((n, r, w), I32),   # gathered block
-        ),
+        out_shape=tuple(out_shape),
+        in_specs=in_specs,
+        out_specs=tuple(arr for _ in out_shape),
         scratch_shapes=[
             pltpu.VMEM((n, r), I32),                # khi
             pltpu.VMEM((n, r), I32),                # klo
         ],
         interpret=interpret,
-    )(due, dstc, thi, tlo, blk)
+    )(*operands)
 
 
 def fused_inbox(pool, n: int, r: int, t_end, alive, hold=None,
-                interpret: bool | None = None):
+                interpret: bool | None = None, gather: bool = True):
     """Fused inbox select + gather.
 
     Same contract as ``pool.build_inbox`` plus the gathered payload:
     returns ``(inbox [N,R] i32, delivered [P] bool, dropped_dead [P]
     bool, gblk [N,R,W] i32)``.  ``interpret=None`` auto-selects the
-    Pallas interpreter off-TPU (kernels.interpret_default)."""
+    Pallas interpreter off-TPU (kernels.interpret_default).
+    ``gather=False`` (the sparse tick) returns the 3-tuple without
+    ``gblk`` and skips the N*R gather pass in-kernel."""
     from oversim_tpu import kernels
 
     if interpret is None:
@@ -159,7 +190,20 @@ def fused_inbox(pool, n: int, r: int, t_end, alive, hold=None,
     t_m = jnp.where(due, pool.t_deliver, 0)
     thi = (t_m >> 31).astype(I32)
     tlo = (t_m & jnp.int64(0x7FFFFFFF)).astype(I32)
-    inbox, delivered, gblk = _fused_call(
+    out = _fused_call(
         due.astype(I32), dstc, thi, tlo, pool.blk,
-        n=n, r=r, interpret=bool(interpret))
+        n=n, r=r, interpret=bool(interpret), gather=gather)
+    if not gather:
+        inbox, delivered = out
+        return inbox, delivered.astype(bool), to_dead
+    inbox, delivered, gblk = out
     return inbox, delivered.astype(bool), to_dead, gblk
+
+
+def fused_select(pool, n: int, r: int, t_end, alive, hold=None,
+                 interpret: bool | None = None):
+    """Select-only fused inbox (sparse tick plane): ``pool.build_inbox``
+    semantics — ``(inbox, delivered, dropped_dead)`` — with the
+    occupancy-bounded kernel walk and NO payload gather."""
+    return fused_inbox(pool, n, r, t_end, alive, hold=hold,
+                       interpret=interpret, gather=False)

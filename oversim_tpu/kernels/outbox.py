@@ -103,3 +103,67 @@ def alloc_dest(valid, want, interpret: bool | None = None):
     dest, over = _dest_call(valid.astype(I32), want.astype(I32),
                             interpret=bool(interpret))
     return dest, over[0]
+
+
+def _compact_kernel(mask_ref, vals_ref, out_ref, count_ref, cnt_ref, *,
+                    m, cap, sentinel):
+    """Serial counting compaction: the k-th set mask bit (walk order)
+    writes ``vals[i]`` to lane k; lanes past ``cap`` defer (the counter
+    keeps running so the caller learns the TRUE active count)."""
+    cnt_ref[0] = I32(0)
+    out_ref[:] = jnp.full((cap,), sentinel, I32)
+
+    def body(iv, carry):
+        i = iv.astype(I32)
+
+        @pl.when(mask_ref[i] != 0)
+        def _():
+            c = cnt_ref[0]
+
+            @pl.when(c < cap)
+            def _():
+                out_ref[c] = vals_ref[i]
+
+            cnt_ref[0] = c + 1
+
+        return carry
+
+    jax.lax.fori_loop(0, m, body, None)
+    count_ref[0] = cnt_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "sentinel", "interpret"))
+def _compact_call(mask, vals, *, cap, sentinel, interpret):
+    m = mask.shape[0]
+    kernel = functools.partial(_compact_kernel, m=m, cap=cap,
+                               sentinel=sentinel)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((cap,), I32),      # compacted lanes
+            jax.ShapeDtypeStruct((1,), I32),        # active count
+        ),
+        scratch_shapes=[
+            pltpu.SMEM((1,), I32),                  # running counter
+        ],
+        interpret=interpret,
+    )(mask, vals)
+
+
+def compact_indices(mask, vals, cap: int, sentinel: int,
+                    interpret: bool | None = None):
+    """(lanes [cap] i32, count i32 scalar) — the sparse tick's
+    active-set compaction (engine/sim.py ``_phase_active_compact``):
+    lane k holds ``vals[i]`` for the k-th set ``mask`` bit, ``sentinel``
+    beyond the active count; ``count`` is the total set-bit count (may
+    exceed ``cap`` — overflowed entries defer to the next tick).
+    Bit-identical to the cumsum-compaction idiom from ``pool.alloc``,
+    pinned in tests/test_kernels.py."""
+    from oversim_tpu import kernels
+
+    if interpret is None:
+        interpret = kernels.interpret_default()
+    lanes, count = _compact_call(mask.astype(I32), vals.astype(I32),
+                                 cap=int(cap), sentinel=int(sentinel),
+                                 interpret=bool(interpret))
+    return lanes, count[0]

@@ -20,6 +20,13 @@ are ONE fused Pallas kernel — the report then carries a single
 ``inbox_fused`` phase in their place (plus ``kernel_plane: true``)
 instead of silently attributing the kernel time to neither half.
 
+Under the sparse plane (``tick_impl="sparse"``) the layout is
+``horizon / churn / inbox_select / active_compact / sparse_step /
+alloc_stats``: selection never gathers the full payload block,
+``active_compact`` packs the awake set into A lanes, and
+``sparse_step`` is the logic sweep over those lanes only (the report
+carries ``tick_impl`` so artifact readers can tell the layouts apart).
+
 Each phase is jitted SEPARATELY and timed with ``block_until_ready``
 over ``n_ticks`` real ticks.  Sub-jits lose cross-phase fusion, so the
 phase sum exceeds the fused tick cost — the per-phase SHARES are the
@@ -52,10 +59,17 @@ PHASES = ("horizon", "churn", "inbox_select", "inbox_gather", "node_step",
 # kernel-plane layout: the fused Pallas kernel owns both inbox halves
 PHASES_FUSED = ("horizon", "churn", "inbox_fused", "node_step",
                 "alloc_stats")
+# sparse-plane layout (tick_impl="sparse"): selection never gathers the
+# full [N, R, W] payload; the awake set compacts into A lanes
+# (active_compact) and only those lanes run the logic sweep (sparse_step)
+PHASES_SPARSE = ("horizon", "churn", "inbox_select", "active_compact",
+                 "sparse_step", "alloc_stats")
 
 
-def phases_for(inbox_impl: str) -> tuple:
+def phases_for(inbox_impl: str, tick_impl: str = "dense") -> tuple:
     """The phase layout a Simulation's tick decomposes into."""
+    if tick_impl == "sparse":
+        return PHASES_SPARSE
     return PHASES_FUSED if inbox_impl == "pallas" else PHASES
 
 
@@ -89,6 +103,22 @@ def _jit_phases(sim):
             of, ov, oo, ev, ms: sim._phase_alloc_stats(
                 s, te, rng, rs, alive, pk, nk, ul, cs, lg, dlv, dead,
                 of, ov, oo, ev, ms)),
+        # sparse plane (tick_impl="sparse")
+        "inbox_select_sparse": jax.jit(
+            lambda s, te, alive: sim._phase_inbox_select_sparse(
+                s, te, alive)),
+        "active_compact": jax.jit(
+            lambda s, te, alive, pk, lg, inbox, dlv:
+            sim._phase_active_compact(s, te, alive, pk, lg, inbox, dlv)),
+        "sparse_step": jax.jit(
+            lambda s, tn, te, alive, pk, cs, nk, ul, lg, inbox, act, rn:
+            sim._phase_sparse_step(s, tn, te, alive, pk, cs, nk, ul, lg,
+                                   inbox, act, rn)),
+        "alloc_stats_sparse": jax.jit(
+            lambda s, te, rng, rs, alive, pk, nk, ul, cs, lg, dlv, dead,
+            of, ov, oo, ev, ms, act: sim._phase_alloc_stats(
+                s, te, rng, rs, alive, pk, nk, ul, cs, lg, dlv, dead,
+                of, ov, oo, ev, ms, active=act)),
     }
 
 
@@ -118,8 +148,9 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
     pays all phase compiles and is EXCLUDED from the averages.
     """
     fns = _jit_phases(sim)
-    fused_inbox = sim.ep.inbox_impl == "pallas"
-    phases = phases_for(sim.ep.inbox_impl)
+    sparse = sim.ep.tick_impl == "sparse"
+    fused_inbox = sim.ep.inbox_impl == "pallas" and not sparse
+    phases = phases_for(sim.ep.inbox_impl, sim.ep.tick_impl)
     totals = {p: 0.0 for p in phases}
     compile_s = 0.0
     measured = 0
@@ -141,37 +172,67 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
             fns["churn"](s, t_next, t_end, r_churn, r_keys, r_reset, r_mig))
         dt_c = time.perf_counter() - t0
 
-        if fused_inbox:
-            t0 = time.perf_counter()
-            msgs, delivered, to_dead = jax.block_until_ready(
-                fns["inbox_fused"](s, t_next, t_end, alive))
-            inbox_dts = (time.perf_counter() - t0,)
-        else:
+        if sparse:
             t0 = time.perf_counter()
             inbox, delivered, to_dead = jax.block_until_ready(
-                fns["inbox_select"](s, t_end, alive))
+                fns["inbox_select_sparse"](s, t_end, alive))
             dt_is = time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            msgs = jax.block_until_ready(
-                fns["inbox_gather"](s, t_next, inbox))
+            act, delivered, active = jax.block_until_ready(
+                fns["active_compact"](s, t_end, alive, pre_killed,
+                                      logic_state, inbox, delivered))
             inbox_dts = (dt_is, time.perf_counter() - t0)
 
-        t0 = time.perf_counter()
-        (logic_state, out_fields, out_valid, out_overflow, events,
-         measuring) = jax.block_until_ready(
-            fns["node_step"](s, t_next, t_end, alive, pre_killed,
-                             churn_state, node_keys, ul_state, logic_state,
-                             msgs, r_nodes))
-        dt_n = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            (logic_state, out_fields, out_valid, out_overflow, events,
+             measuring) = jax.block_until_ready(
+                fns["sparse_step"](s, t_next, t_end, alive, pre_killed,
+                                   churn_state, node_keys, ul_state,
+                                   logic_state, inbox, act, r_nodes))
+            dt_n = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        s = jax.block_until_ready(
-            fns["alloc_stats"](s, t_end, rng, r_send, alive, pre_killed,
-                               node_keys, ul_state, churn_state, logic_state,
-                               delivered, to_dead, out_fields, out_valid,
-                               out_overflow, events, measuring))
-        dt_a = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            s = jax.block_until_ready(
+                fns["alloc_stats_sparse"](
+                    s, t_end, rng, r_send, alive, pre_killed, node_keys,
+                    ul_state, churn_state, logic_state, delivered, to_dead,
+                    out_fields, out_valid, out_overflow, events, measuring,
+                    active))
+            dt_a = time.perf_counter() - t0
+        else:
+            if fused_inbox:
+                t0 = time.perf_counter()
+                msgs, delivered, to_dead = jax.block_until_ready(
+                    fns["inbox_fused"](s, t_next, t_end, alive))
+                inbox_dts = (time.perf_counter() - t0,)
+            else:
+                t0 = time.perf_counter()
+                inbox, delivered, to_dead = jax.block_until_ready(
+                    fns["inbox_select"](s, t_end, alive))
+                dt_is = time.perf_counter() - t0
+
+                t0 = time.perf_counter()
+                msgs = jax.block_until_ready(
+                    fns["inbox_gather"](s, t_next, inbox))
+                inbox_dts = (dt_is, time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            (logic_state, out_fields, out_valid, out_overflow, events,
+             measuring) = jax.block_until_ready(
+                fns["node_step"](s, t_next, t_end, alive, pre_killed,
+                                 churn_state, node_keys, ul_state,
+                                 logic_state, msgs, r_nodes))
+            dt_n = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            s = jax.block_until_ready(
+                fns["alloc_stats"](s, t_end, rng, r_send, alive, pre_killed,
+                                   node_keys, ul_state, churn_state,
+                                   logic_state, delivered, to_dead,
+                                   out_fields, out_valid, out_overflow,
+                                   events, measuring))
+            dt_a = time.perf_counter() - t0
 
         if first:
             compile_s = time.perf_counter() - t_tick0
@@ -190,6 +251,7 @@ def profile_ticks(sim, s, n_ticks: int = 4, fused_reference: bool = True,
         "metric": "tick_phase_breakdown",
         "n_ticks": measured,
         "inbox_impl": sim.ep.inbox_impl,
+        "tick_impl": sim.ep.tick_impl,
         "kernel_plane": fused_inbox,
         "phase_ms_per_tick": phase_ms,
         "phase_frac": {p: round(totals[p] / max(sum(totals.values()), 1e-12),

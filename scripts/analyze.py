@@ -179,8 +179,47 @@ def _seed_kernel(ctx):
         "custom_calls": m["custom_calls"]}}}
 
 
+# synthetic base/sparse module pair where the "sparse" module KEEPS
+# the full-width gathers and stacks a new one on top — the sparse_tick
+# delta contract's required wide-gather REDUCTION must flag it
+# (pure-text, no backend)
+_SEED_SPARSE_BASE = '''\
+HloModule seeded_base
+
+ENTRY %main {
+  %g0 = f32[64,4,6]{2,1,0} gather(%pool, %idx), offset_dims={1}
+}
+'''
+
+_SEED_SPARSE_HLO = '''\
+HloModule seeded_sparse
+
+ENTRY %main {
+  %g0 = f32[64,4,6]{2,1,0} gather(%pool, %idx), offset_dims={1}
+  %g1 = s32[256]{0} gather(%pool, %due)
+}
+'''
+
+
+def _seed_sparse(ctx):
+    """Diff a planted compaction-on-top module against its dense base
+    with the REAL sparse_tick delta contract: the wide-gather delta is
+    +1 where a NEGATIVE delta (a reduction) is required."""
+    from oversim_tpu.analysis import contracts as C
+    from oversim_tpu.analysis import hlo_pass
+
+    delta = C.REGISTRY["sparse_tick"].delta
+    wide = (64, 256)
+    base_m = hlo_pass.measure_entry(_SEED_SPARSE_BASE, 256,
+                                    wide_dims=wide)
+    m = hlo_pass.measure_entry(_SEED_SPARSE_HLO, 256, wide_dims=wide)
+    findings, d = hlo_pass.check_delta("seeded_sparse", delta, base_m, m)
+    return findings, {"entries": {"seeded_sparse": {"delta": d}}}
+
+
 _SEEDS = {"hlo": _seed_hlo, "trace": _seed_trace, "ast": _seed_ast,
-          "compile": _seed_compile, "kernel": _seed_kernel}
+          "compile": _seed_compile, "kernel": _seed_kernel,
+          "sparse": _seed_sparse}
 
 
 # ---------------------------------------------------------------------------
@@ -247,8 +286,8 @@ def main(argv) -> int:
         return 0
 
     if args.seed_breach:
-        # ast + kernel breaches are pure-text — no backend needed
-        if args.seed_breach not in ("ast", "kernel"):
+        # ast + kernel + sparse breaches are pure-text — no backend
+        if args.seed_breach not in ("ast", "kernel", "sparse"):
             _setup_jax()
         findings, summary = _SEEDS[args.seed_breach](None)
         doc = findings_mod.document(

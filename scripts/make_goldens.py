@@ -25,9 +25,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # KEEP IN SYNC: the same -O0 bootstrap lives in tests/conftest.py, __graft_entry__.py and scripts/make_goldens.py
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_backend_optimization_level" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_backend_optimization_level=0"
-        " --xla_llvm_disable_expensive_passes=true").strip()
+    _flags = (_flags + " --xla_backend_optimization_level=0"
+              " --xla_llvm_disable_expensive_passes=true").strip()
+# FMA capped off so golden floats match the suite's replay bit-for-bit
+# regardless of graph structure (see tests/conftest.py)
+if "xla_cpu_max_isa" not in _flags:
+    _flags += " --xla_cpu_max_isa=AVX"
+os.environ["XLA_FLAGS"] = _flags
 import jax
 
 from oversim_tpu.hostcache import cache_dir as _host_cache_dir

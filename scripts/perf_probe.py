@@ -57,11 +57,15 @@ from oversim_tpu import aot  # noqa: E402
 from oversim_tpu import telemetry as telemetry_mod  # noqa: E402
 from oversim_tpu.analysis import contracts as _contracts  # noqa: E402
 
-# AOT pre-warm ($OVERSIM_AOT=1) of the two entries this probe compiles
+# AOT pre-warm of the two entries this probe compiles — default ON in
+# the bench drivers (ROADMAP item 1); OVERSIM_AOT=0 opts out
 aot_rep = aot.warmup(("solo_chunk", "run_until_device"),
                      ctx=_contracts.EntryContext(
                          n=n, overlay=overlay, window=0.05, inbox=4,
-                         pool_factor=4, chunk=chunk))
+                         pool_factor=4, chunk=chunk),
+                     enabled=aot.enabled_by_env(
+                         {"OVERSIM_AOT":
+                          os.environ.get("OVERSIM_AOT", "1")}))
 
 artifact = ArtifactWriter(os.environ.get("OVERSIM_PROBE_ARTIFACT"))
 artifact.set_manifest(telemetry_mod.run_manifest(

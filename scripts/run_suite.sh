@@ -91,6 +91,22 @@ else
   status=1
   echo "FAIL  fused_gate  $(tail -1 "$STATE/fused_gate.log")"
 fi
+# sparse-tick gate (scripts/sparse_gate.py): 64 churned chord ticks
+# under tick_impl="sparse" must be bit-identical to the dense oracle
+# (both inbox impls), and the compiled sparse tick must REPLACE the
+# full-width payload gathers with [A]-lane ones (wide-gather drop >= 1,
+# no new sorts)
+sparse_marker="$STATE/sparse_gate.ok"
+if [ -f "$sparse_marker" ]; then
+  echo "skip  sparse_gate (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/sparse_gate.py > "$STATE/sparse_gate.log" 2>&1; then
+  touch "$sparse_marker"
+  echo "PASS  sparse_gate  $(tail -1 "$STATE/sparse_gate.log")"
+else
+  status=1
+  echo "FAIL  sparse_gate  $(tail -1 "$STATE/sparse_gate.log")"
+fi
 # AOT compile-plane smoke (scripts/aot_smoke.py): the same tiny scenario
 # in TWO processes sharing one artifact store — the second must pre-warm
 # every registered entry from exported artifacts with ZERO fresh

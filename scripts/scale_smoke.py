@@ -50,7 +50,9 @@ def _merge(rows, row):
                     and r.get("overlay") == row.get("overlay")
                     and r.get("platform") == row.get("platform")
                     and r.get("inbox_impl", "scatter")
-                    == row.get("inbox_impl", "scatter"))] + [row]
+                    == row.get("inbox_impl", "scatter")
+                    and r.get("tick_impl", "dense")
+                    == row.get("tick_impl", "dense"))] + [row]
 
 
 def _save_row(row):
@@ -77,9 +79,13 @@ def _setup_jax(platform):
             # runs ~30% faster on these graph shapes
             flags = os.environ.get("XLA_FLAGS", "")
             if "xla_backend_optimization_level" not in flags:
-                os.environ["XLA_FLAGS"] = (
-                    flags + " --xla_backend_optimization_level=0"
-                    " --xla_llvm_disable_expensive_passes=true").strip()
+                flags = (flags + " --xla_backend_optimization_level=0"
+                         " --xla_llvm_disable_expensive_passes=true").strip()
+            # FMA capped off for graph-structure-independent floats
+            # (tests/conftest.py rationale)
+            if "xla_cpu_max_isa" not in flags:
+                flags += " --xla_cpu_max_isa=AVX"
+            os.environ["XLA_FLAGS"] = flags
     sys.modules["zstandard"] = None
     import jax
 
@@ -101,7 +107,7 @@ def _setup_jax(platform):
 
 
 def _build(jax, overlay, n, churn, window, interval=0.2,
-           inbox_impl="scatter"):
+           inbox_impl="scatter", tick_impl="dense"):
     from oversim_tpu import churn as churn_mod
     from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
     from oversim_tpu.common import lookup as lk_mod
@@ -119,17 +125,18 @@ def _build(jax, overlay, n, churn, window, interval=0.2,
         model=churn, target_num=n,
         lifetime_mean=10_000.0, init_interval=10.0 / n)
     ep = sim_mod.EngineParams(window=window, inbox_slots=8, pool_factor=8,
-                              inbox_impl=inbox_impl)
+                              inbox_impl=inbox_impl, tick_impl=tick_impl)
     return sim_mod.Simulation(logic, cp, engine_params=ep), cp
 
 
-def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter"):
+def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter",
+               tick_impl="dense"):
     """Throughput measurement at N: warm, then measured windows — both
     device-resident (run_until_device; one dispatch + one device_get of
     the counter leaves per window, the bench.py round-7 loop)."""
     from bench import _fetch_window_leaves, _summary_from_leaves
     sim, cp = _build(jax, overlay, n, "none", window=0.2,
-                     inbox_impl=inbox_impl)
+                     inbox_impl=inbox_impl, tick_impl=tick_impl)
     dev = jax.devices()[0]
     st = sim.init(seed=7)
     warm_until = cp.init_finished_time + 20.0
@@ -157,6 +164,7 @@ def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter"):
         "mode": "ladder", "overlay": overlay, "n": n,
         "platform": dev.platform,
         "inbox_impl": inbox_impl,
+        "tick_impl": tick_impl,
         "kernel_plane": inbox_impl == "pallas",
         "lookups_per_sec": round(rate, 1),
         "delivered": int(delivered), "sent": int(sent),
@@ -168,10 +176,12 @@ def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter"):
     }
 
 
-def churn_row(jax, overlay, n, t_sim, inbox_impl="scatter"):
+def churn_row(jax, overlay, n, t_sim, inbox_impl="scatter",
+              tick_impl="dense"):
     """LifetimeChurn bounds smoke at N (config #2 envelope)."""
     sim, cp = _build(jax, overlay, n, "lifetime", window=0.2,
-                     interval=60.0, inbox_impl=inbox_impl)
+                     interval=60.0, inbox_impl=inbox_impl,
+                     tick_impl=tick_impl)
     dev = jax.devices()[0]
     t0 = time.time()
     st = sim.init(seed=1)
@@ -195,6 +205,7 @@ def churn_row(jax, overlay, n, t_sim, inbox_impl="scatter"):
         "mode": "churn_smoke", "overlay": overlay, "n": n,
         "platform": dev.platform,
         "inbox_impl": inbox_impl,
+        "tick_impl": tick_impl,
         "kernel_plane": inbox_impl == "pallas",
         "t_sim": out["_t_sim"], "wall_s": round(time.time() - t0, 1),
         "alive": out["_alive"],
@@ -278,6 +289,10 @@ def main():
                     choices=["scatter", "pallas", "sort"],
                     help="inbox implementation (pallas = fused kernel "
                     "plane; falls back to scatter when unavailable)")
+    ap.add_argument("--tick-impl", default="dense",
+                    choices=["dense", "sparse"],
+                    help="tick implementation (sparse = active-set "
+                    "plane; tick cost bounded by traffic, not N)")
     args = ap.parse_args()
 
     if os.environ.get("OVERSIM_SCALE_CHILD") != "1":
@@ -293,6 +308,25 @@ def main():
         jax = _setup_jax(args.platform)
         from oversim_tpu.config import scenario as scenario_mod
         inbox_impl = scenario_mod.resolve_inbox_impl(args.inbox_impl)
+        tick_impl = scenario_mod.resolve_tick_impl(args.tick_impl)
+        # device acquisition under the elastic retry taxonomy — a
+        # tunnel stall at scale-probe time is a transient, not a
+        # run-killer (bench.py wiring, ROADMAP item 1)
+        from oversim_tpu import elastic
+        dev = elastic.with_retry(lambda: jax.devices()[0],
+                                 policy=elastic.RetryPolicy(attempts=3),
+                                 label="scale device acquisition")
+        # AOT pre-warm (default ON, OVERSIM_AOT=0 opts out): both jobs
+        # drive run_until_device, so a prior bench/probe run on the
+        # same config skips trace+lower here entirely
+        from oversim_tpu import aot
+        from oversim_tpu.analysis import contracts as contracts_mod
+        aot_rep = aot.warmup(
+            ("run_until_device",),
+            ctx=contracts_mod.EntryContext(
+                n=args.n, overlay=args.overlay, window=0.2, chunk=64),
+            enabled=aot.enabled_by_env(
+                {"OVERSIM_AOT": os.environ.get("OVERSIM_AOT", "1")}))
         # run manifest — the orchestrator routes this line to the
         # artifact's top-level "manifest" key (telemetry.run_manifest)
         from oversim_tpu import telemetry as telemetry_mod
@@ -302,15 +336,18 @@ def main():
                     "overlay": args.overlay, "t": args.t,
                     "measure": args.measure, "platform": args.platform,
                     "inbox_impl": inbox_impl,
+                    "tick_impl": tick_impl,
                     "kernel_plane": inbox_impl == "pallas"},
             artifacts={"artifact":
-                       os.environ.get("OVERSIM_SCALE_ARTIFACT")}))
+                       os.environ.get("OVERSIM_SCALE_ARTIFACT")},
+            extra={"aot": aot_rep, "device": str(dev)}))
         if args.ladder:
             for n in [int(x) for x in args.ns.split(",") if x]:
                 if _remaining() < 120:
                     break
                 row = ladder_row(jax, args.overlay, n, args.measure,
-                                 inbox_impl=inbox_impl)
+                                 inbox_impl=inbox_impl,
+                                 tick_impl=tick_impl)
                 if row is None:
                     continue
                 _save_row(row)
@@ -318,7 +355,7 @@ def main():
                 _emit({"rows": rows})
         else:
             row = churn_row(jax, args.overlay, args.n, args.t,
-                            inbox_impl=inbox_impl)
+                            inbox_impl=inbox_impl, tick_impl=tick_impl)
             _save_row(row)
             rows = _merge(rows, row)
             _emit({"rows": rows})

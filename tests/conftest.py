@@ -29,6 +29,15 @@ if "xla_backend_optimization_level" not in flags:
     # (SURVEY §4 strategy; VERDICT r3 weak #3)
     flags += (" --xla_backend_optimization_level=0"
               " --xla_llvm_disable_expensive_passes=true")
+# FMA contraction is fusion-context-dependent: the same f32 mul+add can
+# round differently in two differently-structured graphs (observed: the
+# sparse tick diverging from the dense oracle by 1 ULP in Vivaldi
+# coords, PR 16).  Capping the CPU ISA below FMA makes every
+# cross-graph bit-identity pin (dense/sparse, scatter/pallas, telemetry
+# on/off, plain/sharded) exact by construction; at -O0 tiny-N shapes
+# the vector-width cost is noise.
+if "xla_cpu_max_isa" not in flags:
+    flags += " --xla_cpu_max_isa=AVX"
 os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402  (import after env setup)

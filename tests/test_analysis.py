@@ -18,7 +18,7 @@ from oversim_tpu.analysis import ast_pass, findings as findings_mod
 from oversim_tpu.analysis import contracts as contracts_mod
 from oversim_tpu.analysis.hlo_text import (
     collective_census, custom_call_census, donated_leaf_count,
-    dtype_census, host_transfer_count)
+    dtype_census, gather_counts, host_transfer_count)
 
 REPO = Path(__file__).resolve().parent.parent
 
@@ -184,6 +184,26 @@ def test_collective_census_refines_all_reduce():
     assert c == {"all-reduce:min": 1, "all-gather": 1, "all-reduce:add": 1}
 
 
+def test_gather_counts_wide_vs_lane():
+    """The sparse-plane census: a gather is "wide" when its RESULT
+    keeps a full-width leading dim (N or P); [A]-lane gathers and
+    all-gather collectives must not count."""
+    txt = (
+        "HloModule m\n"
+        "ENTRY %main {\n"
+        "  %g1 = f32[256,8,6]{2,1,0} gather(%pool, %idx), offset_dims={1}\n"
+        "  %g2 = s32[2048]{0} gather(%pool, %due)\n"
+        "  %g3 = s64[32]{0} gather(%fields, %act)\n"
+        "  %g4 = pred[32,8]{1,0} gather(%mask, %act)\n"
+        "  %ag = f64[256]{0} all-gather(%y), dimensions={0}\n"
+        "}\n")
+    c = gather_counts(txt, wide_dims=(256, 2048))
+    assert c == {"gather_count": 4, "wide_gather_count": 2}
+    # no wide dims supplied -> everything is lane-width
+    assert gather_counts(txt)["wide_gather_count"] == 0
+    assert gather_counts("")["gather_count"] == 0
+
+
 def test_host_transfer_count():
     txt = ("ENTRY %e {\n"
            "  %t = token[] infeed(%tok)\n"
@@ -251,7 +271,8 @@ def test_registry_shape():
     names = list(contracts_mod.REGISTRY)
     assert names == ["solo_tick", "solo_chunk", "run_until_device",
                      "campaign_tick", "telemetry_tick", "service_window",
-                     "fused_tick", "fused_chunk", "resharded_resume"]
+                     "fused_tick", "fused_chunk", "sparse_tick",
+                     "sparse_chunk", "resharded_resume"]
     tel = contracts_mod.REGISTRY["telemetry_tick"]
     assert tel.delta is not None and tel.delta.base == "solo_tick"
     for donated in ("solo_chunk", "run_until_device", "service_window",
@@ -269,6 +290,17 @@ def test_registry_shape():
     fused = contracts_mod.REGISTRY["fused_tick"]
     assert fused.delta is not None and fused.delta.base == "solo_tick"
     assert fused.delta.max_scatter_delta < 0
+    # sparse active-set entries: donation required, no new sorts or
+    # collectives vs the dense base, and the wide-gather bound is a
+    # REQUIRED reduction (negative) — the whole point of the plane
+    sparse = contracts_mod.REGISTRY["sparse_tick"]
+    assert sparse.contract.require_donation
+    assert sparse.delta is not None and sparse.delta.base == "solo_tick"
+    assert sparse.delta.max_sort_delta == 0
+    assert sparse.delta.max_collective_delta == 0
+    assert sparse.delta.max_wide_gather_delta is not None
+    assert sparse.delta.max_wide_gather_delta < 0
+    assert contracts_mod.REGISTRY["sparse_chunk"].contract.require_donation
 
 
 def test_register_entry_validation():
@@ -374,6 +406,18 @@ def test_seeded_kernel_breach_exits_nonzero(tmp_path):
     assert f["pass"] == "hlo"
     assert f["measured"] == {"rogue_vendor_kernel": 1}
     assert f["limit"] == ["tpu_custom_call"]
+
+
+def test_seeded_sparse_breach_exits_nonzero(tmp_path):
+    """--seed-breach sparse: a planted compaction-on-top module pair
+    diffed with the REAL sparse_tick delta contract — a +1 wide-gather
+    delta where a reduction is required, pure-text, exits non-zero."""
+    rc, doc = _run_seed("sparse", tmp_path)
+    assert rc == 1 and doc["ok"] is False
+    [f] = [f for f in doc["findings"] if f["rule"] == "delta-wide-gathers"]
+    assert f["pass"] == "hlo" and f["measured"] == 1 and f["limit"] == -1
+    d = doc["passes"]["sparse"]["entries"]["seeded_sparse"]["delta"]
+    assert d["wide_gather_delta"] == 1 and d["gather_delta"] == 1
 
 
 def test_seeded_compile_breach_exits_nonzero(tmp_path):

@@ -58,6 +58,15 @@ INI = textwrap.dedent("""
     [Config KadBadInbox]
     extends = Kad
     **.inboxImpl = "bogosort"
+
+    [Config KadSparseTick]
+    extends = Kad
+    **.tickImpl = "sparse"
+    **.activeCap = 16
+
+    [Config KadBadTick]
+    extends = Kad
+    **.tickImpl = "dense_ish"
 """)
 
 
@@ -110,6 +119,30 @@ def test_scenario_inbox_impl_key(ini):
     assert sim.ep.inbox_impl == "sort"
     with pytest.raises(scenario.ScenarioError):
         scenario.build_simulation(ini, "KadBadInbox")
+
+
+def test_scenario_tick_impl_key(ini):
+    """``**.tickImpl`` selects the tick implementation (dense full-N
+    oracle vs sparse active-set plane) and ``**.activeCap`` bounds the
+    compacted lane count; anything but dense/sparse is a config
+    error."""
+    sim = scenario.build_simulation(ini, "Kad")
+    assert sim.ep.tick_impl == "dense"           # oracle default
+    assert sim.ep.active_cap == 0
+    sim = scenario.build_simulation(ini, "KadSparseTick")
+    assert sim.ep.tick_impl == "sparse"
+    assert sim.ep.active_cap == 16
+    with pytest.raises(scenario.ScenarioError):
+        scenario.build_simulation(ini, "KadBadTick")
+
+
+def test_resolve_tick_impl():
+    """No availability dimension here — sparse is pure XLA, so the
+    resolver is a straight validator."""
+    assert scenario.resolve_tick_impl("dense") == "dense"
+    assert scenario.resolve_tick_impl('"sparse"') == "sparse"
+    with pytest.raises(scenario.ScenarioError):
+        scenario.resolve_tick_impl("eager")
 
 
 def test_resolve_inbox_impl_kernel_plane():

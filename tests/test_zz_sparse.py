@@ -1,0 +1,306 @@
+"""Sparse active-set tick tests (engine/sim.py _step_sparse; ISSUE 16).
+
+The dense tick is the bit-identity ORACLE: with the auto active_cap
+(full-N at these sizes) every SimState leaf after 64 churned ticks must
+match the dense engine exactly — chord and kademlia, scatter and fused
+inbox, across active-set occupancy extremes (all-asleep windows, 100%
+awake, R-overflow pressure).  A sub-capacity active_cap is DEFERRAL,
+never loss: those runs are pinned for conservation and liveness, not
+identity.
+
+(Late-alphabet filename on purpose: these are the compile-heaviest
+tests in the suite and tier-1 runs files alphabetically.  Tier-1 keeps
+the scatter identity runs, the deferral-conservation pin and the
+compaction oracles; the remaining occupancy/pallas/window variants are
+marked slow — scripts/sparse_gate.py re-covers both inbox impls'
+identity in every run_suite pass.)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oversim_tpu import churn as churn_mod
+from oversim_tpu import kernels
+from oversim_tpu.apps.kbrtest import KbrTestApp, KbrTestParams
+from oversim_tpu.engine.sim import (
+    ENGINE_COUNTERS, SPARSE_COUNTERS, EngineParams, Simulation)
+
+
+def _sim(overlay, inbox_impl="scatter", tick_impl="dense", active_cap=0,
+         churn="lifetime", interval=None, slots=4, n=12):
+    app = (KbrTestApp(KbrTestParams(test_interval=interval))
+           if interval else None)
+    if overlay == "chord":
+        from oversim_tpu.overlay.chord import ChordLogic
+        logic = ChordLogic(app=app)
+    else:
+        from oversim_tpu.overlay.kademlia import KademliaLogic
+        logic = KademliaLogic(app=app)
+    cp = churn_mod.ChurnParams(model=churn, target_num=n,
+                               init_interval=0.2, lifetime_mean=8.0)
+    ep = EngineParams(window=0.1, inbox_slots=slots, pool_factor=4,
+                      inbox_impl=inbox_impl, tick_impl=tick_impl,
+                      active_cap=active_cap)
+    return Simulation(logic, cp, engine_params=ep)
+
+
+def _strip_sparse(st):
+    """Drop the sparse-only counters so the dense and sparse SimState
+    pytrees become layout-comparable (the dense engine never carries
+    them — sim.counter_names)."""
+    return dataclasses.replace(
+        st, counters={k: v for k, v in st.counters.items()
+                      if k not in SPARSE_COUNTERS})
+
+
+def _assert_tree_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    paths = jax.tree_util.tree_flatten_with_path(a)[0]
+    for (path, _), x, y in zip(paths, la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), \
+            jax.tree_util.keystr(path)
+
+
+def _identity_run(overlay, inbox_impl, n_ticks=64, seed=3, **kw):
+    """64 churned ticks full-step: sparse (auto cap = full-N here) must
+    land on the EXACT dense SimState, bit for bit."""
+    finals = {}
+    for tick_impl in ("dense", "sparse"):
+        sim = _sim(overlay, inbox_impl=inbox_impl, tick_impl=tick_impl,
+                   **kw)
+        s = sim.init(seed=seed)
+        finals[tick_impl] = jax.device_get(sim.run_chunk(s, n_ticks))
+    # counter layout: dense stays pre-sparse, sparse rides its three
+    assert set(finals["dense"].counters) == set(ENGINE_COUNTERS)
+    assert set(finals["sparse"].counters) \
+        == set(ENGINE_COUNTERS + SPARSE_COUNTERS)
+    _assert_tree_equal(finals["dense"], _strip_sparse(finals["sparse"]))
+    assert int(finals["dense"].tick) == n_ticks
+    return finals
+
+
+# -- bit-identity under lifetime churn: overlays x inbox impls --------------
+
+
+def test_sparse_identity_chord_scatter_under_churn():
+    finals = _identity_run("chord", "scatter")
+    assert int(np.sum(finals["dense"].alive)) > 0
+    assert int(np.sum(finals["dense"].pool.valid)) > 0   # traffic ran
+    assert int(finals["sparse"].counters["awake_nodes"]) > 0
+
+
+def test_sparse_identity_kademlia_scatter_under_churn():
+    finals = _identity_run("kademlia", "scatter")
+    assert int(np.sum(finals["dense"].alive)) > 0
+    assert int(finals["sparse"].counters["active_dst"]) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not kernels.available(), reason="pallas unavailable")
+def test_sparse_identity_chord_pallas_under_churn():
+    """The sparse plane composes with the fused kernel inbox: the
+    select-only kernel (kernels.inbox.fused_select) feeds compaction
+    and the final state still matches the dense scatter-fed oracle."""
+    _identity_run("chord", "pallas")
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not kernels.available(), reason="pallas unavailable")
+def test_sparse_identity_kademlia_pallas_under_churn():
+    _identity_run("kademlia", "pallas")
+
+
+# -- occupancy extremes -----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sparse_identity_empty_active_set():
+    """Near-empty windows: joins staggered ~50s out, so only the t=0
+    bootstrap node ever wakes in the first 8 ticks (no messages at
+    all) — and a synthesized all-asleep window compacts to pure
+    sentinel lanes with zero tallies."""
+    finals = {}
+    for tick_impl in ("dense", "sparse"):
+        sim = _sim("chord", tick_impl=tick_impl, churn="none")
+        sim.cp = dataclasses.replace(sim.cp, init_interval=50.0)
+        s = sim.init(seed=11)
+        finals[tick_impl] = jax.device_get(sim.run_chunk(s, 8))
+    _assert_tree_equal(finals["dense"], _strip_sparse(finals["sparse"]))
+    assert int(np.sum(finals["dense"].alive)) == 1     # bootstrap only
+    assert int(finals["sparse"].counters["awake_nodes"]) <= 8
+    assert int(finals["sparse"].counters["active_dst"]) == 0
+
+    # phase-level: a window where NOTHING is due (no inbox traffic, no
+    # churn flips, t_end before any timer) compacts to all-sentinel
+    n = sim.n
+    s0 = sim.init(seed=11)
+    inbox = jnp.full((n, sim.ep.inbox_slots), -1, jnp.int32)
+    dlv0 = jnp.zeros(s0.pool.valid.shape, bool)
+    act, dlv, active = sim._phase_active_compact(
+        s0, jnp.int64(0), s0.alive, jnp.zeros((n,), bool), s0.logic,
+        inbox, dlv0)
+    assert (np.asarray(act) == n).all()                # pure sentinels
+    assert int(active[0]) == 0 and int(active[2]) == 0
+    assert not np.asarray(dlv).any()
+
+
+@pytest.mark.slow
+def test_sparse_identity_full_activity():
+    """100% awake: a KBRTest re-arm interval shorter than the window
+    fires on every READY node every tick — after a 128-tick warm (join
+    + ring stabilization; saturation measured to arrive by tick ~110)
+    the active set IS the alive population every tick, and identity
+    must survive the densest case."""
+    n, warm, meas = 12, 128, 32
+    finals = {}
+    marks = {}
+    for tick_impl in ("dense", "sparse"):
+        sim = _sim("chord", tick_impl=tick_impl, churn="none",
+                   interval=0.05, n=n)
+        s = sim.run_chunk(sim.init(seed=3), warm)
+        if tick_impl == "sparse":
+            marks["warm"] = int(jax.device_get(
+                s.counters["awake_nodes"]))
+        finals[tick_impl] = jax.device_get(sim.run_chunk(s, meas))
+    _assert_tree_equal(finals["dense"], _strip_sparse(finals["sparse"]))
+    alive = int(np.sum(finals["dense"].alive))
+    assert alive == n
+    awake = int(finals["sparse"].counters["awake_nodes"]) - marks["warm"]
+    # saturated steady state: every alive node awake in every measured
+    # tick (one-tick slack for a re-arm landing on a window boundary)
+    assert awake >= alive * (meas - 1)
+    assert awake <= alive * meas
+
+
+@pytest.mark.slow
+def test_sparse_identity_r_overflow_pressure():
+    """inbox_slots=2 under kbr traffic + churn: per-dest R-overflow
+    defers deliveries to later ticks (inbox_deferred > 0) and the
+    deferred pool slots re-enter compaction identically."""
+    finals = _identity_run("chord", "scatter", slots=2, interval=0.2)
+    assert int(finals["dense"].counters["inbox_deferred"]) > 0
+
+
+# -- sub-capacity active_cap: deferral, never loss --------------------------
+
+
+def test_active_cap_defers_but_never_loses():
+    """active_cap=2 on a 12-node kbr run: the cap clips every busy
+    window (active_deferred climbs), but nothing is lost — unserved
+    inbox slots revert to pooled, unserved timers stay due — and the
+    app still makes progress."""
+    sim = _sim("chord", tick_impl="sparse", active_cap=2,
+               churn="none", interval=0.2)
+    assert sim.acap == 2
+    s = sim.init(seed=3)
+    out = jax.device_get(sim.run_chunk(s, 64))
+    assert int(out.counters["active_deferred"]) > 0
+    assert int(out.counters["pool_overflow"]) == 0
+    assert int(out.counters["queue_lost"]) == 0
+    assert int(np.sum(out.alive)) > 0
+    # liveness: deferred work drains — lookups still complete
+    assert int(out.stats["c:kbr_delivered"]) > 0
+
+
+@pytest.mark.slow
+def test_active_cap_at_capacity_is_exact():
+    """cap == n is the auto-cap small-N case spelled explicitly: no
+    deferral, bit-identity to dense."""
+    dense = _sim("chord", churn="none", interval=0.2)
+    sparse = _sim("chord", tick_impl="sparse", active_cap=12,
+                  churn="none", interval=0.2)
+    a = jax.device_get(dense.run_chunk(dense.init(seed=5), 32))
+    b = jax.device_get(sparse.run_chunk(sparse.init(seed=5), 32))
+    assert int(b.counters["active_deferred"]) == 0
+    _assert_tree_equal(a, _strip_sparse(b))
+
+
+# -- compact_indices kernel vs numpy oracle ---------------------------------
+
+
+@pytest.mark.skipif(not kernels.available(), reason="pallas unavailable")
+def test_compact_indices_randomized_oracle():
+    """kernels.outbox.compact_indices == numpy nonzero-compaction:
+    lane k holds the k-th set index, sentinel beyond, and count is the
+    TRUE set-bit total even past cap (the caller's deferral signal)."""
+    rng = np.random.default_rng(23)
+    for trial in range(25):
+        m = int(rng.integers(1, 48))
+        cap = int(rng.integers(1, m + 1))
+        mask = rng.random(m) < rng.random()
+        vals = rng.integers(0, 1000, size=m).astype(np.int32)
+        lanes, count = kernels.outbox.compact_indices(
+            jnp.asarray(mask), jnp.asarray(vals), cap, sentinel=m,
+            interpret=True)
+        want = vals[np.nonzero(mask)[0]]
+        exp = np.full((cap,), m, np.int32)
+        exp[:min(cap, len(want))] = want[:cap]
+        assert (np.asarray(lanes) == exp).all(), trial
+        assert int(count) == int(mask.sum()), trial
+
+
+@pytest.mark.skipif(not kernels.available(), reason="pallas unavailable")
+def test_compact_indices_extremes():
+    mask0 = jnp.zeros((16,), bool)
+    vals = jnp.arange(16, dtype=jnp.int32)
+    lanes, count = kernels.outbox.compact_indices(mask0, vals, 8,
+                                                  sentinel=16,
+                                                  interpret=True)
+    assert (np.asarray(lanes) == 16).all() and int(count) == 0
+    lanes, count = kernels.outbox.compact_indices(~mask0, vals, 8,
+                                                  sentinel=16,
+                                                  interpret=True)
+    assert list(np.asarray(lanes)) == list(range(8))
+    assert int(count) == 16                     # true count past cap
+
+
+# -- the measurement loop stays one-dispatch-one-fetch ----------------------
+
+
+@pytest.mark.slow
+def test_sparse_window_one_dispatch_one_fetch(monkeypatch):
+    """A REAL sparse sim under bench.run_measurement_windows: per
+    window exactly one run_until_device dispatch and one
+    _fetch_window_leaves device_get, with the sparse counters riding
+    inside that single fetch."""
+    import bench
+
+    fetched = []
+    real_fetch = bench._fetch_window_leaves
+    monkeypatch.setattr(bench, "_fetch_window_leaves",
+                        lambda s: fetched.append(real_fetch(s))
+                        or fetched[-1])
+    sim = _sim("chord", tick_impl="sparse", churn="none", interval=0.2)
+    dispatches = []
+    real_run = sim.run_until_device
+
+    def counting_run(s, t_sim, chunk=256):
+        dispatches.append(float(t_sim))
+        return real_run(s, t_sim, chunk=chunk)
+
+    sim.run_until_device = counting_run
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            Clock.t += 10.0
+            return Clock.t - 10.0
+
+    s = sim.init(seed=7)
+    s = real_run(s, 1.0, chunk=8)               # warm outside the pin
+    s, windows = bench.run_measurement_windows(
+        sim, s, start_sim_t=1.0, window_sim_s=0.4, measure_wall=35.0,
+        chunk=4, on_window=lambda out, wall: None, now=Clock())
+    assert windows == 2
+    assert len(dispatches) == 2                 # ONE dispatch per window
+    assert len(fetched) == 2                    # ONE device_get per window
+    for leaves in fetched:
+        assert "awake_nodes" in leaves["counters"]
+        assert "active_deferred" in leaves["counters"]
