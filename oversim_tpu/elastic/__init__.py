@@ -20,16 +20,34 @@ The production-operations counterpart to raw scale (ROADMAP item 5):
     per-shard artifact merge that reproduces the uninterrupted
     single-process ensemble exactly.
 
+  * :mod:`oversim_tpu.elastic.autoscaler` — the closed loop: a
+    hysteresis policy over the fleet's own gauges (backlog, p99
+    latency, liveness) deciding when to grow/shrink the worker set;
+    ``fleet.plan_resize`` + ``fleet.regroup_shard_leaves`` compute the
+    resulting re-split of live replica rows.
+
 See README.md "Elastic fleets" for the user guide.
 """
 
+from oversim_tpu.elastic.autoscaler import (  # noqa: F401
+    SCALE_DOWN,
+    SCALE_UP,
+    AutoscalePolicy,
+    Autoscaler,
+    Decision,
+    Signals,
+    parse_exposition_text,
+    scrape_exposition,
+)
 from oversim_tpu.elastic.fleet import (  # noqa: F401
     chaos_schedule,
     decode_leaves,
     encode_leaves,
     heartbeat_age,
     merge_shard_leaves,
+    plan_resize,
     read_json,
+    regroup_shard_leaves,
     shard_replicas,
     write_heartbeat,
     write_json_atomic,
@@ -44,6 +62,7 @@ from oversim_tpu.elastic.reshard import (  # noqa: F401
 from oversim_tpu.elastic.retry import (  # noqa: F401
     FATAL,
     TRANSIENT,
+    RetryBudgetExceeded,
     RetryPolicy,
     acquire_backend,
     backoff_delays,

@@ -1,0 +1,227 @@
+"""Closed-loop autoscaling: the fleet reads its own gauges and reacts.
+
+PR 9 built a fleet that *survives* failure (reshard-on-resume, chaos
+SIGKILL, seeded backoff) and PR 15 built a fleet that *sees itself*
+(gauges, latency histograms, heartbeat rollups); this module connects
+observation to action.  "Comparing Maintenance Strategies for Overlays"
+(arxiv 0710.0386) makes the same point at the protocol layer — reactive
+strategies that adapt to observed conditions dominate fixed-rate ones
+under dynamic load — and that is what "elastic" must mean for this
+serving stack: reacting to traffic, not just surviving SIGKILL.
+
+The pieces, all host-only (no jax import — the supervisor runs this
+before/without a backend; no obs import — the AST ``obs-import`` rule
+confines the plane to host runners, so gauge publication stays with the
+caller):
+
+  * :class:`AutoscalePolicy` — the hysteresis knobs: scale-up /
+    scale-down backlog thresholds (a DEAD BAND between them, so the
+    loop cannot flap), an optional p99-latency trigger, a cooldown
+    between decisions, and hard min/max worker bounds.
+  * :class:`Signals` — one observation: backlog (outstanding work
+    units — row-ticks for a fleet, queued requests for a service),
+    provisioned workers, optional p99 latency, an ``aligned`` flag the
+    caller clears while a resize would be unsafe, and the caller's
+    monotonic clock reading (injected, so policy math is unit-testable
+    without sleeping).
+  * :class:`Autoscaler` — ``decide(signals)`` returns a
+    :class:`Decision` (or None) and keeps the decision history the
+    supervisor writes to its flight recorder and fleet report.
+  * :func:`scrape_exposition` — minimal OpenMetrics text → {family:
+    value} scraper (hand-rolled: elastic may not import obs), so the
+    supervisor can close the loop on its OWN ``/metrics`` endpoint —
+    the same bytes an external scraper sees — rather than on private
+    supervisor state.
+
+The actual resize (kill → regroup checkpoints → respawn) is the
+supervisor's job: ``fleet.plan_resize`` + ``fleet.regroup_shard_leaves``
+compute the new shard layout and ``scripts/fleet_run.py`` executes it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import urllib.request
+
+SCALE_UP = "scale_up"
+SCALE_DOWN = "scale_down"
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Hysteresis knobs for the scaling loop.
+
+    ``up_backlog_per_worker`` and ``down_backlog_per_worker`` bound a
+    dead band: above the first the fleet is under-provisioned (scale
+    up), below the second it is over-provisioned (scale down), between
+    them nothing happens — the band is what keeps a decision from
+    immediately un-deciding itself after the backlog-per-worker ratio
+    jumps across a single threshold."""
+
+    min_workers: int = 1
+    max_workers: int = 4
+    up_backlog_per_worker: float = 256.0
+    down_backlog_per_worker: float = 64.0
+    p99_up_s: float | None = None     # optional latency trigger (scale up)
+    cooldown_s: float = 5.0           # quiet period after any decision
+    step: int = 1                     # workers added/removed per decision
+
+    def __post_init__(self):
+        if self.min_workers < 1 or self.max_workers < self.min_workers:
+            raise ValueError(
+                f"need 1 <= min_workers <= max_workers, got "
+                f"[{self.min_workers}, {self.max_workers}]")
+        if self.down_backlog_per_worker >= self.up_backlog_per_worker:
+            raise ValueError(
+                "hysteresis band inverted: down threshold "
+                f"{self.down_backlog_per_worker} must be < up threshold "
+                f"{self.up_backlog_per_worker}")
+        if self.step < 1:
+            raise ValueError("step must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class Signals:
+    """One scrape of the fleet's own gauges, ready for ``decide``.
+
+    ``backlog`` is in whatever work unit the caller scales on —
+    outstanding row-ticks for a fleet supervisor, queued requests for a
+    serving tier.  ``now_s`` is the caller's monotonic clock (injected
+    so cooldown math is deterministic in tests).  ``aligned`` is the
+    caller's it-is-safe-to-resize-now flag; while False, decisions are
+    deferred (counted, never silently dropped)."""
+
+    backlog: float
+    workers: int
+    now_s: float
+    p99_s: float | None = None
+    workers_alive: int | None = None
+    aligned: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    action: str            # SCALE_UP | SCALE_DOWN
+    from_workers: int
+    to_workers: int
+    reason: str
+    at_s: float            # caller clock (Signals.now_s)
+    wall: float            # wall stamp for cross-process correlation
+
+    def describe(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Autoscaler:
+    """The decision loop: feed ``decide`` one :class:`Signals` per
+    scrape cadence; it returns a :class:`Decision` when the policy
+    wants a different worker count (and cooldown/alignment permit),
+    else None.  Every decision lands in ``self.history``; deferrals
+    (alignment) and cooldown skips are counted so the supervisor's
+    gauges can show WHY the fleet is not reacting."""
+
+    def __init__(self, policy: AutoscalePolicy | None = None):
+        self.policy = policy or AutoscalePolicy()
+        self.history: list = []
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.deferred = 0          # wanted to act, but not aligned
+        self.cooldown_skips = 0    # wanted to act, but inside cooldown
+        self._last_at: float | None = None
+
+    # ------------------------------------------------------- policy ----
+    def target_for(self, sig: Signals) -> tuple:
+        """Pure threshold logic: ``(target_workers, reason)`` with no
+        cooldown/alignment gating — what the policy WANTS right now."""
+        p = self.policy
+        workers = max(1, sig.workers)
+        per = sig.backlog / workers
+        if sig.p99_s is not None and p.p99_up_s is not None \
+                and sig.p99_s > p.p99_up_s:
+            return (min(sig.workers + p.step, p.max_workers),
+                    f"p99 {sig.p99_s:.3f}s > {p.p99_up_s:.3f}s")
+        if per > p.up_backlog_per_worker:
+            return (min(sig.workers + p.step, p.max_workers),
+                    f"backlog/worker {per:.1f} > "
+                    f"{p.up_backlog_per_worker:.1f}")
+        if per < p.down_backlog_per_worker:
+            return (max(sig.workers - p.step, p.min_workers),
+                    f"backlog/worker {per:.1f} < "
+                    f"{p.down_backlog_per_worker:.1f}")
+        return sig.workers, "in band"
+
+    def decide(self, sig: Signals):  # analysis: allow(wall-clock)
+        """One scrape → at most one :class:`Decision`.
+
+        The wall stamp on the decision is intentional wall-clock (the
+        allow marker): decisions are correlated across processes with
+        heartbeat files and flight events, which are wall-stamped."""
+        target, reason = self.target_for(sig)
+        if target == sig.workers:
+            return None
+        if (self._last_at is not None
+                and sig.now_s - self._last_at < self.policy.cooldown_s):
+            self.cooldown_skips += 1
+            return None
+        if not sig.aligned:
+            self.deferred += 1
+            return None
+        action = SCALE_UP if target > sig.workers else SCALE_DOWN
+        d = Decision(action=action, from_workers=sig.workers,
+                     to_workers=target, reason=reason, at_s=sig.now_s,
+                     wall=time.time())
+        self._last_at = sig.now_s
+        if action == SCALE_UP:
+            self.scale_ups += 1
+        else:
+            self.scale_downs += 1
+        self.history.append(d)
+        return d
+
+    def describe(self) -> dict:
+        """Report-ready summary (fleet_report.json ``autoscale``)."""
+        return {"policy": dataclasses.asdict(self.policy),
+                "decisions": [d.describe() for d in self.history],
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "deferred": self.deferred,
+                "cooldown_skips": self.cooldown_skips}
+
+
+def parse_exposition_text(text: str) -> dict:
+    """Minimal OpenMetrics text parser: ``{family_or_series: value}``.
+
+    A hand-rolled twin of ``obs.metrics.parse_exposition`` — this
+    module may NOT import the obs plane (AST ``obs-import`` rule), and
+    the closed loop should read the same bytes an external scraper
+    reads.  Histogram series keep their suffixed names; plain counter/
+    gauge samples land under the family name."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(None, 1)
+        if len(parts) != 2:
+            continue
+        name, val = parts
+        name = name.split("{", 1)[0]
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def scrape_exposition(url: str, timeout: float = 2.0) -> dict | None:
+    """Scrape ``url`` (an obs ``/metrics`` endpoint) into {family:
+    value}; None on any network error — the autoscaler must keep
+    deciding off its fallback signal source when a scrape fails, not
+    unwind the supervisor."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return parse_exposition_text(
+                resp.read().decode("utf-8", "replace"))
+    except Exception:  # noqa: BLE001 — scrape failure is a soft miss
+        return None

@@ -172,6 +172,98 @@ def decode_leaves(doc):
     return {k: decode_leaves(v) for k, v in doc.items()}
 
 
+# ------------------------------------------------------------- resize --
+
+
+def plan_resize(row_ticks: dict, new_workers: int) -> list:
+    """Re-split live replica rows across a CHANGED worker count.
+
+    ``row_ticks`` maps global replica id → that row's checkpointed
+    ``ticks_done`` (0 for a row never checkpointed).  A shard worker
+    resumes from ONE ``ticks_done``, so rows are first grouped into
+    tick classes (rows sharing a resume point) and each class is then
+    split contiguously; shards are allocated to classes proportionally
+    to class size (largest remainder), every class keeping at least
+    one.  Returns ``[(replica_ids, ticks_done), ...]`` — at least
+    ``len(classes)`` shards even when ``new_workers`` is smaller (rows
+    at different resume points can never share a worker), never more
+    shards than rows.
+
+    This is what makes the autoscaler's resize safe WITHOUT a global
+    barrier: ``run_chunk`` is replica-independent (the fleet
+    determinism contract), so a row's future depends only on
+    (base_seed, id, ticks_done) — not on which worker advances it."""
+    if not row_ticks:
+        raise ValueError("plan_resize needs at least one replica row")
+    if new_workers < 1:
+        raise ValueError("need new_workers >= 1")
+    classes: dict = {}
+    for gid, td in sorted(row_ticks.items()):
+        classes.setdefault(int(td), []).append(int(gid))
+    new_workers = min(new_workers, len(row_ticks))
+    n_classes = len(classes)
+    total = len(row_ticks)
+    # proportional shard allocation, >= 1 per class, largest remainder
+    counts = {td: 1 for td in classes}
+    extra = max(new_workers - n_classes, 0)
+    if extra:
+        quotas = sorted(
+            ((len(ids) * extra / total, td) for td, ids in classes.items()),
+            reverse=True)
+        whole = {td: int(q) for q, td in quotas}
+        left = extra - sum(whole.values())
+        for q, td in quotas:
+            add = 1 if left > 0 and q - whole[td] > 0 else 0
+            counts[td] += whole[td] + add
+            left -= add
+    out = []
+    for td in sorted(classes):
+        ids = classes[td]
+        k = min(counts[td], len(ids))
+        base, rem = divmod(len(ids), k)
+        start = 0
+        for w in range(k):
+            n = base + (1 if w < rem else 0)
+            out.append((tuple(ids[start:start + n]), td))
+            start += n
+    return out
+
+
+def regroup_shard_leaves(old_shards, new_ids) -> list:
+    """Rows for ONE new shard, drawn from the old shards' checkpoint
+    leaves.
+
+    ``old_shards`` — list of ``(replica_ids, leaves_list)`` where
+    ``leaves_list`` holds the shard checkpoint's arrays in flatten
+    order, each with the shard rows on axis 0.  Returns the new shard's
+    leaves (same flatten order, rows in ``new_ids`` order).  Refuses a
+    duplicated or missing global id loudly — a resize bug must not
+    silently mint or lose a replica row."""
+    loc: dict = {}
+    for si, (ids, _) in enumerate(old_shards):
+        for ri, gid in enumerate(ids):
+            if int(gid) in loc:
+                raise ValueError(
+                    f"replica id {gid} appears in more than one shard")
+            loc[int(gid)] = (si, ri)
+    missing = [int(g) for g in new_ids if int(g) not in loc]
+    if missing:
+        raise ValueError(
+            f"replica ids {missing} missing from the old shards")
+    nleaf = {len(lv) for _, lv in old_shards}
+    if len(nleaf) != 1:
+        raise ValueError(
+            f"old shards disagree on leaf count ({sorted(nleaf)})")
+    out = []
+    for j in range(nleaf.pop()):
+        rows = []
+        for gid in new_ids:
+            si, ri = loc[int(gid)]
+            rows.append(np.asarray(old_shards[si][1][j])[ri])
+        out.append(np.stack(rows, axis=0))
+    return out
+
+
 # -------------------------------------------------------------- merge --
 
 

@@ -93,10 +93,41 @@ _FATAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
                 AttributeError, AssertionError, NotImplementedError)
 
 
+class RetryBudgetExceeded(RuntimeError):
+    """The total-wall-clock retry budget ran out mid-storm.
+
+    Raised by :func:`with_retry` when ``policy.max_total_seconds`` would
+    be exceeded by the next backoff sleep — a transient-error storm
+    fails LOUD at a bounded time instead of backing off through the
+    whole attempt schedule.  Carries the full retry ``history``
+    (``[(attempt, delay_s, error), ...]``) and the ``last_error`` so
+    the operator sees every failure that burned the budget, not just
+    the final one."""
+
+    def __init__(self, label: str, elapsed_s: float, budget_s: float,
+                 history: list, last_error: BaseException):
+        self.label = label
+        self.elapsed_s = elapsed_s
+        self.budget_s = budget_s
+        self.history = list(history)
+        self.last_error = last_error
+        lines = "; ".join(f"attempt {a + 1}: {err}"
+                          for a, _d, err in self.history) or "none"
+        super().__init__(
+            f"{label or 'retry'}: total retry budget exceeded "
+            f"({elapsed_s:.1f}s elapsed of {budget_s:.1f}s) — retry "
+            f"history: {lines}; last error: {last_error}")
+
+
 def classify(exc: BaseException) -> str:
     """The failure taxonomy: ``"transient"`` (retry with backoff) or
     ``"fatal"`` (raise immediately).  Unknown errors default to FATAL —
     silently retrying a bug would hide it."""
+    # a blown retry budget only ever wraps a transient storm (fatal
+    # errors raise before any budget check) — callers with their own
+    # degradation path (acquire_backend) treat it like the storm itself
+    if isinstance(exc, RetryBudgetExceeded):
+        return TRANSIENT
     text = f"{type(exc).__name__}: {exc}".lower()
     for marker in _FATAL_MARKERS:
         if marker in text:
@@ -125,6 +156,11 @@ class RetryPolicy:
     max_s: float = 30.0         # delay ceiling (pre-jitter)
     jitter: float = 0.5         # delay *= 1 + uniform(0, jitter)
     seed: int = 0
+    # total-wall-clock deadline across ALL attempts and sleeps; None =
+    # unbounded (the attempt count alone bounds the loop).  When the
+    # next backoff sleep would cross it, with_retry raises
+    # RetryBudgetExceeded with the full retry history attached.
+    max_total_seconds: float | None = None
 
 
 def backoff_delays(policy: RetryPolicy) -> list:
@@ -140,25 +176,36 @@ def backoff_delays(policy: RetryPolicy) -> list:
 
 def with_retry(fn, *, policy: RetryPolicy | None = None,
                classify_fn=classify, on_retry=None, sleep=time.sleep,
-               label: str = ""):
+               clock=time.monotonic, label: str = ""):
     """Call ``fn()`` under the retry policy.
 
     Transient failures sleep the next backoff delay and retry; fatal
     failures (and transient ones past the attempt budget) re-raise.
+    ``policy.max_total_seconds`` additionally bounds the TOTAL wall
+    clock: when the elapsed time plus the next sleep would cross it,
+    :class:`RetryBudgetExceeded` is raised with the retry history
+    attached — a transient storm fails loud at a bounded time.
     ``on_retry(attempt, delay_s, exc)`` observes every retry (the fleet
-    worker logs them into its heartbeat); ``sleep`` is injectable for
-    tests."""
+    worker logs them into its heartbeat); ``sleep`` and ``clock`` are
+    injectable for tests."""
     policy = policy or RetryPolicy()
     delays = backoff_delays(policy)
-    last = None
+    budget = policy.max_total_seconds
+    t0 = clock()
+    history: list = []
     for attempt in range(policy.attempts):
         try:
             return fn()
         except BaseException as exc:  # noqa: BLE001 — classified below
             if classify_fn(exc) != TRANSIENT or attempt >= len(delays):
                 raise
-            last = exc
             delay = delays[attempt]
+            history.append((attempt, delay, repr(exc)))
+            if budget is not None:
+                elapsed = clock() - t0
+                if elapsed + delay > budget:
+                    raise RetryBudgetExceeded(
+                        label, elapsed, budget, history, exc) from exc
             if on_retry is not None:
                 on_retry(attempt, delay, exc)
             else:
@@ -168,7 +215,7 @@ def with_retry(fn, *, policy: RetryPolicy | None = None,
                     % (f"{label}: " if label else "", attempt + 1,
                        policy.attempts, delay, exc))
             sleep(delay)
-    raise last  # pragma: no cover — loop always returns or raises
+    raise AssertionError("unreachable")  # pragma: no cover
 
 
 def _default_probe():
@@ -182,7 +229,8 @@ def _default_probe():
 
 
 def acquire_backend(policy: RetryPolicy | None = None, *, probe=None,
-                    sleep=time.sleep, environ=None) -> dict:
+                    sleep=time.sleep, environ=None,
+                    clock=time.monotonic) -> dict:
     """Acquire a usable jax backend, degrading to CPU when the chip
     keeps failing.
 
@@ -209,7 +257,7 @@ def acquire_backend(policy: RetryPolicy | None = None, *, probe=None,
 
     try:
         platform = with_retry(counted, policy=policy, sleep=sleep,
-                              label="backend acquisition")
+                              clock=clock, label="backend acquisition")
         return {"platform": str(platform), "degraded_to_cpu": False,
                 "attempts": attempts}
     except BaseException as exc:  # noqa: BLE001 — classified below
@@ -225,5 +273,14 @@ def acquire_backend(policy: RetryPolicy | None = None, *, probe=None,
         "elastic.retry: every artifact of this run will carry "
         "degraded_to_cpu=true in its manifest.\n" % (attempts, last)
         + "=" * 70 + "\n")
-    return {"platform": "cpu", "degraded_to_cpu": True,
-            "attempts": attempts, "last_error": str(last)}
+    ann = {"platform": "cpu", "degraded_to_cpu": True,
+           "attempts": attempts, "last_error": str(last)}
+    if isinstance(last, RetryBudgetExceeded):
+        # the storm log rides into the manifest: every error that burned
+        # the budget, not just the final one
+        ann["retry_budget_s"] = last.budget_s
+        ann["retry_elapsed_s"] = round(last.elapsed_s, 3)
+        ann["retry_history"] = [
+            {"attempt": a, "delay_s": round(d, 3), "error": e}
+            for a, d, e in last.history]
+    return ann

@@ -51,6 +51,7 @@ NO_NODE = jnp.int32(-1)
 
 EXT_IN = 150    # real network → gateway node (a=session, b=tag, c=word)
 EXT_OUT = 151   # gateway node → real network (same fields echoed)
+EXT_NACK = 152  # gateway → real network: frame SHED by admission control
 
 _HDR = struct.Struct("!IIII")
 
@@ -83,6 +84,13 @@ class GenericPacketParser:
     def encapsulate(self, sid: int, b: int, c: int) -> bytes:
         """EXT_OUT message fields → wire bytes."""
         return _HDR.pack(EXT_OUT, sid & 0xFFFFFFFF, b & 0xFFFFFFFF,
+                         c & 0xFFFFFFFF)
+
+    def nack(self, sid: int, b: int, c: int) -> bytes:
+        """Explicit shed notice: the frame was received, parsed, and
+        REFUSED by admission control — the peer can retry later instead
+        of waiting on a reply that will never come."""
+        return _HDR.pack(EXT_NACK, sid & 0xFFFFFFFF, b & 0xFFFFFFFF,
                          c & 0xFFFFFFFF)
 
 
@@ -182,7 +190,7 @@ class RealtimeGateway:
                  host: str = "127.0.0.1",
                  stun_server: tuple | None = None,
                  crypto=None, parser: GenericPacketParser | None = None,
-                 tracer=None):
+                 tracer=None, max_rx_backlog: int | None = None):
         self.sim = sim
         self.state = state
         self.gw = gw_slot
@@ -244,6 +252,12 @@ class RealtimeGateway:
         self.rx_batches = 0             # batched pool writes performed
         self.rx_dropped = 0             # malformed/unauthenticated frames
         self.rx_socket_errors = 0       # transient socket-level errors
+        # admission control: once _rx holds this many pending frames,
+        # further well-formed frames are SHED — counted, NACKed back to
+        # the peer, never queued (bounded backlog keeps window latency
+        # from growing without bound under overload).  None = unbounded.
+        self.max_rx_backlog = max_rx_backlog
+        self.rx_shed = 0                # frames refused by admission ctl
         self._warned: set = set()       # one stderr warning per category
 
     # ------------------------------------------------ injection --------
@@ -285,6 +299,24 @@ class RealtimeGateway:
             print(f"oversim-tpu gateway: dropping {category} ({detail});"
                   " counted in rx_dropped/rx_socket_errors, further"
                   " occurrences silent", file=sys.stderr)
+
+    def _shed_frame(self, sid: int, b: int, c: int, transmit) -> None:
+        """Refuse one admitted frame: count it, settle its trace as
+        NACKed, and send the explicit NACK back via ``transmit`` —
+        deterministic shedding, never a silent drop."""
+        self.rx_shed += 1
+        self._rx_warn(
+            "shed frame (admission control)",
+            f"rx backlog at max_rx_backlog={self.max_rx_backlog}")
+        if self.tracer is not None and hasattr(self.tracer, "nack"):
+            self.tracer.nack(sid)
+        payload = self.parser.nack(sid, b, c)
+        if self.crypto is not None:
+            payload = self.crypto.sign_frame(payload)
+        try:
+            transmit(payload)
+        except OSError:
+            pass
 
     def _decode_frame(self, data: bytes, what: str):
         """Verify + parse one frame; None (counted + warned) on ANY
@@ -336,9 +368,15 @@ class RealtimeGateway:
             b, c = parsed
             sid = self._next_session
             self._next_session += 1
-            self._sessions[sid] = ("udp", addr)
             if self.tracer is not None:
                 self.tracer.mint(sid)
+            if (self.max_rx_backlog is not None
+                    and len(self._rx) >= self.max_rx_backlog):
+                # no session entry: a shed frame never gets an EXT_OUT
+                self._shed_frame(
+                    sid, b, c, lambda p: self.udp.sendto(p, addr))
+                continue
+            self._sessions[sid] = ("udp", addr)
             self._rx.append(ExtFrame(a=sid, b=b, c=c))
 
     def _poll_tcp(self):
@@ -395,6 +433,14 @@ class RealtimeGateway:
                     # per-FRAME mint on the per-connection sid: a fresh
                     # request on a kept-alive stream re-opens the trace
                     self.tracer.mint(sid)
+                if (self.max_rx_backlog is not None
+                        and len(self._rx) >= self.max_rx_backlog):
+                    # connection survives — only this frame is refused
+                    self._shed_frame(
+                        sid, b, c,
+                        lambda p, _co=conn: _co.sendall(
+                            len(p).to_bytes(4, "big") + p))
+                    continue
                 self._rx.append(ExtFrame(a=sid, b=b, c=c))
         for sid in dead:
             self._tcp_conns.pop(sid, None)

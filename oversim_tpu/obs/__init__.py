@@ -24,16 +24,17 @@ from oversim_tpu.obs.metrics import (LATENCY_BUCKETS_S, REGISTRY,
                                      WINDOW_BUCKETS, Counter, Gauge,
                                      Histogram, Registry, get_registry,
                                      parse_exposition)
-from oversim_tpu.obs.requests import RequestTracer, SyntheticLoad
+from oversim_tpu.obs.requests import (RampLoad, RequestTracer,
+                                      SyntheticLoad, ramp_profile)
 from oversim_tpu.obs.runtime import RunObserver
-from oversim_tpu.obs.server import DRAINING, READY, ObsServer
+from oversim_tpu.obs.server import DRAINING, OVERLOADED, READY, ObsServer
 from oversim_tpu.obs.xprof import capture as xprof_capture
 from oversim_tpu.obs.xprof import xprof_dir
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "get_registry", "parse_exposition", "LATENCY_BUCKETS_S",
-    "WINDOW_BUCKETS", "ObsServer", "READY", "DRAINING",
-    "FlightRecorder", "RequestTracer", "SyntheticLoad", "RunObserver",
-    "xprof_capture", "xprof_dir",
+    "WINDOW_BUCKETS", "ObsServer", "READY", "DRAINING", "OVERLOADED",
+    "FlightRecorder", "RequestTracer", "SyntheticLoad", "RampLoad",
+    "ramp_profile", "RunObserver", "xprof_capture", "xprof_dir",
 ]

@@ -71,6 +71,9 @@ class RequestTracer:
         self.unmatched = r.counter(
             "oversim_requests_unmatched_total",
             "EXT_OUT drains with no (or an already-settled) trace id")
+        self.nacked = r.counter(
+            "oversim_requests_nacked_total",
+            "minted requests explicitly refused by admission control")
         self.latency_s = r.histogram(
             "oversim_request_latency_seconds",
             "request-to-response wall latency",
@@ -104,6 +107,23 @@ class RequestTracer:
             if windows is not None:
                 self.samples_windows.append(windows)
         return wall_s, windows
+
+    def nack(self, sid, *, window: int | None = None) -> bool:
+        """Close a minted trace as REFUSED (admission control shed).
+
+        A NACKed request counts in ``nacked``, never in the latency
+        histograms — shedding exists precisely so tail latency is not
+        polluted by requests that were never served.  Unknown sid →
+        ``unmatched`` (same contract as :meth:`settle`).  Together the
+        counters satisfy minted == settled + nacked + outstanding."""
+        del window  # symmetry with settle; a refusal has no latency
+        with self._lock:
+            rec = self._open.pop(sid, None)
+        if rec is None:
+            self.unmatched.inc()
+            return False
+        self.nacked.inc()
+        return True
 
     def outstanding(self) -> int:
         with self._lock:
@@ -177,6 +197,71 @@ class SyntheticLoad:
             self.sids.append(
                 self.inner.submit(b=client, c=self.submitted))
             self.submitted += 1
+        return self.inner.before_window(state, target_ns)
+
+    def after_window(self, state):
+        return self.inner.after_window(state)
+
+
+def ramp_profile(clients: int, windows: int) -> list:
+    """Triangular 0→``clients``→0 active-client schedule over
+    ``windows`` boundaries: ramp up over the first half (peaking at
+    ``clients``), back down to exactly 0 by the last window.  Pure and
+    unit-testable — the overload proof in scripts/loadgen.py and the
+    autoscale_smoke gate both ride on this shape."""
+    if clients < 1 or windows < 1:
+        raise ValueError("need clients >= 1 and windows >= 1")
+    up = (windows + 1) // 2
+    down = windows - up
+    out = []
+    for w in range(windows):
+        if w < up:
+            active = round(clients * (w + 1) / up)
+        else:
+            active = round(clients * (windows - 1 - w) / down)
+        out.append(max(0, min(clients, active)))
+    return out
+
+
+class RampLoad:
+    """Ramped synthetic load: 0→N clients→0 over a fixed window count.
+
+    Same ingest-protocol wrapper shape as :class:`SyntheticLoad`, but
+    the number of active clients follows :func:`ramp_profile` — the
+    rising edge drives the backlog across the autoscaler's scale-up
+    threshold (and past the admission bound, forcing sheds), the
+    falling edge brings it back down across scale-down.  Each active
+    client submits ``per_client`` requests per window (``b`` = client
+    id, ``c`` = global serial); every submission is remembered in
+    ``self.sent`` as ``(sid, b, c)`` so the driver can check each
+    answer exactly (the echo app replies ``(b, c + 1)``).  Windows past
+    the profile submit nothing — the drain tail."""
+
+    def __init__(self, inner, *, clients: int = 8, windows: int = 32,
+                 per_client: int = 1):
+        if per_client < 1:
+            raise ValueError("need per_client >= 1")
+        self.inner = inner
+        self.clients = clients
+        self.windows = windows
+        self.per_client = per_client
+        self.profile = ramp_profile(clients, windows)
+        self.window = 0
+        self.submitted = 0
+        self.sent: list = []          # (sid, b, c) in submit order
+
+    @property
+    def responses(self):
+        return self.inner.responses
+
+    def before_window(self, state, target_ns: int):
+        if self.window < len(self.profile):
+            for client in range(self.profile[self.window]):
+                for _ in range(self.per_client):
+                    sid = self.inner.submit(b=client, c=self.submitted)
+                    self.sent.append((sid, client, self.submitted))
+                    self.submitted += 1
+        self.window += 1
         return self.inner.before_window(state, target_ns)
 
     def after_window(self, state):

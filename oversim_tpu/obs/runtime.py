@@ -34,7 +34,7 @@ import time
 
 from oversim_tpu.obs import metrics as metrics_mod
 from oversim_tpu.obs.flight import FlightRecorder
-from oversim_tpu.obs.server import DRAINING, ObsServer
+from oversim_tpu.obs.server import DRAINING, OVERLOADED, READY, ObsServer
 
 # per-window wall cost (dispatch-to-drain), seconds
 WINDOW_WALL_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -93,6 +93,11 @@ class RunObserver:
                                      "checkpoints written")
         self.events = r.counter("oversim_flight_events_total",
                                 "flight-recorder events recorded")
+        # gateway/ingest RX export (attach_rx_source): the host-side
+        # rx_* counters mirrored into the registry as monotone counters
+        self._rx_src = None
+        self._rx_counters: dict = {}
+        self._rx_last: dict = {}
 
     # ------------------------------------------------------ lifecycle --
     def start(self) -> int | None:
@@ -112,6 +117,24 @@ class RunObserver:
         if self.server is not None:
             self.server.set_health(DRAINING)
         self.record("draining")
+
+    def overloaded(self, **fields) -> None:
+        """Flip /healthz ready → overloaded (503): admission control is
+        SHEDDING.  Distinct from draining (the process is staying, load
+        balancers should back off, not deregister); a process already
+        draining keeps that terminal state."""
+        if self.server is None or self.server.health != READY:
+            return
+        self.server.set_health(OVERLOADED)
+        self.record("overloaded", **fields)
+
+    def ready(self, **fields) -> None:
+        """Clear an overload: overloaded → ready.  Draining is terminal
+        and never cleared from here."""
+        if self.server is None or self.server.health != OVERLOADED:
+            return
+        self.server.set_health(READY)
+        self.record("overload_cleared", **fields)
 
     def close(self, *, dump_tail: bool = False) -> None:
         if dump_tail:
@@ -145,10 +168,52 @@ class RunObserver:
             self._last_checkpoint_mono = time.monotonic()
         self.record(kind, **fields)
 
+    def attach_rx_source(self, src) -> None:
+        """Mirror a gateway/ingest's host-side ``rx_*`` counters into
+        the registry so they reach ``/metrics`` (ISSUE 17: today they
+        are counted host-side but invisible to scrapers).  ``src`` is
+        duck-typed — any object carrying integer ``rx_frames`` /
+        ``rx_batches`` / ``rx_dropped`` / ``rx_socket_errors`` /
+        ``rx_shed`` attributes (missing ones are skipped).  Deltas are
+        synced at every ``on_window`` / ``statusz`` scrape."""
+        self._rx_src = src
+        specs = (
+            ("rx_frames", "oversim_gateway_rx_frames_total",
+             "external frames injected into the pool (post-parse)"),
+            ("rx_batches", "oversim_gateway_rx_batches_total",
+             "batched EXT_IN pool writes performed"),
+            ("rx_dropped", "oversim_gateway_rx_dropped_total",
+             "malformed/unauthenticated frames dropped"),
+            ("rx_socket_errors", "oversim_gateway_rx_socket_errors_total",
+             "transient socket-level receive errors"),
+            ("rx_shed", "oversim_gateway_rx_shed_total",
+             "well-formed frames refused by admission control (NACKed)"),
+        )
+        for attr, name, help_ in specs:
+            if hasattr(src, attr):
+                self._rx_counters[attr] = self.registry.counter(name, help_)
+                self._rx_last.setdefault(attr, 0)
+        self.sync_rx()
+
+    def sync_rx(self) -> None:
+        """Push the rx source's counter deltas into the registry
+        (counters are monotone: only positive deltas are applied)."""
+        if self._rx_src is None:
+            return
+        for attr, counter in self._rx_counters.items():
+            val = getattr(self._rx_src, attr, None)
+            if val is None:
+                continue
+            delta = int(val) - self._rx_last[attr]
+            if delta > 0:
+                counter.inc(delta)
+                self._rx_last[attr] = int(val)
+
     def on_window(self, window: int, summary: dict, wall_s: float) -> None:
         """Per-drained-window update off the ALREADY-FETCHED summary —
         chain it from the runner's own on_window callback."""
         self.windows.inc()
+        self.sync_rx()
         if "_ticks" in summary:
             self.ticks.set(summary["_ticks"])
         if "_t_sim" in summary:
@@ -176,6 +241,7 @@ class RunObserver:
 
     def statusz(self) -> dict:
         age = self.checkpoint_age_s()
+        self.sync_rx()
         doc = dict(self._static)
         doc.update(self._last)
         doc["windows_done"] = int(self.windows.value)
@@ -187,5 +253,10 @@ class RunObserver:
             doc["requests"] = {
                 "minted": int(self.tracer.minted.value),
                 "settled": int(self.tracer.settled.value),
+                "nacked": int(getattr(self.tracer, "nacked").value)
+                if hasattr(self.tracer, "nacked") else 0,
                 "outstanding": self.tracer.outstanding()}
+        if self._rx_src is not None:
+            doc["rx"] = {attr: self._rx_last.get(attr, 0)
+                         for attr in self._rx_counters}
         return doc

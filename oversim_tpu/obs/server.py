@@ -4,9 +4,12 @@ One daemon thread serving three read-only endpoints off the process's
 metrics registry (obs/metrics.py):
 
   /metrics   Prometheus/OpenMetrics text exposition
-  /healthz   {"status": "ready"|"draining", ...} — HTTP 200 while
-             ready, 503 once draining (a SIGTERM handler flips it so
-             load balancers stop routing before the process exits)
+  /healthz   {"status": "ready"|"overloaded"|"draining", ...} — HTTP
+             200 while ready, 503 otherwise.  ``draining`` means the
+             process is on its way OUT (a SIGTERM handler flips it so
+             load balancers stop routing before exit); ``overloaded``
+             means it is alive but SHEDDING load (admission control)
+             and will return to ready when the backlog clears
   /statusz   JSON operational snapshot: server info merged with the
              runner-provided ``statusz`` callable (tick, window,
              replica shards, inbox_impl, degraded_to_cpu, checkpoint
@@ -33,6 +36,7 @@ CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
 
 READY = "ready"
 DRAINING = "draining"
+OVERLOADED = "overloaded"
 
 
 class ObsServer:
@@ -115,7 +119,7 @@ class ObsServer:
         return time.monotonic() - self._t0
 
     def set_health(self, state: str) -> None:
-        if state not in (READY, DRAINING):
+        if state not in (READY, DRAINING, OVERLOADED):
             raise ValueError(f"unknown health state {state!r}")
         self.health = state
 

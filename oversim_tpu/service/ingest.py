@@ -41,18 +41,28 @@ class InProcessIngest:
     window that served it.
 
     ``tracer`` is duck-typed (obs.RequestTracer-shaped: ``mint(sid,
-    window=)`` / ``settle(sid, window=)``) so this module never imports
-    the observability plane; ``windows`` counts completed after_window
-    drains and is the window index the tracer latencies are phrased in.
+    window=)`` / ``settle(sid, window=)`` / ``nack(sid, window=)``) so
+    this module never imports the observability plane; ``windows``
+    counts completed after_window drains and is the window index the
+    tracer latencies are phrased in.
+
+    ``max_pending`` is the admission-control bound: once that many
+    submitted frames await injection, further ``submit`` calls are SHED
+    — the sid lands in ``nacked`` (and the tracer's nack counter), the
+    frame never enters the pool, and the caller can tell refusal apart
+    from a reply that merely has not arrived yet.  None = unbounded.
     """
 
     def __init__(self, gw_slot: int = 0, collect_responses: bool = True,
-                 tracer=None):
+                 tracer=None, max_pending: int | None = None):
         self.gw = gw_slot
         self.collect = collect_responses
         self.tracer = tracer
+        self.max_pending = max_pending
         self.windows = 0              # after_window drains completed
         self.responses: dict = {}     # sid -> (b, c)
+        self.nacked: dict = {}        # sid -> (b, c) refused on submit
+        self.rx_shed = 0              # frames refused by admission ctl
         self.num_batches = 0          # batched pool writes performed
         self.num_injected = 0         # frames injected across batches
         self._pending: list = []
@@ -64,10 +74,19 @@ class InProcessIngest:
                dst: int | None = None, key=None) -> int:
         sid = self._next_sid
         self._next_sid += 1
-        self._pending.append(gateway_mod.ExtFrame(
-            a=sid, b=b, c=c, kind=kind, dst=dst, key=key))
         if self.tracer is not None:
             self.tracer.mint(sid, window=self.windows)
+        if (self.max_pending is not None
+                and len(self._pending) >= self.max_pending):
+            # explicit NACK, never a silent drop: every minted request
+            # either settles with a response or lands here
+            self.rx_shed += 1
+            self.nacked[sid] = (b, c)
+            if self.tracer is not None and hasattr(self.tracer, "nack"):
+                self.tracer.nack(sid, window=self.windows)
+            return sid
+        self._pending.append(gateway_mod.ExtFrame(
+            a=sid, b=b, c=c, kind=kind, dst=dst, key=key))
         return sid
 
     def overflow(self) -> int:

@@ -1,0 +1,126 @@
+"""CI smoke for closed-loop autoscaling + admission control
+(autoscale_smoke gate, ISSUE 17).
+
+Two proofs, end to end against REAL processes:
+
+  1. elastic resize — scripts/fleet_run.py with ``--autoscale`` against
+     a deliberately under-provisioned start (1 worker, 4 replicas) and
+     thresholds the fixed backlog trajectory must cross in BOTH
+     directions: the run must record >= 1 SCALE_UP and >= 1 SCALE_DOWN
+     (live re-split + reshard, not respawn-in-place), finish every
+     replica row exactly once, and pass ``--verify`` — the merged
+     ensemble bit-identical to an uninterrupted single-process run.
+  2. overload shed — scripts/loadgen.py ``--ramp`` with a small
+     ``--max-pending`` admission bound: zero lost sessions (every
+     minted EXT_IN settles or carries an explicit NACK), nonzero sheds,
+     the run's own /healthz probe captured 503 "overloaded", its own
+     /metrics showed nonzero oversim_gateway_rx_shed_total, and the
+     settled-latency window p99 stays plateaued (NACKed requests never
+     enter the histogram).
+
+Exit 0 only if both hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+PY = [sys.executable]
+ENV = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+
+# settled requests must answer within this many serving windows even
+# while the admission bound sheds the rest — the "p99 plateaus" claim
+P99_WINDOW_PLATEAU = 4.0
+
+
+def log(msg):
+    print(f"[autoscale_smoke] {msg}", flush=True)
+
+
+def smoke_fleet_autoscale(workdir: Path) -> None:
+    out = workdir / "fleet_autoscale"
+    cmd = PY + [str(ROOT / "scripts" / "fleet_run.py"),
+                "--workers", "1", "--replicas", "4", "--ticks", "160",
+                "--chunk", "16", "--n", "8", "--overlay", "chord",
+                "--autoscale", "--autoscale-min", "1",
+                "--autoscale-max", "2",
+                "--autoscale-up", "300", "--autoscale-down", "150",
+                "--autoscale-cooldown", "1.0",
+                "--autoscale-interval", "0.3",
+                "--verify", "--out", str(out)]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=ENV,
+                       timeout=1200)
+    assert r.returncode == 0, (
+        f"fleet_run --autoscale exited {r.returncode}:\n"
+        f"{r.stdout[-3000:]}\n{r.stderr[-2000:]}")
+    assert "VERIFY OK" in r.stdout, r.stdout[-2000:]
+
+    rep = json.loads((out / "fleet_report.json").read_text())
+    auto = rep["fleet"]["autoscale"]
+    actions = [rz["action"] for rz in auto["resizes"]]
+    assert auto["scale_ups"] >= 1 and "scale_up" in actions, \
+        f"no scale-up recorded: {auto}"
+    assert auto["scale_downs"] >= 1 and "scale_down" in actions, \
+        f"no scale-down recorded: {auto}"
+    assert rep["verify"]["leaves_equal"] and rep["verify"]["summary_equal"]
+    # every replica row lands in exactly one final shard
+    rows = sorted(r for s in rep["fleet"]["final_shards"] for r in s)
+    assert rows == list(range(4)), f"rows lost/duplicated: {rows}"
+    log(f"fleet autoscale: {auto['scale_ups']} up / "
+        f"{auto['scale_downs']} down across {auto['generations']} "
+        f"generations, ensemble exact ({rep['fleet']['wall_s']}s)")
+
+
+def smoke_overload_shed(workdir: Path) -> None:
+    out = workdir / "ramp_report.json"
+    cmd = PY + [str(ROOT / "scripts" / "loadgen.py"),
+                "--ramp", "--clients", "24", "--windows", "12",
+                "--max-pending", "8", "--n", "4",
+                "--metrics-port", "0", "--out", str(out)]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=ENV,
+                       timeout=1200)
+    assert r.returncode == 0, (
+        f"loadgen --ramp exited {r.returncode}:\n"
+        f"{r.stdout[-3000:]}\n{r.stderr[-2000:]}")
+
+    rep = json.loads(out.read_text())
+    assert rep["lost"] == 0, f"lost sessions: {rep['lost']}"
+    assert rep["wrong_payloads"] == 0, rep
+    assert rep["nacked"] > 0 and rep["shed"] > 0, (
+        "admission bound never shed — raise clients or lower "
+        f"max-pending: {rep['nacked']=} {rep['shed']=}")
+    assert rep["submitted"] == rep["answered"] + rep["nacked"], rep
+    probe = rep.get("overload_probe") or {}
+    hz = probe.get("healthz") or {}
+    assert hz.get("code") == 503 and hz.get("status") == "overloaded", \
+        f"self-probe never saw the overloaded state: {probe}"
+    shed_metric = probe.get("metrics_rx_shed")
+    assert isinstance(shed_metric, (int, float)) and shed_metric > 0, \
+        f"oversim_gateway_rx_shed_total not visible on /metrics: {probe}"
+    p99_w = rep["percentiles"]["windows"]["p99"]
+    assert p99_w is not None and p99_w <= P99_WINDOW_PLATEAU, (
+        f"settled-latency p99 did not plateau: {p99_w} windows > "
+        f"{P99_WINDOW_PLATEAU}")
+    log(f"overload shed: {rep['answered']}/{rep['submitted']} answered, "
+        f"{rep['nacked']} NACKed, 0 lost; healthz=503 overloaded, "
+        f"rx_shed={shed_metric:.0f}, settled p99={p99_w} windows")
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="autoscale_smoke_") as td:
+        workdir = Path(td)
+        smoke_fleet_autoscale(workdir)
+        smoke_overload_shed(workdir)
+    log("OK: scale-up+scale-down with exact ensemble AND "
+        "zero-lost-session overload shed both green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

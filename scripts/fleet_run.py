@@ -20,6 +20,14 @@ Usage:
   python scripts/fleet_run.py ... --verify
       # also run the uninterrupted reference in-process and demand
       # exact ensemble equality (exit 2 on divergence)
+  python scripts/fleet_run.py ... --autoscale --autoscale-min 1 \
+      --autoscale-max 4 [--autoscale-up 256] [--autoscale-down 64]
+      # closed loop: the supervisor scrapes its own gauges (backlog
+      # rows, heartbeat liveness, chunk wall latency), applies a
+      # hysteresis policy, and resizes the worker set mid-campaign by
+      # re-splitting the live replica rows (elastic.plan_resize +
+      # regroup_shard_leaves) into a new worker generation — every
+      # decision goes to the flight recorder and oversim_autoscale_*
 
 Determinism contract: workers and the reference BOTH advance by
 ``run_chunk(chunk)`` strides (never ``run_until_device``, whose
@@ -29,8 +37,10 @@ independent of sharding, kills, and resume points.
 """
 
 import argparse
+import dataclasses
 import json
 import os
+import random
 import signal
 import subprocess
 import sys
@@ -52,6 +62,7 @@ def _scenario(args) -> dict:
             "churn": args.churn, "lifetime": args.lifetime,
             "interval": args.interval,
             "engine_window": args.engine_window,
+            "inbox_impl": args.inbox_impl,
             "replicas": args.replicas, "ticks": args.ticks,
             "chunk": args.chunk}
 
@@ -63,7 +74,8 @@ def _build_campaign(scn: dict, replica_ids=None):
     ns = argparse.Namespace(
         overlay=scn["overlay"], n=scn["n"], churn=scn["churn"],
         lifetime=scn["lifetime"], interval=scn["interval"],
-        engine_window=scn["engine_window"], telemetry=0,
+        engine_window=scn["engine_window"],
+        inbox_impl=scn.get("inbox_impl", "scatter"), telemetry=0,
         telemetry_window=256)
     sim = service_run._build_sim(ns)
     p = CampaignParams(
@@ -115,7 +127,8 @@ def _worker_main(spec_path: str) -> int:
     ckpt_path = spec["checkpoint"]
     cfg_hash = telemetry_mod.config_hash(scn)
     policy = RetryPolicy(attempts=spec.get("retry_attempts", 4),
-                         base_s=0.2, seed=widx)
+                         base_s=0.2, seed=widx,
+                         max_total_seconds=spec.get("retry_budget_s"))
 
     # backend bring-up under the retry policy; a persistent transient
     # failure degrades to CPU with a loud manifest annotation
@@ -207,10 +220,11 @@ def _worker_main(spec_path: str) -> int:
 
 
 class _Worker:
-    def __init__(self, idx, spec_path, log_path):
+    def __init__(self, idx, spec_path, log_path, spec=None):
         self.idx = idx
         self.spec_path = spec_path
         self.log_path = log_path
+        self.spec = spec if spec is not None else json.load(open(spec_path))
         self.proc = None
         self.spawned_at = 0.0
         self.respawns = 0
@@ -238,7 +252,26 @@ class _Worker:
         return False
 
 
+def _make_worker(out: Path, scn: dict, args, w: int, ids, gen: int):
+    """Spec file + _Worker for shard ``w`` of generation ``gen``
+    (generation-prefixed paths keep every resize's checkpoints/logs
+    distinct on disk; gen 0 keeps the historical flat names)."""
+    from oversim_tpu.elastic import fleet
+    prefix = f"g{gen}_shard{w}" if gen else f"shard{w}"
+    spec = {"worker": w, "scenario": scn, "replica_ids": list(ids),
+            "ticks": args.ticks, "platform": args.platform or "cpu",
+            "checkpoint": str(out / f"{prefix}.ckpt.npz"),
+            "heartbeat": str(out / f"{prefix}.heartbeat.json"),
+            "artifact": str(out / f"{prefix}.artifact.json")}
+    if args.retry_budget_s is not None:
+        spec["retry_budget_s"] = args.retry_budget_s
+    spec_path = str(out / f"{prefix}.spec.json")
+    fleet.write_json_atomic(spec_path, spec)
+    return _Worker(w, spec_path, str(out / f"{prefix}.log"), spec)
+
+
 def _supervise(args) -> int:
+    from oversim_tpu.elastic import autoscaler as autoscaler_mod
     from oversim_tpu.elastic import fleet
 
     scn = _scenario(args)
@@ -248,16 +281,9 @@ def _supervise(args) -> int:
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
     shards = fleet.shard_replicas(args.replicas, args.workers)
-    workers = []
-    for w, ids in enumerate(shards):
-        spec = {"worker": w, "scenario": scn, "replica_ids": list(ids),
-                "ticks": args.ticks, "platform": args.platform or "cpu",
-                "checkpoint": str(out / f"shard{w}.ckpt.npz"),
-                "heartbeat": str(out / f"shard{w}.heartbeat.json"),
-                "artifact": str(out / f"shard{w}.artifact.json")}
-        spec_path = str(out / f"shard{w}.spec.json")
-        fleet.write_json_atomic(spec_path, spec)
-        workers.append(_Worker(w, spec_path, str(out / f"shard{w}.log")))
+    workers = [_make_worker(out, scn, args, w, ids, 0)
+               for w, ids in enumerate(shards)]
+    finished: list = []            # workers whose artifact says done
 
     # live observability: the supervisor aggregates per-worker heartbeat
     # JSON into fleet-level series each poll; chaos/respawn/hang events
@@ -289,14 +315,46 @@ def _supervise(args) -> int:
         print(json.dumps({"phase": "obs", "metrics_port": obs.start(),
                           "flight": args.flight}), flush=True)
 
-    hb_paths = {w.idx: str(out / f"shard{w.idx}.heartbeat.json")
-                for w in workers}
+    # the closed loop (ISSUE 17): hysteresis policy over the fleet's
+    # own gauges; decisions re-split the live replica rows across a new
+    # worker generation (plan_resize + regroup_shard_leaves) — never a
+    # respawn-in-place
+    autoscaler = None
+    auto_gauges = {}
+    auto_last = {"ups": 0, "downs": 0, "deferred": 0}
+    if args.autoscale:
+        autoscaler = autoscaler_mod.Autoscaler(autoscaler_mod.AutoscalePolicy(
+            min_workers=args.autoscale_min,
+            max_workers=args.autoscale_max,
+            up_backlog_per_worker=args.autoscale_up,
+            down_backlog_per_worker=args.autoscale_down,
+            p99_up_s=args.autoscale_p99_s,
+            cooldown_s=args.autoscale_cooldown))
+        if obs is not None:
+            r = obs.registry
+            auto_gauges = {
+                "target": r.gauge("oversim_autoscale_workers_target",
+                                  "worker count the last decision chose"),
+                "backlog": r.gauge("oversim_autoscale_backlog_rows",
+                                   "outstanding row-ticks across shards"),
+                "per_worker": r.gauge(
+                    "oversim_autoscale_backlog_per_worker",
+                    "backlog rows per provisioned worker"),
+                "ups": r.counter("oversim_autoscale_scale_ups_total",
+                                 "scale-up decisions taken"),
+                "downs": r.counter("oversim_autoscale_scale_downs_total",
+                                   "scale-down decisions taken"),
+                "deferred": r.counter(
+                    "oversim_autoscale_deferred_total",
+                    "decisions deferred (alignment) or cooldown-skipped"),
+            }
 
     def poll_obs():
         if obs is None:
             return
         agg = fleet.aggregate_heartbeats(
-            {idx: fleet.read_json(p) for idx, p in hb_paths.items()})
+            {w.idx: fleet.read_json(w.spec["heartbeat"])
+             for w in workers})
         fleet_gauges["reporting"].set(agg["workers_reporting"])
         fleet_gauges["ticks_done"].set(agg["ticks_done"])
         fleet_gauges["ticks_target"].set(agg["ticks_target"])
@@ -317,19 +375,181 @@ def _supervise(args) -> int:
                    chaos_kills=len(chaos))
 
     t0 = time.monotonic()
+    gen = 0
+    resizes: list = []
+
+    def sweep_done():
+        """Move workers whose artifact says done into ``finished``."""
+        for w in list(workers):
+            art = fleet.read_json(w.spec["artifact"])
+            if art and art.get("done"):
+                w.done = True
+                if w.alive():
+                    w.proc.wait()
+                workers.remove(w)
+                finished.append(w)
+
+    def apply_resize(decision):
+        """Execute one autoscale decision: SIGKILL the live generation,
+        regroup its checkpointed rows into the new shard layout
+        (fleet.plan_resize + fleet.regroup_shard_leaves), spawn the next
+        generation.  Atomic per-chunk checkpoints make the freeze safe:
+        at most the in-flight chunk is redone.  Returns the index of
+        the chaos kill landed DURING the reshard (or None)."""
+        from oversim_tpu import checkpoint as ckpt_mod
+        from oversim_tpu import telemetry as telemetry_mod
+        for w in workers:
+            w.kill()
+        # kill happens-before this sweep, so a shard that finished in
+        # the race window keeps its artifact and leaves the resize set
+        sweep_done()
+        if not workers:
+            return None
+        cfg_hash = telemetry_mod.config_hash(scn)
+        old = []                       # (ids, leaves | None, ticks_done)
+        row_ticks = {}
+        for w in workers:
+            ids = [int(i) for i in w.spec["replica_ids"]]
+            leaves, td = None, 0
+            if os.path.exists(w.spec["checkpoint"]):
+                try:
+                    leaves, meta = ckpt_mod.load_raw(w.spec["checkpoint"])
+                    td = int((meta.get("fleet") or {}).get("ticks_done", 0))
+                except (OSError, ValueError):
+                    leaves, td = None, 0   # torn/foreign: redo from seed
+            old.append((ids, leaves, td))
+            for gid in ids:
+                row_ticks[gid] = td
+        plan = fleet.plan_resize(row_ticks, decision.to_workers)
+        with_leaves = [(ids, lv) for ids, lv, _td in old if lv is not None]
+        new_workers = []
+        for w, (ids, td) in enumerate(plan):
+            wk = _make_worker(out, scn, args, w, ids, gen)
+            if td > 0:
+                # synthesized meta carries exactly what reshard_load
+                # checks (base seed + replica ids) plus the resume point
+                lv = fleet.regroup_shard_leaves(with_leaves, ids)
+                ckpt_mod.save(wk.spec["checkpoint"], lv, meta={
+                    "config_hash": cfg_hash,
+                    "campaign": {"base_seed": scn["seed"],
+                                 "replica_ids": list(ids)},
+                    "fleet": {"ticks_done": td, "worker": w,
+                              "retries": 0, "resize_gen": gen}})
+            # seed the heartbeat with the KNOWN resume point: until the
+            # worker's first own heartbeat (a whole compile away), the
+            # backlog signal must not read "nothing done" — that lie is
+            # exactly what would make the loop flap through generations
+            fleet.write_heartbeat(wk.spec["heartbeat"], worker=w,
+                                  ticks_done=td, ticks=args.ticks,
+                                  retries=0)
+            new_workers.append(wk)
+        for wk in new_workers:
+            wk.spawn()
+        # chaos ∩ resize (ISSUE 17 satellite): SIGKILL one just-spawned
+        # worker of the new generation — a failure DURING the live
+        # reshard; the ordinary respawn path must recover it from the
+        # generation checkpoint it was spawned with
+        resize_kill = None
+        if args.chaos and new_workers:
+            rnd = random.Random(args.chaos_seed + gen)
+            resize_kill = rnd.randrange(len(new_workers))
+            new_workers[resize_kill].kill()
+        workers[:] = new_workers
+        return resize_kill
+
+    def autoscale_tick(now):
+        """One scrape → signals → at most one decision → resize."""
+        nonlocal gen
+        backlog, alive, walls, tds, rows = 0, 0, [], [], 0
+        for w in workers:
+            hb = fleet.read_json(w.spec["heartbeat"]) or {}
+            td = int(hb.get("ticks_done", 0))
+            tds.append(td)
+            rows += len(w.spec["replica_ids"])
+            backlog += (len(w.spec["replica_ids"])
+                        * max(0, args.ticks - td))
+            if w.alive():
+                alive += 1
+            if hb.get("chunk_wall_s") is not None:
+                walls.append(float(hb["chunk_wall_s"]))
+        if auto_gauges:
+            auto_gauges["backlog"].set(backlog)
+            auto_gauges["per_worker"].set(backlog / max(1, len(workers)))
+        # CLOSED loop: when the endpoint is up, decide off the fleet's
+        # own /metrics exposition — the same bytes an external scraper
+        # reads — with the host-side rollup as the fallback signal
+        sig_backlog = float(backlog)
+        via_scrape = False
+        if obs is not None and obs.port:
+            doc = autoscaler_mod.scrape_exposition(
+                f"http://127.0.0.1:{obs.port}/metrics")
+            if doc and "oversim_autoscale_backlog_rows" in doc:
+                sig_backlog = doc["oversim_autoscale_backlog_rows"]
+                via_scrape = True
+        sig = autoscaler_mod.Signals(
+            backlog=sig_backlog, workers=len(workers), now_s=now,
+            p99_s=max(walls) if walls else None, workers_alive=alive)
+        # alignment: defer decisions a resize cannot actually honor.
+        # Rows at different resume points can never share a worker, so
+        # shrinking needs fewer tick classes than workers; growing
+        # needs more unfinished rows than workers.  Without this gate a
+        # blocked scale-down re-decides every cooldown, each no-op
+        # resize killing the very progress that would unblock it.
+        target, _ = autoscaler.target_for(sig)
+        if target > len(workers):
+            achievable = len(workers) < rows
+        elif target < len(workers):
+            achievable = len(set(tds)) < len(workers)
+        else:
+            achievable = True
+        if not achievable:
+            sig = dataclasses.replace(sig, aligned=False)
+        decision = autoscaler.decide(sig)
+        if auto_gauges:
+            for key, attr in (("ups", "scale_ups"),
+                              ("downs", "scale_downs"),
+                              ("deferred", "deferred")):
+                delta = getattr(autoscaler, attr) - auto_last[key]
+                if delta > 0:
+                    auto_gauges[key].inc(delta)
+                    auto_last[key] += delta
+        if decision is None:
+            return
+        print(json.dumps({"phase": "autoscale",
+                          **decision.describe(),
+                          "via_scrape": via_scrape}), flush=True)
+        if obs is not None:
+            obs.record("autoscale_decision", **decision.describe(),
+                       via_scrape=via_scrape)
+        gen += 1
+        resize_kill = apply_resize(decision)
+        resizes.append({"gen": gen, **decision.describe(),
+                        "workers_after": len(workers),
+                        "chaos_kill_during_resize": resize_kill})
+        if auto_gauges:
+            auto_gauges["target"].set(decision.to_workers)
+        if obs is not None:
+            obs.set_static(workers=len(workers))
+            obs.record("autoscale_resize_done", gen=gen,
+                       workers=len(workers),
+                       chaos_kill_during_resize=resize_kill)
+
     for w in workers:
         w.spawn()
     pending_chaos = list(chaos)
     executed_kills = []
     fail = None
+    last_auto = -1e9
     while True:
         now = time.monotonic() - t0
         # seeded chaos kills: SIGKILL scheduled workers that are still
         # running (a finished shard can't be killed — recorded as a
-        # no-op so the report stays honest about delivered chaos)
+        # no-op so the report stays honest about delivered chaos; after
+        # a resize the schedule's index folds onto the live generation)
         while pending_chaos and pending_chaos[0][0] <= now:
             delay, w_idx = pending_chaos.pop(0)
-            landed = workers[w_idx].kill()
+            landed = (workers[w_idx % len(workers)].kill()
+                      if workers else False)
             executed_kills.append({"delay_s": round(delay, 3),
                                    "worker": w_idx, "landed": landed})
             if landed:
@@ -339,16 +559,8 @@ def _supervise(args) -> int:
                 if obs is not None:
                     obs.record("chaos_kill", worker=w_idx,
                                t=round(now, 2))
+        sweep_done()
         for w in workers:
-            if w.done:
-                continue
-            art = fleet.read_json(
-                json.load(open(w.spec_path))["artifact"])
-            if art and art.get("done"):
-                w.done = True
-                if w.alive():
-                    w.proc.wait()
-                continue
             if not w.alive():
                 # died without finishing: reschedule; the respawn
                 # resumes from the shard's latest checkpoint
@@ -363,8 +575,7 @@ def _supervise(args) -> int:
                 w.spawn()
             elif (time.monotonic() - w.spawned_at
                     > args.heartbeat_timeout):
-                spec = json.load(open(w.spec_path))
-                age = fleet.heartbeat_age(spec["heartbeat"])
+                age = fleet.heartbeat_age(w.spec["heartbeat"])
                 if age is not None and age > args.heartbeat_timeout:
                     # hung, not dead: SIGKILL and let the respawn
                     # branch above reschedule it next poll
@@ -379,7 +590,11 @@ def _supervise(args) -> int:
         poll_obs()
         if fail:
             break
-        if all(w.done for w in workers):
+        if autoscaler is not None and workers \
+                and now - last_auto >= args.autoscale_interval:
+            last_auto = now
+            autoscale_tick(now)
+        if not workers:
             break
         if now > args.deadline:
             fail = f"fleet deadline ({args.deadline}s) exceeded"
@@ -402,10 +617,7 @@ def _supervise(args) -> int:
     # (x64 on, cpu flags) so the reference runs the workers' program
     import service_run
     service_run._setup_jax(args.platform or "cpu")
-    arts = []
-    for w in workers:
-        spec = json.load(open(w.spec_path))
-        arts.append(fleet.read_json(spec["artifact"]))
+    arts = [fleet.read_json(w.spec["artifact"]) for w in finished]
     merged = fleet.merge_shard_leaves(
         [(a["replica_ids"], fleet.decode_leaves(a["leaves"]))
          for a in arts],
@@ -418,15 +630,23 @@ def _supervise(args) -> int:
         "kills_requested": args.kills if args.chaos else 0,
         "kills_landed": sum(1 for k in executed_kills if k["landed"]),
         "kill_log": executed_kills,
-        "respawns": {w.idx: w.respawns for w in workers},
-        "worker_retries": {a["worker"]: a["retries"] for a in arts},
+        "respawns": {Path(w.spec_path).stem: w.respawns
+                     for w in finished},
+        "worker_retries": {Path(w.spec_path).stem: a["retries"]
+                           for w, a in zip(finished, arts)},
         "degraded_to_cpu": any(a["elastic"]["degraded_to_cpu"]
                                for a in arts),
     }
+    if autoscaler is not None:
+        elastic_ann["autoscale"] = {**autoscaler.describe(),
+                                    "generations": gen,
+                                    "resizes": resizes}
     report = {
         "summary": summary,
-        "fleet": {"workers": len(workers),
+        "fleet": {"workers": len(finished),
                   "shards": [list(s) for s in shards],
+                  "final_shards": [list(w.spec["replica_ids"])
+                                   for w in finished],
                   "ticks": args.ticks, "chunk": args.chunk,
                   "wall_s": round(time.monotonic() - t0, 2),
                   **elastic_ann},
@@ -455,12 +675,16 @@ def _supervise(args) -> int:
     fleet.write_json_atomic(str(out / "fleet_report.json"), report)
     print(json.dumps({"phase": "fleet_done",
                       "kills_landed": elastic_ann["kills_landed"],
-                      "respawns": sum(w.respawns for w in workers),
+                      "respawns": sum(w.respawns for w in finished),
+                      "scale_ups": (autoscaler.scale_ups
+                                    if autoscaler else 0),
+                      "scale_downs": (autoscaler.scale_downs
+                                      if autoscaler else 0),
                       "wall_s": report["fleet"]["wall_s"]}), flush=True)
     if obs is not None:
         obs.record("fleet_done",
                    kills_landed=elastic_ann["kills_landed"],
-                   respawns=sum(w.respawns for w in workers))
+                   respawns=sum(w.respawns for w in finished))
         obs.close()
     return verdict
 
@@ -484,6 +708,10 @@ def main() -> int:
     ap.add_argument("--lifetime", type=float, default=10_000.0)
     ap.add_argument("--interval", type=float, default=0.2)
     ap.add_argument("--engine-window", type=float, default=0.2)
+    ap.add_argument("--inbox-impl", default="scatter",
+                    choices=["scatter", "pallas", "sort"],
+                    help="inbox implementation shipped to every worker "
+                    "via the scenario (and hashed into checkpoints)")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--out", default="/tmp/oversim_fleet")
     ap.add_argument("--chaos", action="store_true",
@@ -504,6 +732,25 @@ def main() -> int:
     ap.add_argument("--max-respawns", type=int, default=8)
     ap.add_argument("--deadline", type=float, default=900.0)
     ap.add_argument("--poll-s", type=float, default=0.2)
+    ap.add_argument("--autoscale", action="store_true",
+                    help="closed-loop autoscaling: hysteresis policy "
+                    "over the fleet's own gauges grows/shrinks the "
+                    "worker set mid-campaign (live reshard)")
+    ap.add_argument("--autoscale-min", type=int, default=1)
+    ap.add_argument("--autoscale-max", type=int, default=4)
+    ap.add_argument("--autoscale-up", type=float, default=256.0,
+                    help="scale-up threshold: backlog rows per worker")
+    ap.add_argument("--autoscale-down", type=float, default=64.0,
+                    help="scale-down threshold (hysteresis band floor)")
+    ap.add_argument("--autoscale-cooldown", type=float, default=5.0)
+    ap.add_argument("--autoscale-interval", type=float, default=0.5,
+                    help="seconds between autoscaler scrapes")
+    ap.add_argument("--autoscale-p99-s", type=float, default=None,
+                    help="optional latency trigger: scale up when the "
+                    "slowest heartbeat chunk_wall_s exceeds this")
+    ap.add_argument("--retry-budget-s", type=float, default=None,
+                    help="total-wall-clock retry budget per worker "
+                    "(RetryPolicy.max_total_seconds)")
     args = ap.parse_args()
     if args.worker:
         if not args.spec:

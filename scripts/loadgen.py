@@ -15,10 +15,24 @@ the wall-latency histogram with ``vis.write_histogram_svg`` — both
 referenced from the record itself.  ``--metrics-port`` additionally
 serves the live /metrics endpoint while the run is in flight.
 
+Ramp mode (``--ramp``) drives the overload/admission-control proof
+instead of a flat rate: active clients follow the triangular
+0→``--clients``→0 profile (obs.RampLoad) over ``--windows`` windows
+(plus ``--drain-windows`` empty tail windows so in-flight requests
+settle).  With ``--max-pending`` the ingest sheds deterministically at
+the bound — every submission either settles, or is explicitly NACKed;
+the report's ``lost`` field (submitted − answered − nacked) must be 0.
+While sheds are landing, /healthz flips to the ``overloaded`` state and
+the run probes its OWN endpoint once to record the externally visible
+evidence (HTTP 503 + nonzero oversim_gateway_rx_shed_total) in the
+report's ``overload_probe``.
+
 Usage:
   python scripts/loadgen.py --clients 8 --rate 16 --windows 12 \
       [--n 4] [--out /tmp/loadgen.json] [--svg /tmp/loadgen_hist.svg] \
       [--metrics-port 0] [--platform cpu]
+  python scripts/loadgen.py --ramp --clients 24 --windows 12 \
+      --max-pending 8 --metrics-port 0 --out /tmp/ramp.json
 """
 
 import argparse
@@ -55,26 +69,89 @@ def main():
                     help="wall-latency histogram SVG (vis.histogram_svg)")
     ap.add_argument("--metrics-port", type=int, default=None)
     ap.add_argument("--flight", default=None)
+    ap.add_argument("--ramp", action="store_true",
+                    help="triangular 0→clients→0 load profile instead "
+                    "of a flat per-window rate")
+    ap.add_argument("--per-client", type=int, default=1,
+                    help="ramp mode: requests per active client per "
+                    "window")
+    ap.add_argument("--max-pending", type=int, default=None,
+                    help="admission-control bound: shed (NACK) "
+                    "submissions past this many pending frames")
+    ap.add_argument("--drain-windows", type=int, default=4,
+                    help="ramp mode: empty tail windows so in-flight "
+                    "requests settle")
     args = ap.parse_args()
 
     import service_run
     service_run._setup_jax(args.platform)
-    from oversim_tpu.obs import RequestTracer, RunObserver, SyntheticLoad
+    from oversim_tpu.obs import (RampLoad, RequestTracer, RunObserver,
+                                 SyntheticLoad)
     from oversim_tpu.service import ServiceLoop, ServiceParams
     from oversim_tpu.service.ingest import InProcessIngest
 
     sim = service_run._build_echo_sim(args)
     tracer = RequestTracer(keep_samples=True)
-    load = SyntheticLoad(InProcessIngest(gw_slot=0, tracer=tracer),
-                         clients=args.clients, per_window=args.rate,
-                         max_requests=args.max_requests)
+    ingest = InProcessIngest(gw_slot=0, tracer=tracer,
+                             max_pending=args.max_pending)
+    if args.ramp:
+        load = RampLoad(ingest, clients=args.clients,
+                        windows=args.windows, per_client=args.per_client)
+    else:
+        load = SyntheticLoad(ingest, clients=args.clients,
+                             per_window=args.rate,
+                             max_requests=args.max_requests)
     obs = None
     if args.metrics_port is not None or args.flight:
         obs = RunObserver(role="loadgen", port=args.metrics_port,
                           flight_path=args.flight, tracer=tracer)
-        obs.set_static(clients=args.clients, rate=args.rate, n=args.n)
+        obs.set_static(clients=args.clients, rate=args.rate, n=args.n,
+                       ramp=args.ramp, max_pending=args.max_pending)
+        obs.attach_rx_source(ingest)
         print(json.dumps({"phase": "obs", "metrics_port": obs.start(),
                           "flight": args.flight}), flush=True)
+
+    # overload evidence: when a window sheds, flip /healthz to the
+    # overloaded state and (once) probe our OWN endpoint so the report
+    # carries the externally visible proof; clear when sheds stop.
+    probe: dict = {}
+    shed_seen = [0]
+
+    def _probe_overload(port):
+        import urllib.error
+        import urllib.request
+        out = {}
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5)
+            out["healthz"] = {"code": 200}
+        except urllib.error.HTTPError as e:
+            out["healthz"] = {"code": e.code,
+                              "status": json.loads(e.read()).get("status")}
+        except OSError as e:
+            out["healthz"] = {"error": str(e)}
+        try:
+            from oversim_tpu.obs import parse_exposition
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                fams = parse_exposition(r.read().decode())
+            out["metrics_rx_shed"] = fams.get(
+                "oversim_gateway_rx_shed_total")
+        except OSError as e:
+            out["metrics_rx_shed"] = f"error: {e}"
+        return out
+
+    def on_window(window, summary, wall_s):
+        if obs is not None:
+            obs.on_window(window, summary, wall_s)
+        if ingest.rx_shed > shed_seen[0]:
+            shed_seen[0] = ingest.rx_shed
+            if obs is not None:
+                obs.overloaded(shed=ingest.rx_shed, window=window)
+                if not probe and obs.port:
+                    probe.update(_probe_overload(obs.port))
+        elif obs is not None:
+            obs.ready()
 
     t0 = time.perf_counter()
     state = sim.init(seed=args.seed)
@@ -87,16 +164,34 @@ def main():
                                   chunk=args.chunk),
         ingest=load,
         events=obs.loop_event if obs is not None else None,
-        on_window=(obs.on_window if obs is not None else None))
-    loop.run(n_windows=args.windows)
+        on_window=on_window)
+    n_windows = args.windows + (args.drain_windows if args.ramp else 0)
+    loop.run(n_windows=n_windows)
     wall_s = time.perf_counter() - t0
 
-    # response correctness: request i went out as (b=i%clients, c=i)
-    # and the echo app (transform=1) must answer (b, i+1)
-    answered = sum(1 for sid in load.sids if sid in load.responses)
-    wrong = sum(1 for i, sid in enumerate(load.sids)
-                if (resp := load.responses.get(sid)) is not None
-                and resp != (i % args.clients, i + 1))
+    if args.ramp:
+        # every minted request must SETTLE or carry an explicit NACK —
+        # the zero-lost-sessions admission-control contract.  Request
+        # (b=client, c=serial) echoes back (b, serial+1) (transform=1).
+        answered = wrong = nacked = 0
+        for sid, client, serial in load.sent:
+            resp = load.responses.get(sid)
+            if resp is not None:
+                answered += 1
+                if resp != (client, serial + 1):
+                    wrong += 1
+            elif sid in ingest.nacked:
+                nacked += 1
+        lost = load.submitted - answered - nacked
+    else:
+        # response correctness: request i went out as (b=i%clients,
+        # c=i) and the echo app (transform=1) must answer (b, i+1)
+        answered = sum(1 for sid in load.sids if sid in load.responses)
+        wrong = sum(1 for i, sid in enumerate(load.sids)
+                    if (resp := load.responses.get(sid)) is not None
+                    and resp != (i % args.clients, i + 1))
+        nacked = 0
+        lost = load.submitted - answered
 
     table = tracer.table()
     print(table, flush=True)
@@ -108,7 +203,9 @@ def main():
         "kind": "loadgen_report",
         "clients": args.clients, "rate": args.rate,
         "windows": args.windows,
+        "ramp": args.ramp, "max_pending": args.max_pending,
         "submitted": load.submitted, "answered": answered,
+        "nacked": nacked, "shed": ingest.rx_shed, "lost": lost,
         "wrong_payloads": wrong,
         "settled": int(tracer.settled.value),
         "unmatched": int(tracer.unmatched.value),
@@ -120,6 +217,9 @@ def main():
         "wall_s": round(wall_s, 2),
         "svg": args.svg,
     }
+    if args.ramp:
+        report["ramp_profile"] = load.profile
+        report["overload_probe"] = probe or None
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)
@@ -133,8 +233,8 @@ def main():
                       if k != "latency_s_hist"}), flush=True)
     if obs is not None:
         obs.close()
-    if answered < load.submitted or wrong:
-        print(f"loadgen: {load.submitted - answered} unanswered, "
+    if lost or wrong:
+        print(f"loadgen: {lost} lost (neither answered nor NACKed), "
               f"{wrong} wrong payloads", file=sys.stderr)
         return 1
     return 0

@@ -29,7 +29,21 @@ from oversim_tpu.obs.metrics import parse_exposition  # noqa: E402
 
 # metrics whose per-second rate is worth a line (counter families)
 _RATED = ("oversim_windows_total", "oversim_requests_settled_total",
-          "oversim_fleet_ticks_done")
+          "oversim_fleet_ticks_done", "oversim_requests_nacked_total",
+          "oversim_gateway_rx_shed_total")
+
+# autoscale / admission families shown as current values when present
+# (gauges + slow counters — a rate line would round to 0.00/s)
+_LEVELS = ("oversim_autoscale_workers_target",
+           "oversim_autoscale_backlog_rows",
+           "oversim_autoscale_backlog_per_worker",
+           "oversim_autoscale_scale_ups_total",
+           "oversim_autoscale_scale_downs_total",
+           "oversim_autoscale_deferred_total",
+           "oversim_gateway_rx_frames_total",
+           "oversim_gateway_rx_dropped_total",
+           "oversim_gateway_rx_socket_errors_total",
+           "oversim_gateway_rx_shed_total")
 
 
 def _fetch(url: str, timeout: float):
@@ -82,6 +96,7 @@ def render(cur: dict, prev: dict | None) -> str:
         r = st["requests"]
         lines.append(f"{'requests':22s} minted={r.get('minted')} "
                      f"settled={r.get('settled')} "
+                     f"nacked={r.get('nacked')} "
                      f"outstanding={r.get('outstanding')}")
     if isinstance(st.get("fleet"), dict):
         f = st["fleet"]
@@ -91,6 +106,11 @@ def render(cur: dict, prev: dict | None) -> str:
                      f"{f.get('ticks_target')}, retries "
                      f"{f.get('retries')}")
     m = cur.get("metrics") or {}
+    shown = [fam for fam in _LEVELS if fam in m]
+    if shown:
+        lines.append("autoscale/admission:")
+        for fam in shown:
+            lines.append(f"  {fam:40s} {m[fam]:12.0f}")
     if prev and prev.get("metrics") and not prev.get("error"):
         dt = cur["t"] - prev["t"]
         if dt > 0:

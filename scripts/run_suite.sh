@@ -140,4 +140,21 @@ else
   status=1
   echo "FAIL  obs_smoke  $(tail -1 "$STATE/obs_smoke.log")"
 fi
+# autoscale/admission smoke (scripts/autoscale_smoke.py): fleet_run
+# --autoscale must record >= 1 live scale-up AND scale-down (re-split +
+# reshard mid-campaign) with the merged ensemble exactly equal to an
+# uninterrupted run, and loadgen --ramp under a small --max-pending must
+# shed with explicit NACKs (zero lost sessions), flip /healthz to
+# "overloaded", and keep the settled-latency p99 plateaued
+autoscale_marker="$STATE/autoscale_smoke.ok"
+if [ -f "$autoscale_marker" ]; then
+  echo "skip  autoscale_smoke (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/autoscale_smoke.py > "$STATE/autoscale_smoke.log" 2>&1; then
+  touch "$autoscale_marker"
+  echo "PASS  autoscale_smoke  $(tail -1 "$STATE/autoscale_smoke.log")"
+else
+  status=1
+  echo "FAIL  autoscale_smoke  $(tail -1 "$STATE/autoscale_smoke.log")"
+fi
 exit $status

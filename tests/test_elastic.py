@@ -19,15 +19,18 @@ import jax.numpy as jnp
 
 from oversim_tpu import checkpoint as ckpt_mod
 from oversim_tpu.campaign import Campaign, CampaignParams
-from oversim_tpu.elastic import (FATAL, TRANSIENT, RetryPolicy,
-                                 acquire_backend, backoff_delays,
-                                 chaos_schedule, classify, decode_leaves,
-                                 encode_leaves, heartbeat_age,
-                                 merge_shard_leaves, read_json,
+from oversim_tpu.elastic import (FATAL, TRANSIENT, AutoscalePolicy,
+                                 Autoscaler, RetryBudgetExceeded,
+                                 RetryPolicy, Signals, acquire_backend,
+                                 backoff_delays, chaos_schedule,
+                                 classify, decode_leaves, encode_leaves,
+                                 heartbeat_age, merge_shard_leaves,
+                                 parse_exposition_text, plan_resize,
+                                 read_json, regroup_shard_leaves,
                                  replica_fingerprint, reshard_load,
-                                 reshard_stacked, shard_replicas,
-                                 with_retry, write_heartbeat,
-                                 write_json_atomic)
+                                 reshard_stacked, scrape_exposition,
+                                 shard_replicas, with_retry,
+                                 write_heartbeat, write_json_atomic)
 
 
 # -- failure taxonomy --------------------------------------------------------
@@ -400,3 +403,236 @@ def test_heartbeat_files(tmp_path):
     # atomic writer leaves no tmp droppings
     write_json_atomic(str(tmp_path / "a.json"), {"v": 1})
     assert [p.name for p in tmp_path.glob("*.tmp.*")] == []
+
+
+# -- total-wall-clock retry budget (ISSUE 17 satellite) ----------------------
+
+
+def test_with_retry_total_budget_fails_loud():
+    # deterministic time: jitter 0 -> every delay exactly 10s; the fake
+    # clock only advances when the injected sleep runs
+    p = RetryPolicy(attempts=8, base_s=10.0, factor=1.0, jitter=0.0,
+                    seed=0, max_total_seconds=25.0)
+    now = [0.0]
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise TimeoutError("still down")
+
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        with_retry(always_down, policy=p, clock=lambda: now[0],
+                   sleep=lambda d: now.__setitem__(0, now[0] + d),
+                   on_retry=lambda *a: None, label="probe")
+    exc = ei.value
+    # attempts land at t=0/10/20; the sleep after the third would end at
+    # 30s > 25s, so the budget trips there — NOT after all 8 attempts
+    assert len(calls) == 3
+    assert exc.label == "probe"
+    assert exc.budget_s == 25.0 and exc.elapsed_s == 20.0
+    assert [a for a, _d, _e in exc.history] == [0, 1, 2]
+    assert isinstance(exc.last_error, TimeoutError)
+    # the message IS the storm log: every burned attempt, then the budget
+    msg = str(exc)
+    assert "attempt 3" in msg and "TimeoutError" in msg
+    assert "25.0s" in msg
+    # a blown budget re-enters the taxonomy as TRANSIENT so callers with
+    # a degradation path (acquire_backend) treat it like the storm itself
+    assert classify(exc) == TRANSIENT
+
+
+def test_with_retry_budget_generous_falls_through_to_exhaustion():
+    p = RetryPolicy(attempts=3, base_s=0.1, jitter=0.0, seed=0,
+                    max_total_seconds=3600.0)
+    now = [0.0]
+    with pytest.raises(TimeoutError):     # attempt budget, not wall
+        with_retry(lambda: (_ for _ in ()).throw(TimeoutError("down")),
+                   policy=p, clock=lambda: now[0],
+                   sleep=lambda d: now.__setitem__(0, now[0] + d),
+                   on_retry=lambda *a: None)
+    # fatal errors raise before any budget bookkeeping
+    with pytest.raises(ValueError):
+        with_retry(lambda: (_ for _ in ()).throw(ValueError("bug")),
+                   policy=RetryPolicy(max_total_seconds=0.0),
+                   sleep=lambda _d: None)
+
+
+def test_acquire_backend_budget_annotation():
+    # the storm log rides into the degradation annotation -> manifest
+    p = RetryPolicy(attempts=10, base_s=5.0, factor=1.0, jitter=0.0,
+                    seed=0, max_total_seconds=12.0)
+    now = [0.0]
+    env = {}
+    ann = acquire_backend(
+        p, probe=lambda: (_ for _ in ()).throw(
+            RuntimeError("UNAVAILABLE: tunnel down")),
+        clock=lambda: now[0],
+        sleep=lambda d: now.__setitem__(0, now[0] + d), environ=env)
+    assert env == {"JAX_PLATFORMS": "cpu"}
+    assert ann["degraded_to_cpu"] is True and ann["attempts"] == 3
+    assert ann["retry_budget_s"] == 12.0
+    assert ann["retry_elapsed_s"] == 10.0
+    assert [h["attempt"] for h in ann["retry_history"]] == [0, 1, 2]
+    assert all("UNAVAILABLE" in h["error"] for h in ann["retry_history"])
+    assert "budget exceeded" in ann["last_error"]
+
+
+# -- autoscaler policy (ISSUE 17 tentpole) -----------------------------------
+
+
+def test_autoscale_policy_validation():
+    with pytest.raises(ValueError, match="min_workers"):
+        AutoscalePolicy(min_workers=0)
+    with pytest.raises(ValueError, match="min_workers"):
+        AutoscalePolicy(min_workers=3, max_workers=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        AutoscalePolicy(up_backlog_per_worker=10.0,
+                        down_backlog_per_worker=10.0)
+    with pytest.raises(ValueError, match="step"):
+        AutoscalePolicy(step=0)
+
+
+def test_autoscaler_hysteresis_band_and_bounds():
+    a = Autoscaler(AutoscalePolicy(
+        min_workers=1, max_workers=3, up_backlog_per_worker=100.0,
+        down_backlog_per_worker=25.0, cooldown_s=0.0))
+    # inside the dead band: the policy wants nothing
+    assert a.decide(Signals(backlog=50, workers=1, now_s=0.0)) is None
+    d = a.decide(Signals(backlog=150, workers=1, now_s=0.0))
+    assert d.action == "scale_up" and (d.from_workers, d.to_workers) == (1, 2)
+    assert "backlog/worker 150.0 > 100.0" == d.reason
+    # max bound clamps even under unbounded backlog
+    assert a.target_for(
+        Signals(backlog=1e9, workers=3, now_s=1.0))[0] == 3
+    d2 = a.decide(Signals(backlog=10, workers=2, now_s=1.0))
+    assert d2.action == "scale_down" and d2.to_workers == 1
+    # min bound clamps; thresholds are strict (per == down stays put)
+    assert a.target_for(Signals(backlog=0, workers=1, now_s=2.0))[0] == 1
+    assert a.target_for(Signals(backlog=25, workers=1, now_s=2.0))[0] == 1
+    assert a.scale_ups == 1 and a.scale_downs == 1
+    assert len(a.history) == 2
+
+
+def test_autoscaler_cooldown_and_alignment_deferral():
+    a = Autoscaler(AutoscalePolicy(
+        max_workers=4, up_backlog_per_worker=100.0,
+        down_backlog_per_worker=25.0, cooldown_s=10.0))
+    assert a.decide(Signals(backlog=500, workers=1, now_s=0.0)) is not None
+    # wants to act again, but inside the cooldown: counted, not taken
+    assert a.decide(Signals(backlog=500, workers=2, now_s=3.0)) is None
+    assert a.cooldown_skips == 1
+    # cooldown elapsed but the caller is mid-resize (not aligned):
+    # deferred, and the deferral must NOT burn the cooldown clock
+    assert a.decide(Signals(backlog=5000, workers=2, now_s=11.0,
+                            aligned=False)) is None
+    assert a.deferred == 1
+    d = a.decide(Signals(backlog=5000, workers=2, now_s=12.0))
+    assert d is not None and d.action == "scale_up"
+    # an in-band signal is a no-op, never a skip/deferral
+    assert a.decide(Signals(backlog=225, workers=3, now_s=30.0)) is None
+    assert a.cooldown_skips == 1 and a.deferred == 1
+    desc = a.describe()
+    assert desc["scale_ups"] == 2 and desc["scale_downs"] == 0
+    assert len(desc["decisions"]) == 2
+    assert desc["policy"]["cooldown_s"] == 10.0
+
+
+def test_autoscaler_p99_latency_trigger():
+    a = Autoscaler(AutoscalePolicy(
+        p99_up_s=1.0, up_backlog_per_worker=100.0,
+        down_backlog_per_worker=25.0, max_workers=4))
+    # p99 above the trigger scales up even with the backlog in band
+    t, reason = a.target_for(
+        Signals(backlog=50, workers=2, now_s=0.0, p99_s=2.5))
+    assert t == 3 and "p99" in reason
+    # no latency sample -> backlog rules alone
+    assert a.target_for(Signals(backlog=50, workers=2, now_s=0.0))[0] == 2
+
+
+def test_parse_exposition_text_and_scrape_soft_failure():
+    text = ("# HELP oversim_autoscale_backlog_rows outstanding\n"
+            "# TYPE oversim_autoscale_backlog_rows gauge\n"
+            "oversim_autoscale_backlog_rows 640\n"
+            'oversim_window_wall_seconds_bucket{le="0.1"} 3\n'
+            "garbage line without a number\n")
+    fam = parse_exposition_text(text)
+    assert fam["oversim_autoscale_backlog_rows"] == 640.0
+    # labeled series collapse to the family/series name
+    assert fam["oversim_window_wall_seconds_bucket"] == 3.0
+    assert "garbage" not in str(sorted(fam))
+    # a dead endpoint is a soft miss (None), never an exception — the
+    # supervisor keeps deciding off its host-side fallback signal
+    assert scrape_exposition("http://127.0.0.1:9/metrics",
+                             timeout=0.2) is None
+
+
+# -- live resize planning (ISSUE 17 tentpole) --------------------------------
+
+
+def test_plan_resize_grow_shrink_and_classes():
+    # uniform resume point: a plain contiguous proportional split
+    assert plan_resize({0: 32, 1: 32, 2: 32, 3: 32}, 2) == \
+        [((0, 1), 32), ((2, 3), 32)]
+    assert plan_resize({0: 0, 1: 0, 2: 0, 3: 0}, 1) == [((0, 1, 2, 3), 0)]
+    # mixed resume points can NEVER share a worker: shrinking to 1 still
+    # yields one shard per tick class (the supervisor's achievability
+    # gate defers the decision instead of forcing this)
+    plan = plan_resize({0: 10, 1: 10, 2: 0, 3: 0}, 1)
+    assert sorted(plan) == [((0, 1), 10), ((2, 3), 0)]
+    # growing splits the biggest class first (largest remainder)
+    plan = plan_resize({0: 10, 1: 10, 2: 0, 3: 0}, 3)
+    assert len(plan) == 3
+    rows = sorted(r for ids, _ in plan for r in ids)
+    assert rows == [0, 1, 2, 3]           # every row exactly once
+    # never more shards than rows; degenerate inputs refuse loudly
+    assert len(plan_resize({0: 0, 1: 0}, 5)) == 2
+    with pytest.raises(ValueError):
+        plan_resize({}, 2)
+    with pytest.raises(ValueError):
+        plan_resize({0: 0}, 0)
+
+
+def test_plan_resize_rows_conserved_property():
+    # conservation across a messy mix of classes and worker counts
+    row_ticks = {0: 64, 1: 64, 2: 64, 3: 32, 4: 32, 5: 0, 6: 64, 7: 32}
+    for new_workers in range(1, 9):
+        plan = plan_resize(row_ticks, new_workers)
+        rows = sorted(r for ids, _ in plan for r in ids)
+        assert rows == sorted(row_ticks), (new_workers, plan)
+        for ids, td in plan:
+            assert {row_ticks[r] for r in ids} == {td}
+
+
+def test_regroup_shard_leaves_identity_and_refusals():
+    # two old shards, two leaves each, rows tagged by global id
+    def leaves(ids):
+        return [np.asarray([[gid, gid + 0.5] for gid in ids], np.float32),
+                np.asarray(ids, np.int64) * 10]
+
+    old = [((0, 1), leaves((0, 1))), ((2, 3), leaves((2, 3)))]
+    # regroup to a different split: rows follow their global ids
+    out = regroup_shard_leaves(old, (1, 2))
+    np.testing.assert_array_equal(
+        out[0], np.asarray([[1, 1.5], [2, 2.5]], np.float32))
+    np.testing.assert_array_equal(out[1], np.asarray([10, 20]))
+    # regroup-then-merge equals the original merge (no row invented or
+    # lost by the resize path); merge runs per leaf — a shard
+    # checkpoint's leaves are positional, not a pytree
+    re0, re1 = regroup_shard_leaves(old, (0, 1, 2)), \
+        regroup_shard_leaves(old, (3,))
+    for j in range(2):
+        merged = merge_shard_leaves(
+            [((0, 1, 2), re0[j]), ((3,), re1[j])], total=4)
+        ref = merge_shard_leaves(
+            [(ids, lv[j]) for ids, lv in old], total=4)
+        np.testing.assert_array_equal(merged, ref)
+    # loud refusals: duplicated id, missing id, leaf-count disagreement
+    with pytest.raises(ValueError, match="more than one shard"):
+        regroup_shard_leaves(
+            [((0, 1), leaves((0, 1))), ((1, 2), leaves((1, 2)))], (0,))
+    with pytest.raises(ValueError, match="missing"):
+        regroup_shard_leaves(old, (0, 7))
+    with pytest.raises(ValueError, match="leaf count"):
+        regroup_shard_leaves(
+            [((0, 1), leaves((0, 1))), ((2, 3), leaves((2, 3))[:1])],
+            (0, 2))

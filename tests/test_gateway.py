@@ -371,3 +371,87 @@ def test_rx_batching_one_pool_write(tmp_path):
     finally:
         client.close()
         gw.close()
+
+
+# ------------------------------------- admission control (ISSUE 17) --
+
+from oversim_tpu.gateway import EXT_NACK  # noqa: E402
+
+
+class _NackTracer:
+    """Tracer double recording mint/nack calls (duck-typed, the
+    gateway takes any object with these methods)."""
+
+    def __init__(self):
+        self.minted = []
+        self.nacks = []
+
+    def mint(self, sid, **kw):
+        self.minted.append(sid)
+
+    def nack(self, sid, **kw):
+        self.nacks.append(sid)
+        return True
+
+
+def test_udp_admission_bound_sheds_with_nack():
+    """Frames past max_rx_backlog are refused with an explicit NACK
+    datagram back to the sender — counted in rx_shed, traced as nacked,
+    never a session entry — while admitted frames are untouched."""
+    tr = _NackTracer()
+    gw = RealtimeGateway(None, None, max_rx_backlog=2, tracer=tr)
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    client.settimeout(3.0)
+    try:
+        for i in range(3):
+            client.sendto(_HDR.pack(EXT_IN, 0, i, 100 + i),
+                          ("127.0.0.1", gw.udp_port))
+        assert _poll_until(gw, lambda: gw.rx_shed == 1)
+        # exactly the first two admitted, in arrival order
+        assert [(f.b, f.c) for f in gw._rx] == [(0, 100), (1, 101)]
+        # the shed frame got a NACK with ITS OWN identity echoed back
+        kind, sid, b, c = _HDR.unpack(client.recv(65536))
+        assert kind == EXT_NACK and (b, c) == (2, 102)
+        # minted-then-NACKed: the trace closes explicitly (the
+        # zero-lost-sessions identity), and no session entry exists
+        assert sid in tr.minted and tr.nacks == [sid]
+        assert sid not in gw._sessions
+        # backlog drained -> the next frame is admitted again
+        gw._rx.clear()
+        client.sendto(_HDR.pack(EXT_IN, 0, 7, 700),
+                      ("127.0.0.1", gw.udp_port))
+        assert _poll_until(gw, lambda: len(gw._rx) == 1)
+        assert gw.rx_shed == 1
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_tcp_admission_shed_keeps_connection():
+    """A shed TCP frame answers a length-prefixed NACK on the SAME
+    connection and the stream survives — only the one frame is
+    refused."""
+    gw = RealtimeGateway(None, None, tcp_port=0, max_rx_backlog=1)
+    client = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    client.settimeout(3.0)
+    try:
+        client.connect(("127.0.0.1", gw.tcp_port))
+        for i in range(2):
+            frame = _HDR.pack(EXT_IN, 0, i, 200 + i)
+            client.sendall(len(frame).to_bytes(4, "big") + frame)
+        assert _poll_until(gw, lambda: gw.rx_shed == 1)
+        assert [(f.b, f.c) for f in gw._rx] == [(0, 200)]
+        # the NACK arrives length-prefixed on the same stream
+        ln = int.from_bytes(client.recv(4), "big")
+        kind, _sid, b, c = _HDR.unpack(client.recv(ln))
+        assert kind == EXT_NACK and (b, c) == (1, 201)
+        # the connection is still serviced: drain, then send another
+        assert len(gw._tcp_conns) == 1
+        gw._rx.clear()
+        frame = _HDR.pack(EXT_IN, 0, 9, 900)
+        client.sendall(len(frame).to_bytes(4, "big") + frame)
+        assert _poll_until(gw, lambda: len(gw._rx) == 1)
+        assert (gw._rx[0].b, gw._rx[0].c) == (9, 900)
+    finally:
+        client.close()
+        gw.close()

@@ -28,7 +28,9 @@ from oversim_tpu.obs.metrics import (
     format_value,
     parse_exposition,
 )
-from oversim_tpu.obs.requests import RequestTracer, SyntheticLoad, percentile
+from oversim_tpu.obs.requests import (RampLoad, RequestTracer,
+                                      SyntheticLoad, percentile,
+                                      ramp_profile)
 from oversim_tpu.obs.runtime import RunObserver
 from oversim_tpu.obs.server import DRAINING, READY, ObsServer
 
@@ -426,7 +428,7 @@ def test_run_observer_endpoint_and_draining(tmp_path):
         _, body = _get(base + "/statusz")
         doc = json.loads(body)
         assert doc["requests"] == {"minted": 1, "settled": 1,
-                                   "outstanding": 0}
+                                   "nacked": 0, "outstanding": 0}
         obs.draining()
         with pytest.raises(urllib.error.HTTPError) as ei:
             _get(base + "/healthz")
@@ -515,3 +517,132 @@ def test_obs_import_rule_exempts_obs_package():
     assert obs_rels, "obs package must be scanned"
     assert all("obs-import" not in targets[r] for r in obs_rels)
     assert "obs-import" in targets["oversim_tpu/engine/sim.py"]
+
+
+# ------------------------------------- admission control (ISSUE 17) --
+
+
+def test_tracer_nack_closes_without_latency():
+    t = [0.0]
+    tr = RequestTracer(Registry(), keep_samples=True,
+                       clock=lambda: t[0])
+    tr.mint("s1", window=0)
+    tr.mint("s2", window=0)
+    t[0] = 5.0
+    assert tr.nack("s1", window=1) is True
+    # the refusal closed the trace but NEVER entered the histograms
+    assert tr.nacked.value == 1 and tr.settled.value == 0
+    assert tr.samples_wall_s == [] and tr.outstanding() == 1
+    # a NACKed sid cannot settle later (same contract as double drain)
+    assert tr.settle("s1", window=2) is None
+    assert tr.unmatched.value == 1
+    # unknown sid -> unmatched, not a crash
+    assert tr.nack("ghost") is False
+    assert tr.unmatched.value == 2
+    # the accounting identity the smoke gate asserts:
+    # minted == settled + nacked + outstanding
+    assert tr.minted.value == tr.settled.value + tr.nacked.value \
+        + tr.outstanding()
+
+
+def test_ramp_profile_shape():
+    # even window count: symmetric triangle ending at exactly 0
+    assert ramp_profile(4, 8) == [1, 2, 3, 4, 3, 2, 1, 0]
+    prof = ramp_profile(24, 12)
+    assert max(prof) == 24 and prof[-1] == 0
+    assert all(0 <= a <= 24 for a in prof)
+    # rises to the peak then never rises again
+    peak = prof.index(24)
+    assert prof[:peak + 1] == sorted(prof[:peak + 1])
+    assert prof[peak:] == sorted(prof[peak:], reverse=True)
+    # odd window count still peaks at clients and lands on 0
+    prof = ramp_profile(4, 5)
+    assert max(prof) == 4 and prof[-1] == 0
+    with pytest.raises(ValueError):
+        ramp_profile(0, 8)
+    with pytest.raises(ValueError):
+        ramp_profile(4, 0)
+
+
+def test_ramp_load_follows_profile_and_remembers_sends():
+    inner = _FakeIngest()
+    load = RampLoad(inner, clients=2, windows=4, per_client=2)
+    assert load.profile == ramp_profile(2, 4)      # [1, 2, 1, 0]
+    for _ in range(6):                             # 4 profile + 2 drain
+        load.before_window("st", 10)
+    load.after_window("st")
+    # per window: per_client submissions per active client (client-major
+    # order), b = client id, c = global serial; drain windows submit
+    # nothing
+    assert inner.submits == [(0, 0), (0, 1),
+                             (0, 2), (0, 3), (1, 4), (1, 5),
+                             (0, 6), (0, 7)]
+    assert load.submitted == 8
+    assert [(b, c) for _sid, b, c in load.sent] == inner.submits
+    assert (inner.before, inner.after) == (6, 1)
+    assert load.responses is inner.responses
+    with pytest.raises(ValueError):
+        RampLoad(inner, per_client=0)
+
+
+def test_run_observer_overloaded_transitions(tmp_path):
+    obs = RunObserver(role="test", registry=Registry(), port=0)
+    port = obs.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # ready -> overloaded: healthz serves 503 with the distinct state
+        obs.overloaded(shed=3)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["status"] == "overloaded"
+        # overloaded -> ready clears it
+        obs.ready()
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert json.loads(r.read())["status"] == "ready"
+        # draining is terminal: neither overloaded() nor ready() move it
+        obs.draining()
+        obs.overloaded()
+        obs.ready()
+        assert obs.server.health == DRAINING
+    finally:
+        obs.close()
+    # endpointless observer: the flips are harmless no-ops
+    quiet = RunObserver(role="test", registry=Registry())
+    quiet.overloaded()
+    quiet.ready()
+    quiet.close()
+
+
+class _FakeRxSource:
+    """Gateway/ingest double: bare integer rx_* counters (no rx_batches
+    — attach must skip families the source does not carry)."""
+
+    def __init__(self):
+        self.rx_frames = 0
+        self.rx_dropped = 0
+        self.rx_shed = 0
+
+
+def test_attach_rx_source_exports_and_deltas():
+    r = Registry()
+    obs = RunObserver(role="test", registry=r)
+    src = _FakeRxSource()
+    src.rx_frames, src.rx_shed = 5, 2
+    obs.attach_rx_source(src)
+    text = r.render()
+    # present attrs exported (initial values synced), absent ones skipped
+    assert "oversim_gateway_rx_frames_total 5" in text
+    assert "oversim_gateway_rx_shed_total 2" in text
+    assert "oversim_gateway_rx_dropped_total 0" in text
+    assert "oversim_gateway_rx_batches_total" not in text
+    # deltas flow through on_window's sync; counters stay monotone
+    src.rx_frames, src.rx_shed = 9, 3
+    obs.on_window(0, {}, 0.1)
+    fam = parse_exposition(r.render())
+    assert fam["oversim_gateway_rx_frames_total"] == 9.0
+    assert fam["oversim_gateway_rx_shed_total"] == 3.0
+    # statusz carries the raw source snapshot
+    assert obs.statusz()["rx"] == {"rx_frames": 9, "rx_dropped": 0,
+                                   "rx_shed": 3}
+    obs.close()

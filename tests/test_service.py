@@ -468,3 +468,48 @@ def test_build_service_defaults_and_validation():
         build_service(IniFile.loads("**.service.chunk = 0\n"))
     with pytest.raises(ScenarioError, match="checkpointPath"):
         build_service(IniFile.loads("**.service.checkpointEvery = 4\n"))
+
+
+def test_in_process_ingest_max_pending_sheds_with_nack():
+    """Admission control on the in-process queue (ISSUE 17): past
+    ``max_pending`` waiting frames, submit() mints the sid but refuses
+    the frame — ``nacked`` + ``rx_shed``, tracer.nack — so every minted
+    request either settles or carries an explicit NACK.  Injection
+    drains the queue and re-opens admission."""
+
+    class Tr:
+        def __init__(self):
+            self.minted, self.nacks, self.settled = [], [], []
+
+        def mint(self, sid, **kw):
+            self.minted.append(sid)
+
+        def settle(self, sid, **kw):
+            self.settled.append(sid)
+
+        def nack(self, sid, **kw):
+            self.nacks.append(sid)
+            return True
+
+    tr = Tr()
+    ing = InProcessIngest(gw_slot=0, tracer=tr, max_pending=2)
+    s1 = ing.submit(b=1, c=100)
+    s2 = ing.submit(b=2, c=200)
+    s3 = ing.submit(b=3, c=300)          # bound hit: shed
+    assert ing.rx_shed == 1 and ing.nacked == {s3: (3, 300)}
+    assert tr.minted == [s1, s2, s3] and tr.nacks == [s3]
+
+    st = _pool_state()
+    st = ing.before_window(st, target_ns=5000)
+    # ONLY the admitted frames entered the pool
+    assert ing.num_injected == 2
+    valid = np.asarray(st.pool.valid)
+    assert sorted(np.asarray(st.pool.a)[valid]) == sorted([s1, s2])
+    # the queue drained: admission is open again
+    s4 = ing.submit(b=4, c=400)
+    assert s4 not in ing.nacked and ing.rx_shed == 1
+    # unbounded ingest never sheds
+    free = InProcessIngest(gw_slot=0)
+    for i in range(64):
+        free.submit(b=i, c=i)
+    assert free.rx_shed == 0 and free.nacked == {}

@@ -146,3 +146,44 @@ def test_fleet_shards_equal_full_campaign_rows(tmp_path):
         merged, {"stats": ref.stats, "counters": ref.counters,
                  "tick": ref.tick},
         "shard merge == full campaign")
+
+
+def test_autoscale_resize_survives_chaos_sigkill(tmp_path):
+    """ISSUE 17: a SIGKILL landing DURING a live autoscale reshard must
+    not lose or duplicate replica rows.  fleet_run --autoscale --chaos
+    kills one freshly-spawned worker of every resize generation (plus
+    the scheduled mid-run kills); the supervisor must converge through
+    ordinary respawn-from-checkpoint and the merged ensemble must stay
+    bit-identical to an uninterrupted single-process run."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    out = tmp_path / "fleet_autoscale_chaos"
+    cmd = [sys.executable, str(root / "scripts" / "fleet_run.py"),
+           "--workers", "1", "--replicas", "4", "--ticks", "160",
+           "--chunk", "16", "--n", "8", "--overlay", "chord",
+           "--autoscale", "--autoscale-min", "1", "--autoscale-max", "2",
+           "--autoscale-up", "300", "--autoscale-down", "150",
+           "--autoscale-cooldown", "1.0", "--autoscale-interval", "0.3",
+           "--chaos", "--kills", "2", "--chaos-span", "20",
+           "--verify", "--out", str(out)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (
+        f"fleet_run exited {r.returncode}:\n{r.stdout[-3000:]}\n"
+        f"{r.stderr[-2000:]}")
+    assert "VERIFY OK" in r.stdout, r.stdout[-2000:]
+
+    rep = json.loads((out / "fleet_report.json").read_text())
+    auto = rep["fleet"]["autoscale"]
+    assert auto["generations"] >= 1, "no resize ever happened"
+    # chaos mode SIGKILLs one new worker of every resize generation:
+    # the kill landed mid-reshard and the fleet still converged
+    assert any(rz["chaos_kill_during_resize"] is not None
+               for rz in auto["resizes"]), auto["resizes"]
+    # no lost or duplicated replica rows across the resize generations
+    rows = sorted(r for s in rep["fleet"]["final_shards"] for r in s)
+    assert rows == [0, 1, 2, 3], f"rows lost/duplicated: {rows}"
+    assert rep["verify"]["leaves_equal"] and rep["verify"]["summary_equal"]
