@@ -659,6 +659,13 @@ def child_main():
     tick_impl = scenario_mod.resolve_tick_impl(
         os.environ.get("OVERSIM_BENCH_TICK_IMPL", "dense"))
     active_cap = int(os.environ.get("OVERSIM_BENCH_ACTIVE_CAP", "0"))
+    # OVERSIM_BENCH_NODE_SHARDS=K: shard the node axis over K devices
+    # (2D replica x node mesh, parallel/mesh.py make_mesh_2d).  0/1 =
+    # replicated node axis (the pre-2D behavior).  Placement refuses
+    # loudly when K does not divide N and the pool, or when fewer than
+    # K devices exist — a silently-replicated "sharded" run would
+    # poison the ladder.
+    node_shards = int(os.environ.get("OVERSIM_BENCH_NODE_SHARDS", "0"))
     from oversim_tpu import telemetry as telemetry_mod
     ep = sim_mod.EngineParams(window=window, inbox_slots=inbox,
                               pool_factor=pool_f,
@@ -681,6 +688,23 @@ def child_main():
     # sharded when S divides the device count.  The campaign run loop is
     # device-resident only (no host-synced invariant tier).
     replicas = int(os.environ.get("OVERSIM_BENCH_REPLICAS", "0"))
+
+    # Mesh layout string ("RxK") for the manifest and every artifact
+    # row — ladder rows from different mesh shapes must never silently
+    # merge (scripts/scale_smoke.py keys its cache on this too).
+    if node_shards > 1:
+        _avail = len(jax.devices())
+        if replicas >= 1:
+            _r_fit = max(_avail // node_shards, 1)
+            r_dev = max(d for d in range(1, min(_r_fit, replicas) + 1)
+                        if replicas % d == 0)
+            mesh_layout = "%dx%d" % (r_dev, node_shards)
+        else:
+            r_dev = 1
+            mesh_layout = "1x%d" % node_shards
+    else:
+        r_dev = 0
+        mesh_layout = None
 
     # AOT pre-warm: deserialize-or-export the entry this run will
     # compile, so a second process on the same config skips trace+lower
@@ -720,6 +744,7 @@ def child_main():
                        tick_impl=tick_impl,
                        replicas=int(os.environ.get(
                            "OVERSIM_BENCH_REPLICAS", "0")),
+                       node_shards=node_shards,
                        degraded_to_cpu=on_cpu)
         obs.start()
         obs.record("aot", enabled=aot_rep.get("enabled"),
@@ -737,7 +762,8 @@ def child_main():
                 "chunk": chunk, "slots": slots,
                 "telemetry_sample_ticks": tel_ticks,
                 "telemetry_window": tel_window,
-                "replicas": os.environ.get("OVERSIM_BENCH_REPLICAS", "0")},
+                "replicas": os.environ.get("OVERSIM_BENCH_REPLICAS", "0"),
+                "node_shards": node_shards, "mesh": mesh_layout},
         artifacts={"artifact": os.environ.get("OVERSIM_BENCH_ARTIFACT"),
                    "trace": trace_path,
                    "metrics_port": obs.port if obs is not None else None,
@@ -777,20 +803,38 @@ def child_main():
     if camp is None:
         s = sim.init(seed=7)
         runner = sim
+        if node_shards > 1:
+            # 2D (1 x K) placement: GSPMD partitions the node axis of
+            # pool/logic leaves; the run loop is unchanged.  shard_state_2d
+            # raises when K does not divide N / the pool or devices are
+            # short — fail the run rather than measure a replicated mesh.
+            from oversim_tpu.parallel import mesh as mesh_mod
+            mesh2d = mesh_mod.make_mesh_2d(1, node_shards)
+            s = mesh_mod.shard_state_2d(s, mesh2d)
+            sys.stderr.write("bench: node axis sharded over %d device(s) "
+                             "(mesh %s)\n" % (node_shards, mesh_layout))
     else:
         s = camp.init()
         runner = camp
-        # shard over the LARGEST device count that divides S (even
-        # split keeps the replica axis collective-free)
-        avail = len(jax.devices())
-        n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
-                    if camp.s % d == 0)
-        if n_dev > 1:
-            from oversim_tpu.parallel import mesh as mesh_mod
-            mesh = mesh_mod.make_replica_mesh(n_dev)
-            s = mesh_mod.shard_campaign_state(s, mesh)
-        sys.stderr.write("bench: campaign S=%d over %d device(s)\n"
-                         % (camp.s, n_dev))
+        from oversim_tpu.parallel import mesh as mesh_mod
+        if node_shards > 1:
+            # 2D (R x K) placement: replica axis over the largest
+            # divisor of S that still fits, node axis over K
+            mesh2d = mesh_mod.make_mesh_2d(r_dev, node_shards)
+            s = mesh_mod.shard_campaign_state_2d(s, mesh2d)
+            sys.stderr.write("bench: campaign S=%d on mesh %s\n"
+                             % (camp.s, mesh_layout))
+        else:
+            # shard over the LARGEST device count that divides S (even
+            # split keeps the replica axis collective-free)
+            avail = len(jax.devices())
+            n_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                        if camp.s % d == 0)
+            if n_dev > 1:
+                mesh = mesh_mod.make_replica_mesh(n_dev)
+                s = mesh_mod.shard_campaign_state(s, mesh)
+            sys.stderr.write("bench: campaign S=%d over %d device(s)\n"
+                             % (camp.s, n_dev))
     if host_loop:
         s = sim.run_until(s, warm_until, chunk=chunk, check_invariants=True)
     else:
@@ -847,6 +891,8 @@ def child_main():
         extra = {"delivery": round(delivery, 4),
                  "inbox_impl": inbox_impl,
                  "tick_impl": tick_impl,
+                 "node_shards": node_shards,
+                 "mesh": mesh_layout,
                  "measured_utc": time.strftime(
                      "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
         if camp is not None:

@@ -357,6 +357,65 @@ def _build_sparse_chunk(ctx):
               "tick_impl": "sparse", "active_cap": cap})
 
 
+def _node_shard_extent(n: int, p: int, avail: int) -> int:
+    """Largest node-shard count ≤ avail dividing BOTH n and the pool."""
+    return max(d for d in range(1, avail + 1) if n % d == 0 and p % d == 0)
+
+
+def _build_sharded_tick(ctx):
+    """The genuinely node-sharded tick (parallel/shard_tick.py): K-way
+    shard_map over the (1, K) 2-D mesh, every cross-shard exchange a
+    hand-written min-gather — the compiled step's collective census is
+    ``all-reduce:min`` and nothing else, with zero sorts (the sort
+    path's all-to-all merge exchange never enters the graph)."""
+    import jax
+    from oversim_tpu.parallel import mesh as mesh_mod
+    from oversim_tpu.parallel.shard_tick import ShardedSim
+
+    sim = build_sim(ctx)
+    k = _node_shard_extent(ctx.n, sim.ep.pool_factor * ctx.n,
+                           len(jax.devices()))
+    mesh = mesh_mod.make_mesh_2d(1, k)
+    ssim = ShardedSim(sim, mesh)
+    fn = jax.jit(ssim.step, in_shardings=(ssim.shardings,),
+                 out_shardings=ssim.shardings, donate_argnums=(0,))
+    return EntryBuild(
+        fn=fn, make_args=lambda: (ssim.place(sim.init(seed=7)),),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay, "node_shards": k,
+              "mesh": [1, k]})
+
+
+def _build_sharded_campaign_tick(ctx):
+    """S stacked replicas × K node shards on one (R, K) 2-D mesh: the
+    campaign axis composed with node sharding.  Same allowlist as
+    ``sharded_tick`` — and since every pmin names NODE_AXIS only, the
+    replica groups span node subgroups: cross-replica traffic stays
+    structurally zero (scripts/shard_gate.py pins the replica_groups)."""
+    import jax
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.parallel import mesh as mesh_mod
+    from oversim_tpu.parallel.shard_tick import ShardedCampaign
+
+    sim = build_sim(ctx)
+    camp = Campaign(sim, CampaignParams(replicas=ctx.replicas, base_seed=7))
+    avail = len(jax.devices())
+    r_dev = max(d for d in range(1, min(avail, camp.s) + 1)
+                if camp.s % d == 0)
+    k = _node_shard_extent(ctx.n, sim.ep.pool_factor * ctx.n,
+                           avail // r_dev)
+    mesh = mesh_mod.make_mesh_2d(r_dev, k)
+    scamp = ShardedCampaign(camp, mesh)
+    fn = jax.jit(scamp.vstep, in_shardings=(scamp.shardings,),
+                 out_shardings=scamp.shardings, donate_argnums=(0,))
+    return EntryBuild(
+        fn=fn, make_args=lambda: (scamp.place(camp.init()),),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay,
+              "replicas": ctx.replicas, "node_shards": k,
+              "mesh": [r_dev, k]})
+
+
 def _build_service_window(ctx):
     import jax.numpy as jnp
     from oversim_tpu.engine.sim import NS
@@ -471,6 +530,31 @@ DEFAULT_ENTRIES = (
         contract=GraphContract(require_donation=True,
                                max_scatters=DEFAULT_MAX_SCATTERS + 128),
         build=_build_sparse_chunk),
+    EntryPoint(
+        name="sharded_tick",
+        doc="node-sharded tick on the (1, K) 2-D mesh (shard_map, "
+            "parallel/shard_tick.py): donation required and the "
+            "collective allowlist is all-reduce:min ONLY — no "
+            "all-to-all, no all-gather of pool payloads, zero sorts "
+            "(bit-identity vs the solo oracle is pinned by "
+            "tests/test_mesh.py and scripts/shard_gate.py)",
+        contract=GraphContract(
+            require_donation=True,
+            allowed_collectives=frozenset({"all-reduce:min"}),
+            max_scatters=DEFAULT_MAX_SCATTERS + 64),
+        build=_build_sharded_tick),
+    EntryPoint(
+        name="sharded_campaign_tick",
+        doc="S replicas × K node shards on the (R, K) 2-D mesh: the "
+            "same all-reduce:min-only allowlist; every collective "
+            "names the node axis only, so replica groups span node "
+            "subgroups — zero cross-replica collectives stays pinned "
+            "(replica_groups structure checked by shard_gate.py)",
+        contract=GraphContract(
+            require_donation=True,
+            allowed_collectives=frozenset({"all-reduce:min"}),
+            max_scatters=DEFAULT_MAX_SCATTERS + 64),
+        build=_build_sharded_campaign_tick),
     EntryPoint(
         name="resharded_resume",
         doc="campaign tick on a state reshard-restored from a "

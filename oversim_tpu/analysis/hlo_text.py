@@ -123,10 +123,15 @@ def collective_census(txt: str) -> dict:
     Returns a ``{token: count}`` dict where ``token`` is the collective
     opcode (``"all-gather"``, ``"all-to-all"``, ...) or, for all-reduce,
     ``"all-reduce:min"`` / ``"all-reduce:add"`` / ... when the
-    ``to_apply=`` computation name reveals the combiner — the contract
-    language for "all-reduce-min-only sharded ticks".  Unrecognizable
-    combiners stay plain ``"all-reduce"``.
+    ``to_apply=`` computation reveals the combiner — the contract
+    language for "all-reduce-min-only sharded ticks".  The combiner is
+    read from the computation NAME when it carries one (``%min_s64``)
+    and otherwise resolved from the computation BODY: compiler-named
+    regions (``%region_1.7``) say nothing, but their root op
+    (``minimum``/``add``/...) does.  Unrecognizable combiners stay
+    plain ``"all-reduce"``.
     """
+    body_comb = _combiner_by_region(txt)
     out = collections.Counter()
     for ln in txt.splitlines():
         for op in _COLLECTIVE_OPS:
@@ -142,8 +147,42 @@ def collective_census(txt: str) -> dict:
                             if comb in name:
                                 token = f"all-reduce:{comb}"
                                 break
+                        else:
+                            comb = body_comb.get(m.group(1))
+                            if comb:
+                                token = f"all-reduce:{comb}"
                 out[token] += 1
     return dict(out)
+
+
+_ROOT_COMBINERS = (("minimum(", "min"), ("maximum(", "max"),
+                   ("add(", "add"), ("multiply(", "mul"),
+                   ("and(", "and"), ("or(", "or"))
+
+
+def _combiner_by_region(txt: str) -> dict:
+    """Map computation name -> combiner token, resolved from each
+    computation's ROOT op.  Covers compiler-generated region names
+    (``%region_1.7``) whose names carry no combiner hint."""
+    out = {}
+    name = None
+    for ln in txt.splitlines():
+        m = re.match(r"%([\w.\-]+)\s*\([^)]*\)\s*->\s*[^{]+{", ln)
+        if m:
+            name = m.group(1)
+            continue
+        if name is None:
+            continue
+        if ln.strip().startswith("}"):
+            name = None
+            continue
+        if "ROOT " in ln:
+            for needle, comb in _ROOT_COMBINERS:
+                if needle in ln:
+                    out[name] = comb
+                    break
+            name = None
+    return out
 
 
 _CUSTOM_TARGET = re.compile(r'custom_call_target="([^"]+)"')

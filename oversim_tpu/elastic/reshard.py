@@ -200,7 +200,25 @@ def _best_divisor(extent: int, n_devices: int) -> int:
     return 1
 
 
-def place_campaign(cs, n_devices: int | None = None):
+def _check_node_shards(n: int, p: int, node_shards: int, avail: int):
+    """Refuse LOUDLY when a requested 2-D restore cannot hold: the node
+    extents must divide evenly and the devices must exist — silently
+    degrading a requested K-way mesh to 1-way would hide a capacity
+    regression from the fleet controller."""
+    if node_shards < 1:
+        raise ValueError(f"node_shards={node_shards} must be >= 1")
+    if n % node_shards or p % node_shards:
+        raise ValueError(
+            f"reshard placement mismatch: n={n} / pool={p} not "
+            f"divisible by the requested node_shards={node_shards}")
+    if node_shards > avail:
+        raise ValueError(
+            f"reshard placement mismatch: node_shards={node_shards} "
+            f"exceeds the {avail} available devices")
+
+
+def place_campaign(cs, n_devices: int | None = None,
+                   node_shards: int | None = None):
     """Re-establish replica-axis placement over the mesh available NOW.
 
     Builds a REPLICA_AXIS mesh over the largest available device count
@@ -208,21 +226,46 @@ def place_campaign(cs, n_devices: int | None = None):
     degenerating to 1 — fully replicated placement — for prime
     mismatches) and ``device_put``s the state onto it.  Layout only:
     values are bit-identical before and after.  Returns
-    ``(state, mesh)`` so the caller can jit with matching shardings."""
+    ``(state, mesh)`` so the caller can jit with matching shardings.
+
+    ``node_shards`` — restore onto the 2-D ``(replica, node)`` mesh
+    instead, K-way node-sharded (parallel/mesh.py 2-D layout, the
+    shard_tick plane's placement).  Requested explicitly, it REFUSES
+    rather than degrades: N (and the pool) must divide evenly by K and
+    replica_extent × K devices must exist."""
     leaves = jax.tree.leaves(cs)
     s = _leading_extent(leaves, "state")
     avail = len(jax.devices()) if n_devices is None else n_devices
+    if node_shards is not None:
+        # np.shape yields static python ints — no device sync
+        n = np.shape(cs.alive)[1]
+        p = np.shape(cs.pool.valid)[1]
+        _check_node_shards(n, p, node_shards, avail)
+        r = _best_divisor(s, avail // node_shards)
+        mesh = mesh_mod.make_mesh_2d(r, node_shards)
+        return mesh_mod.shard_campaign_state_2d(cs, mesh), mesh
     mesh = mesh_mod.make_replica_mesh(_best_divisor(s, avail))
     return mesh_mod.shard_campaign_state(cs, mesh), mesh
 
 
-def place_solo(state, n_devices: int | None = None):  # analysis: allow(device-sync)
+def place_solo(state, n_devices: int | None = None,
+               node_shards: int | None = None):  # analysis: allow(device-sync)
     """Node-axis analogue of :func:`place_campaign` for solo SimState:
     NODE_AXIS mesh over the largest device count dividing N, state
     placed with ``parallel/mesh.py`` ``state_shardings`` (telemetry
     rings replicated as usual).  Returns ``(state, mesh)``.  The int()
-    here reads a static SHAPE, not a device value — no sync."""
+    here reads a static SHAPE, not a device value — no sync.
+
+    ``node_shards`` — restore onto the 2-D ``(1, K)`` mesh with the
+    shard_tick plane's explicit layout (pool + logic node leaves
+    sharded, full-width rng planes replicated) instead of the 1-D
+    GSPMD placement; refuses loudly on indivisible extents."""
     n = int(np.shape(state.alive)[0])
     avail = len(jax.devices()) if n_devices is None else n_devices
+    if node_shards is not None:
+        p = int(np.shape(state.pool.valid)[0])
+        _check_node_shards(n, p, node_shards, avail)
+        mesh = mesh_mod.make_mesh_2d(1, node_shards)
+        return mesh_mod.shard_state_2d(state, mesh), mesh
     mesh = mesh_mod.make_mesh(_best_divisor(n, avail))
     return mesh_mod.shard_state(state, mesh), mesh

@@ -205,32 +205,46 @@ def build_inbox_sort(pool: MsgPool, n: int, r: int, t_end, alive,
 
 
 def build_inbox_scatter(pool: MsgPool, n: int, r: int, t_end, alive,
-                        hold=None):
+                        hold=None, *, axis_name=None, base=0, p_total=None):
     """Zero-sort inbox grouping: R rounds of deterministic scatter-min.
 
     Round k scatter-mins t_deliver over the destination axis to find each
     row's earliest remaining due message, then scatter-mins the POOL INDEX
     over the messages matching that minimum — reproducing the stable
     sort's exact (t_deliver, idx) tie-break — and masks the winners out.
-    O(R·P) work, 2R small [P]→[N] scatters, no full-pool sort; under
-    GSPMD the scatter-min partitions into a local select + all-reduce-min
-    (parallel/mesh.py), replacing the distributed sort's merge exchange.
+    O(R·P) work, 2R small [P]→[N] scatters, no full-pool sort.
     Bit-identical to :func:`build_inbox_sort` (pinned by the identity
     tests in tests/test_engine.py).
+
+    Under explicit node sharding (parallel/shard_tick.py) ``pool`` is
+    one shard's contiguous tile: pass the shard_map ``axis_name``, the
+    tile's ``base`` pool offset and the global ``p_total``.  Each round's
+    two scatter-mins then run on the LOCAL tile and merge across shards
+    with ``lax.pmin`` — the local-select + all-reduce:min form this
+    selection was designed for.  The per-round global minimum over
+    (t_deliver, pool index) is the min of the per-shard minima, so the
+    sharded table is bit-identical to the solo one; ``delivered`` /
+    ``to_dead`` come back tile-local.  Defaults leave the solo path
+    byte-for-byte unchanged.
     """
     p = pool.capacity
+    pt = p if p_total is None else p_total
     due, to_dead = _due_masks(pool, n, t_end, alive, hold)
 
-    idx = jnp.arange(p, dtype=I32)
+    idx = base + jnp.arange(p, dtype=I32)  # GLOBAL pool indices
     dstc = jnp.clip(pool.dst, 0, n - 1)
     # remaining-candidate key; winners flip to T_INF between rounds
     tkey = jnp.where(due, pool.t_deliver, T_INF)
     cols, delivered = [], jnp.zeros((p,), bool)
     for _ in range(r):
         min_t = jnp.full((n,), T_INF, I64).at[dstc].min(tkey)
+        if axis_name is not None:
+            min_t = jax.lax.pmin(min_t, axis_name)
         cand = (tkey < T_INF) & (tkey == min_t[dstc])
-        win = jnp.full((n,), p, I32).at[dstc].min(jnp.where(cand, idx, p))
-        cols.append(jnp.where(win < p, win, NO_NODE))
+        win = jnp.full((n,), pt, I32).at[dstc].min(jnp.where(cand, idx, pt))
+        if axis_name is not None:
+            win = jax.lax.pmin(win, axis_name)
+        cols.append(jnp.where(win < pt, win, NO_NODE))
         is_win = cand & (idx == win[dstc])
         delivered |= is_win
         tkey = jnp.where(is_win, T_INF, tkey)

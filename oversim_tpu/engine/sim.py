@@ -309,17 +309,25 @@ class Simulation:
             s.pool, self.n, self.ep.inbox_slots, t_end, alive,
             impl=self.ep.inbox_impl, hold=self._hold_mask(s))
 
-    def _msgs_from_block(self, s: SimState, t_next, inbox, blk):
+    def _msgs_from_block(self, s: SimState, t_next, inbox, blk,
+                         t_deliver=None, stamp=None):
         """[N, R] index table + gathered [N, R, W] payload block → the
         Msg view (shared by the lax gather and the fused kernel path;
-        the two i64 fields are always gathered here off the index
-        table — the Pallas core has no 64-bit lanes)."""
+        the two i64 fields are gathered here off the index table — the
+        Pallas core has no 64-bit lanes — unless the caller already
+        holds them: the sharded tick (parallel/shard_tick.py) passes
+        its owner-gathered [N, R] ``t_deliver``/``stamp``, since the
+        local pool tile cannot be indexed by global inbox entries)."""
         safe = jnp.maximum(inbox, 0)
+        if t_deliver is None:
+            t_deliver = s.pool.t_deliver[safe]
+        if stamp is None:
+            stamp = s.pool.stamp[safe]
         ncol = len(pool_mod.SCAL_COLS)
         col = lambda name: blk[..., pool_mod._COL[name]]  # noqa: E731
         return Msg(
             valid=inbox >= 0,
-            t_deliver=jnp.maximum(s.pool.t_deliver[safe], t_next),
+            t_deliver=jnp.maximum(t_deliver, t_next),
             src=col("src"), dst=col("dst"),
             kind=col("kind"),
             key=jax.lax.bitcast_convert_type(
@@ -328,7 +336,7 @@ class Simulation:
             a=col("a"), b=col("b"),
             c=col("c"), d=col("d"),
             nodes=blk[..., ncol + s.pool.kl:], size_b=col("size_b"),
-            stamp=s.pool.stamp[safe])
+            stamp=stamp)
 
     def _phase_inbox_gather(self, s: SimState, t_next, inbox):
         """Phase 3b: ONE gather of the packed [P, W] block for all the

@@ -207,3 +207,64 @@ def fused_select(pool, n: int, r: int, t_end, alive, hold=None,
     occupancy-bounded kernel walk and NO payload gather."""
     return fused_inbox(pool, n, r, t_end, alive, hold=hold,
                        interpret=interpret, gather=False)
+
+
+def fused_select_sharded(pool, n: int, r: int, t_end, alive, hold=None, *,
+                         axis_name, base, p_total, interpret=None):
+    """Shard-aware fused select (parallel/shard_tick.py): the kernel
+    runs UNMODIFIED on each shard's local pool tile, producing that
+    shard's per-destination top-R list; the global table is then a
+    K-way sorted merge driven purely by ``lax.pmin``.
+
+    Per round, every shard offers its list head ``(t, global idx)``;
+    an i64 pmin picks the winning deliver time, an i32 pmin over the
+    matching heads breaks ties by global pool index (tiles are
+    contiguous, so local-index order IS global-index order within a
+    shard — the oracle's exact (t_deliver, idx) tie-break), and the
+    winning shard advances its head.  2R all-reduce:min per call, the
+    same collective count and kind as the sharded scatter path.
+
+    Correctness of the local prefilter: each destination's global
+    top-R draws at most R entries from any one shard, and those are
+    necessarily that shard's R earliest — so the global table is a
+    subset of the union of local tables.  ``delivered`` is recomputed
+    as membership of the local tile in the FINAL table (the local
+    kernel's provisional flags — including its R-overflow evictions —
+    are discarded; the oracle's delivered set is exactly the final
+    table's membership).  Returns ``(inbox [N, R] GLOBAL pool indices,
+    delivered [P_local] bool, dropped_dead [P_local] bool)``.
+    """
+    p_local = pool.capacity
+    inbox_l, _prov, to_dead = fused_inbox(pool, n, r, t_end, alive,
+                                          hold=hold, interpret=interpret,
+                                          gather=False)
+    valid_l = inbox_l >= 0
+    safe_l = jnp.maximum(inbox_l, 0)
+    t_tab = jnp.where(valid_l, pool.t_deliver[safe_l], pool_mod.T_INF)
+    g_tab = jnp.where(valid_l, base + inbox_l, _I32_MAX)
+
+    head = jnp.zeros((n,), I32)
+    cols = []
+    for _ in range(r):
+        hc = jnp.minimum(head, r - 1)[:, None]
+        in_range = head < r
+        t_cand = jnp.where(
+            in_range, jnp.take_along_axis(t_tab, hc, axis=1)[:, 0],
+            pool_mod.T_INF)
+        g_cand = jnp.where(
+            in_range, jnp.take_along_axis(g_tab, hc, axis=1)[:, 0],
+            _I32_MAX)
+        t_win = jax.lax.pmin(t_cand, axis_name)
+        g_win = jax.lax.pmin(
+            jnp.where(t_cand == t_win, g_cand, _I32_MAX), axis_name)
+        got = g_win < _I32_MAX  # global indices < p_total << i32 max
+        cols.append(jnp.where(got, g_win, pool_mod.NO_NODE))
+        head += ((t_cand == t_win) & (g_cand == g_win) & got).astype(I32)
+    inbox = jnp.stack(cols, axis=1)
+
+    flat = inbox.reshape(-1)
+    loc = flat - base
+    mine = (flat >= 0) & (loc >= 0) & (loc < p_local)
+    delivered = jnp.zeros((p_local,), bool).at[
+        jnp.where(mine, loc, p_local)].set(True, mode="drop")
+    return inbox, delivered, to_dead

@@ -62,6 +62,170 @@ def make_replica_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.array(devices).reshape(-1), (REPLICA_AXIS,))
 
 
+def make_mesh_2d(replica_devices: int = 1, node_devices: int | None = None,
+                 devices=None) -> Mesh:
+    """2-D ``(REPLICA_AXIS, NODE_AXIS)`` mesh: one program runs
+    ``replica_devices`` replica groups, each over ``node_devices``
+    node shards.  ``node_devices=None`` takes every remaining device.
+    ``make_mesh_2d(1, k)`` is the solo node-sharded layout; composed
+    with the campaign's stacked [S, ...] axis it is S replicas ×
+    K-way-sharded nodes in one compiled tick."""
+    if devices is None:
+        devices = jax.devices()
+    if node_devices is None:
+        node_devices = len(devices) // replica_devices
+    need = replica_devices * node_devices
+    if need < 1 or need > len(devices):
+        raise ValueError(
+            f"mesh {replica_devices}x{node_devices} needs {need} devices, "
+            f"have {len(devices)}")
+    return Mesh(np.array(devices[:need]).reshape(replica_devices,
+                                                 node_devices),
+                (REPLICA_AXIS, NODE_AXIS))
+
+
+def _shape(leaf):
+    return tuple(getattr(leaf, "shape", None) or np.shape(leaf))
+
+
+def _node_spec(leaf, lead: int):
+    """P sharding dim ``lead`` on NODE_AXIS (replica dims prepended by
+    the campaign builders)."""
+    nd = len(_shape(leaf))
+    return P(*([None] * lead), NODE_AXIS, *([None] * (nd - lead - 1)))
+
+
+def state_pspecs_2d(state):
+    """PartitionSpec pytree for a solo SimState on a (replica, node)
+    mesh: pool leaves ([P]/[P, W]) and logic leaves with leading dim N
+    shard along NODE_AXIS; EVERYTHING else is replicated.
+
+    The replication ledger (why not "every [N, ...] leaf"):
+
+      * ``alive``/``node_keys``/``malicious`` [N] — cross-indexed by
+        every handler through the full-width Ctx (``ctx.keys[slot]``);
+        at 20 B/node replicating is cheaper than an all-gather per use;
+      * churn/underlay/stats/counters/telemetry + scalars — the churn
+        step, ``logic.reset`` and ``send_batch`` draw FULL-WIDTH rng
+        planes; running them replicated is what keeps the sharded tick
+        bit-identical to the solo oracle (parallel/shard_tick.py);
+      * the dominant bytes — the [P, W] pool block (O(N·pool_factor·W))
+        and the per-node logic rows (O(N·F)) — do shard.
+    """
+    n = _shape(state.alive)[0]
+
+    def logic_spec(leaf):
+        shp = _shape(leaf)
+        return _node_spec(leaf, 0) if shp and shp[0] == n else P()
+
+    import dataclasses
+    sp = jax.tree.map(lambda _: P(), state)
+    return dataclasses.replace(
+        sp,
+        pool=jax.tree.map(lambda l: _node_spec(l, 0), state.pool),
+        logic=jax.tree.map(logic_spec, state.logic))
+
+
+def state_shardings_2d(state, mesh: Mesh):
+    """NamedSharding pytree for a solo SimState on a 2-D mesh (node
+    leaves sharded on NODE_AXIS, replicated across REPLICA_AXIS)."""
+    k = int(mesh.shape[NODE_AXIS])
+    n = _shape(state.alive)[0]
+    p = _shape(state.pool.valid)[0]
+    if n % k or p % k:
+        raise ValueError(
+            f"n={n} / pool={p} not divisible by node shards k={k}")
+    return jax.tree.map(lambda _, sp: NamedSharding(mesh, sp), state,
+                        state_pspecs_2d(state))
+
+
+def shard_state_2d(state, mesh: Mesh):
+    """Place a solo SimState onto a 2-D (replica, node) mesh."""
+    return jax.device_put(state, state_shardings_2d(state, mesh))
+
+
+def campaign_state_pspecs_2d(cs):
+    """PartitionSpec pytree for a stacked [S, ...] campaign state on the
+    2-D mesh: every leaf shards its leading replica axis; pool and
+    logic-node leaves additionally shard dim 1 along NODE_AXIS (same
+    replication ledger as :func:`state_pspecs_2d`, shifted one dim)."""
+    n = _shape(cs.alive)[1]
+
+    import dataclasses
+    sp = jax.tree.map(lambda l: P(REPLICA_AXIS), cs)
+
+    def logic_spec(leaf):
+        shp = _shape(leaf)
+        return (P(REPLICA_AXIS, NODE_AXIS)
+                if len(shp) >= 2 and shp[1] == n else P(REPLICA_AXIS))
+
+    sp = dataclasses.replace(
+        sp,
+        pool=jax.tree.map(lambda l: P(REPLICA_AXIS, NODE_AXIS), cs.pool),
+        logic=jax.tree.map(logic_spec, cs.logic))
+    return sp
+
+
+def campaign_state_shardings_2d(cs, mesh: Mesh):
+    """NamedSharding pytree for a stacked campaign state on a 2-D
+    (replica, node) mesh."""
+    r = int(mesh.shape[REPLICA_AXIS])
+    k = int(mesh.shape[NODE_AXIS])
+    s = _shape(cs.alive)[0]
+    n = _shape(cs.alive)[1]
+    p = _shape(cs.pool.valid)[1]
+    if s % r:
+        raise ValueError(f"S={s} replicas not divisible by replica "
+                         f"mesh extent r={r}")
+    if n % k or p % k:
+        raise ValueError(
+            f"n={n} / pool={p} not divisible by node shards k={k}")
+    return jax.tree.map(lambda _, sp: NamedSharding(mesh, sp), cs,
+                        campaign_state_pspecs_2d(cs))
+
+
+def shard_campaign_state_2d(cs, mesh: Mesh):
+    """Place a stacked campaign state onto a 2-D (replica, node) mesh."""
+    return jax.device_put(cs, campaign_state_shardings_2d(cs, mesh))
+
+
+def jit_sharded_step(sim, mesh: Mesh, donate: bool = True):
+    """jit the genuinely node-sharded one-tick step (shard_map plane,
+    parallel/shard_tick.py) with matching in/out shardings."""
+    from oversim_tpu.parallel.shard_tick import ShardedSim
+    ssim = ShardedSim(sim, mesh)
+    return jax.jit(ssim.step, in_shardings=(ssim.shardings,),
+                   out_shardings=ssim.shardings,
+                   donate_argnums=(0,) if donate else ())
+
+
+def jit_sharded_run(sim, mesh: Mesh, n_ticks: int, donate: bool = True):
+    """jit a ``lax.scan`` of n_ticks node-sharded steps."""
+    from oversim_tpu.parallel.shard_tick import ShardedSim
+    ssim = ShardedSim(sim, mesh)
+
+    def run(s):
+        def body(carry, _):
+            return ssim.step(carry), None
+        s, _ = jax.lax.scan(body, s, None, length=n_ticks)
+        return s
+
+    return jax.jit(run, in_shardings=(ssim.shardings,),
+                   out_shardings=ssim.shardings,
+                   donate_argnums=(0,) if donate else ())
+
+
+def jit_sharded_campaign_step(camp, mesh: Mesh, donate: bool = True):
+    """jit the S-replica × K-node-shard campaign step on the 2-D mesh
+    (zero cross-replica collectives: every pmin names NODE_AXIS only,
+    so replica groups span node subgroups — pinned by the shard gate)."""
+    from oversim_tpu.parallel.shard_tick import ShardedCampaign
+    scamp = ShardedCampaign(camp, mesh)
+    return jax.jit(scamp.vstep, in_shardings=(scamp.shardings,),
+                   out_shardings=scamp.shardings,
+                   donate_argnums=(0,) if donate else ())
+
+
 def state_shardings(state, mesh: Mesh):
     """NamedSharding pytree for a SimState: leading axis of every array
     whose first dim divides evenly over the mesh is sharded; scalars and

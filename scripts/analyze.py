@@ -217,9 +217,44 @@ def _seed_sparse(ctx):
     return findings, {"entries": {"seeded_sparse": {"delta": d}}}
 
 
+# synthetic HLO carrying an all-reduce:add and an all-to-all — both
+# off the sharded tick's all-reduce:min-only collective allowlist
+# (pure-text, no backend; the combiner resolves through the region
+# body like real pmin lowerings do)
+_SEED_SHARD_HLO = '''\
+HloModule seeded_shard
+
+%region_0.1 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %r = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %p0), replica_groups={}, \
+to_apply=%region_0.1
+  ROOT %a2a = f32[64]{0} all-to-all(f32[64]{0} %ar), dimensions={0}
+}
+'''
+
+
+def _seed_shard(ctx):
+    """Check a planted all-reduce:add + all-to-all against the sharded
+    tick's contract (allowed_collectives = {all-reduce:min} only)."""
+    from oversim_tpu.analysis import contracts as C
+    from oversim_tpu.analysis import hlo_pass
+
+    contract = C.REGISTRY["sharded_tick"].contract
+    m = hlo_pass.measure_entry(_SEED_SHARD_HLO, 64)
+    findings = hlo_pass.check_contract("seeded_shard", contract, m)
+    return findings, {"entries": {"seeded_shard": {
+        "collectives": m["collectives"]}}}
+
+
 _SEEDS = {"hlo": _seed_hlo, "trace": _seed_trace, "ast": _seed_ast,
           "compile": _seed_compile, "kernel": _seed_kernel,
-          "sparse": _seed_sparse}
+          "sparse": _seed_sparse, "shard": _seed_shard}
 
 
 # ---------------------------------------------------------------------------
@@ -286,8 +321,8 @@ def main(argv) -> int:
         return 0
 
     if args.seed_breach:
-        # ast + kernel + sparse breaches are pure-text — no backend
-        if args.seed_breach not in ("ast", "kernel", "sparse"):
+        # ast + kernel + sparse + shard breaches are pure-text — no backend
+        if args.seed_breach not in ("ast", "kernel", "sparse", "shard"):
             _setup_jax()
         findings, summary = _SEEDS[args.seed_breach](None)
         doc = findings_mod.document(

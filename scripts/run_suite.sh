@@ -107,6 +107,22 @@ else
   status=1
   echo "FAIL  sparse_gate  $(tail -1 "$STATE/sparse_gate.log")"
 fi
+# 2D-mesh sharded-tick gate (scripts/shard_gate.py): 64 churned chord
+# ticks through ShardedSim on the (1, 8) mesh must be bit-identical to
+# the unsharded oracle (both inbox impls), the compiled sharded step
+# may carry ONLY all-reduce:min collectives (no sorts), and on the
+# (2, 4) campaign mesh no replica_groups set may span replica rows
+shard_marker="$STATE/shard_gate.ok"
+if [ -f "$shard_marker" ]; then
+  echo "skip  shard_gate (done)"
+elif timeout "${SUITE_MODULE_TIMEOUT:-3000}" \
+    python scripts/shard_gate.py > "$STATE/shard_gate.log" 2>&1; then
+  touch "$shard_marker"
+  echo "PASS  shard_gate  $(tail -1 "$STATE/shard_gate.log")"
+else
+  status=1
+  echo "FAIL  shard_gate  $(tail -1 "$STATE/shard_gate.log")"
+fi
 # AOT compile-plane smoke (scripts/aot_smoke.py): the same tiny scenario
 # in TWO processes sharing one artifact store — the second must pre-warm
 # every registered entry from exported artifacts with ZERO fresh

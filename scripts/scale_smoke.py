@@ -52,7 +52,9 @@ def _merge(rows, row):
                     and r.get("inbox_impl", "scatter")
                     == row.get("inbox_impl", "scatter")
                     and r.get("tick_impl", "dense")
-                    == row.get("tick_impl", "dense"))] + [row]
+                    == row.get("tick_impl", "dense")
+                    and r.get("node_shards", 0)
+                    == row.get("node_shards", 0))] + [row]
 
 
 def _save_row(row):
@@ -129,8 +131,20 @@ def _build(jax, overlay, n, churn, window, interval=0.2,
     return sim_mod.Simulation(logic, cp, engine_params=ep), cp
 
 
+def _place_2d(jax, st, node_shards):
+    """Node-axis 2D placement (1 x K mesh) for a solo SimState.  Raises
+    loudly (ValueError from mesh.py) when K does not divide N / the
+    pool or fewer than K devices exist — a silently replicated
+    "sharded" row would poison the ladder cache."""
+    if node_shards <= 1:
+        return st
+    from oversim_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.make_mesh_2d(1, node_shards)
+    return mesh_mod.shard_state_2d(st, mesh)
+
+
 def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter",
-               tick_impl="dense"):
+               tick_impl="dense", node_shards=0):
     """Throughput measurement at N: warm, then measured windows — both
     device-resident (run_until_device; one dispatch + one device_get of
     the counter leaves per window, the bench.py round-7 loop)."""
@@ -138,7 +152,7 @@ def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter",
     sim, cp = _build(jax, overlay, n, "none", window=0.2,
                      inbox_impl=inbox_impl, tick_impl=tick_impl)
     dev = jax.devices()[0]
-    st = sim.init(seed=7)
+    st = _place_2d(jax, sim.init(seed=7), node_shards)
     warm_until = cp.init_finished_time + 20.0
     t0 = time.time()
     st = sim.run_until_device(st, warm_until, chunk=64)
@@ -165,6 +179,8 @@ def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter",
         "platform": dev.platform,
         "inbox_impl": inbox_impl,
         "tick_impl": tick_impl,
+        "node_shards": node_shards,
+        "mesh": "1x%d" % node_shards if node_shards > 1 else None,
         "kernel_plane": inbox_impl == "pallas",
         "lookups_per_sec": round(rate, 1),
         "delivered": int(delivered), "sent": int(sent),
@@ -177,14 +193,14 @@ def ladder_row(jax, overlay, n, measure_wall, inbox_impl="scatter",
 
 
 def churn_row(jax, overlay, n, t_sim, inbox_impl="scatter",
-              tick_impl="dense"):
+              tick_impl="dense", node_shards=0):
     """LifetimeChurn bounds smoke at N (config #2 envelope)."""
     sim, cp = _build(jax, overlay, n, "lifetime", window=0.2,
                      interval=60.0, inbox_impl=inbox_impl,
                      tick_impl=tick_impl)
     dev = jax.devices()[0]
     t0 = time.time()
-    st = sim.init(seed=1)
+    st = _place_2d(jax, sim.init(seed=1), node_shards)
     target = min(t_sim, cp.init_finished_time + 300.0)
     step = 64 * 0.2
     sim_t = 0.0
@@ -206,6 +222,8 @@ def churn_row(jax, overlay, n, t_sim, inbox_impl="scatter",
         "platform": dev.platform,
         "inbox_impl": inbox_impl,
         "tick_impl": tick_impl,
+        "node_shards": node_shards,
+        "mesh": "1x%d" % node_shards if node_shards > 1 else None,
         "kernel_plane": inbox_impl == "pallas",
         "t_sim": out["_t_sim"], "wall_s": round(time.time() - t0, 1),
         "alive": out["_alive"],
@@ -293,6 +311,11 @@ def main():
                     choices=["dense", "sparse"],
                     help="tick implementation (sparse = active-set "
                     "plane; tick cost bounded by traffic, not N)")
+    ap.add_argument("--node-shards", type=int, default=0,
+                    help="shard the node axis over K devices (2D "
+                    "replica x node mesh); 0/1 = replicated node axis. "
+                    "Refuses loudly when K does not divide N/pool or "
+                    "devices are short.")
     args = ap.parse_args()
 
     if os.environ.get("OVERSIM_SCALE_CHILD") != "1":
@@ -337,6 +360,9 @@ def main():
                     "measure": args.measure, "platform": args.platform,
                     "inbox_impl": inbox_impl,
                     "tick_impl": tick_impl,
+                    "node_shards": args.node_shards,
+                    "mesh": ("1x%d" % args.node_shards
+                             if args.node_shards > 1 else None),
                     "kernel_plane": inbox_impl == "pallas"},
             artifacts={"artifact":
                        os.environ.get("OVERSIM_SCALE_ARTIFACT")},
@@ -347,7 +373,8 @@ def main():
                     break
                 row = ladder_row(jax, args.overlay, n, args.measure,
                                  inbox_impl=inbox_impl,
-                                 tick_impl=tick_impl)
+                                 tick_impl=tick_impl,
+                                 node_shards=args.node_shards)
                 if row is None:
                     continue
                 _save_row(row)
@@ -355,7 +382,8 @@ def main():
                 _emit({"rows": rows})
         else:
             row = churn_row(jax, args.overlay, args.n, args.t,
-                            inbox_impl=inbox_impl, tick_impl=tick_impl)
+                            inbox_impl=inbox_impl, tick_impl=tick_impl,
+                            node_shards=args.node_shards)
             _save_row(row)
             rows = _merge(rows, row)
             _emit({"rows": rows})

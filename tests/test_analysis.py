@@ -272,7 +272,8 @@ def test_registry_shape():
     assert names == ["solo_tick", "solo_chunk", "run_until_device",
                      "campaign_tick", "telemetry_tick", "service_window",
                      "fused_tick", "fused_chunk", "sparse_tick",
-                     "sparse_chunk", "resharded_resume"]
+                     "sparse_chunk", "sharded_tick",
+                     "sharded_campaign_tick", "resharded_resume"]
     tel = contracts_mod.REGISTRY["telemetry_tick"]
     assert tel.delta is not None and tel.delta.base == "solo_tick"
     for donated in ("solo_chunk", "run_until_device", "service_window",
@@ -418,6 +419,18 @@ def test_seeded_sparse_breach_exits_nonzero(tmp_path):
     assert f["pass"] == "hlo" and f["measured"] == 1 and f["limit"] == -1
     d = doc["passes"]["sparse"]["entries"]["seeded_sparse"]["delta"]
     assert d["wide_gather_delta"] == 1 and d["gather_delta"] == 1
+
+
+def test_seeded_shard_breach_exits_nonzero(tmp_path):
+    """--seed-breach shard: a planted all-reduce:add + all-to-all vs the
+    sharded tick's all-reduce:min-only collective allowlist — pure-text,
+    no backend, exits non-zero."""
+    rc, doc = _run_seed("shard", tmp_path)
+    assert rc == 1 and doc["ok"] is False
+    [f] = [f for f in doc["findings"] if f["rule"] == "collectives"]
+    assert f["pass"] == "hlo"
+    assert f["measured"] == {"all-reduce:add": 1, "all-to-all": 1}
+    assert f["limit"] == ["all-reduce:min"]
 
 
 def test_seeded_compile_breach_exits_nonzero(tmp_path):
