@@ -431,6 +431,27 @@ def _build_service_window(ctx):
         info={"n": ctx.n, "overlay": ctx.overlay, "ext_hold_slot": 0})
 
 
+def _build_daemon_window(ctx):
+    import jax.numpy as jnp
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.engine.sim import NS
+    # the daemon tier's dispatch unit: the CAMPAIGN-stacked
+    # run_until_device with the EXT_OUT hold armed — S tenants (replica
+    # rows, service/tenant.py) served by one compiled program.  Same
+    # donated-window contract as service_window: tenancy adds batched
+    # pool writes at the boundary, never graph structure.
+    sim = build_sim(ctx, ext_hold_slot=0)
+    camp = Campaign(sim, CampaignParams(replicas=ctx.replicas,
+                                        base_seed=7))
+    target = jnp.int64(int(2 * ctx.window * NS))
+    return EntryBuild(
+        fn=type(camp)._run_until_device,
+        make_args=lambda: (camp, camp.init(), target, ctx.chunk),
+        pool_dim=sim.ep.pool_factor * ctx.n,
+        info={"n": ctx.n, "overlay": ctx.overlay,
+              "replicas": ctx.replicas, "ext_hold_slot": 0})
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -486,6 +507,13 @@ DEFAULT_ENTRIES = (
         doc="service window: run_until_device with EXT_OUT hold armed",
         contract=_DONATED,
         build=_build_service_window),
+    EntryPoint(
+        name="daemon_window",
+        doc="daemon serving window: campaign-stacked run_until_device "
+            "with EXT_OUT hold armed — S tenants from one compiled "
+            "program, donated, zero cross-replica collectives",
+        contract=_DONATED,
+        build=_build_daemon_window),
     EntryPoint(
         name="fused_tick",
         doc="jit(sim.step) with the Pallas kernel plane armed "

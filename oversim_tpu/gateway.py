@@ -233,6 +233,13 @@ class RealtimeGateway:
         self.tcp = None
         self.tcp_port = None
         self._tcp_conns: dict = {}      # session id -> (sock, rx buffer)
+        # per-connection WRITE buffers: outbound frames are appended
+        # (prefix+payload, atomically) and drained with non-blocking
+        # send() on every poll — sendall on a non-blocking socket can
+        # raise after a PARTIAL write, truncating the length-prefixed
+        # stream mid-frame and desyncing the peer forever
+        self._tcp_tx: dict = {}         # session id -> tx bytearray
+        self.tx_partial_writes = 0      # sends the kernel only partly took
         if tcp_port is not None:
             self.tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             self.tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -259,6 +266,10 @@ class RealtimeGateway:
         self.max_rx_backlog = max_rx_backlog
         self.rx_shed = 0                # frames refused by admission ctl
         self._warned: set = set()       # one stderr warning per category
+        # serving-window index (set by service.ingest.GatewayIngest per
+        # boundary) so traced latencies carry window units; None on the
+        # per-tick pump/run_realtime path (wall-only, the old behavior)
+        self._window = None
 
     # ------------------------------------------------ injection --------
     def inject(self, kind: int, a: int = 0, b: int = 0, c: int = 0,
@@ -308,8 +319,7 @@ class RealtimeGateway:
         self._rx_warn(
             "shed frame (admission control)",
             f"rx backlog at max_rx_backlog={self.max_rx_backlog}")
-        if self.tracer is not None and hasattr(self.tracer, "nack"):
-            self.tracer.nack(sid)
+        self._trace("nack", sid)
         payload = self.parser.nack(sid, b, c)
         if self.crypto is not None:
             payload = self.crypto.sign_frame(payload)
@@ -341,6 +351,60 @@ class RealtimeGateway:
             self._rx_warn(f"malformed {what}", repr(e))
             return None
 
+    def _trace(self, event: str, sid: int):
+        """mint/settle/nack on the tracer, threading the serving-window
+        index when the ingest adapter set one (window units make the
+        latency histograms scale-free; the per-tick pump path keeps the
+        old wall-only no-kwarg calls for duck-typed test tracers)."""
+        if self.tracer is None:
+            return
+        fn = getattr(self.tracer, event, None)
+        if fn is None:
+            return
+        if self._window is not None:
+            fn(sid, window=self._window)
+        else:
+            fn(sid)
+
+    def _send_tcp(self, sid: int, payload: bytes):
+        """Queue one length-prefixed frame on the session's write
+        buffer and drain opportunistically.  The append is atomic per
+        frame, so concurrent frames can interleave only at frame
+        boundaries — never mid-frame, even when the socket buffer is
+        full (the partial-write audit, tests/test_gateway.py)."""
+        if sid not in self._tcp_conns:
+            return
+        buf = self._tcp_tx.setdefault(sid, bytearray())
+        buf += len(payload).to_bytes(4, "big") + payload
+        self._pump_tx(sid)
+
+    def _pump_tx(self, only_sid=None):
+        """Drain pending per-connection write buffers with non-blocking
+        sends; whatever the kernel refuses stays queued for the next
+        poll.  A hard send error drops the buffer (the rx side notices
+        the dead socket and reaps the session)."""
+        sids = ((only_sid,) if only_sid is not None
+                else tuple(self._tcp_tx))
+        for sid in sids:
+            buf = self._tcp_tx.get(sid)
+            entry = self._tcp_conns.get(sid)
+            if not buf or entry is None:
+                if entry is None:
+                    self._tcp_tx.pop(sid, None)
+                continue
+            conn = entry[0]
+            while buf:
+                try:
+                    n = conn.send(buf)
+                except BlockingIOError:
+                    break
+                except OSError:
+                    self._tcp_tx.pop(sid, None)
+                    break
+                if n < len(buf):
+                    self.tx_partial_writes += 1
+                del buf[:n]
+
     def _poll_udp(self):
         socket_errs = 0
         while True:
@@ -368,8 +432,7 @@ class RealtimeGateway:
             b, c = parsed
             sid = self._next_session
             self._next_session += 1
-            if self.tracer is not None:
-                self.tracer.mint(sid)
+            self._trace("mint", sid)
             if (self.max_rx_backlog is not None
                     and len(self._rx) >= self.max_rx_backlog):
                 # no session entry: a shed frame never gets an EXT_OUT
@@ -429,22 +492,22 @@ class RealtimeGateway:
                 if parsed is None:
                     continue
                 b, c = parsed
-                if self.tracer is not None:
-                    # per-FRAME mint on the per-connection sid: a fresh
-                    # request on a kept-alive stream re-opens the trace
-                    self.tracer.mint(sid)
+                # per-FRAME mint on the per-connection sid: a fresh
+                # request on a kept-alive stream re-opens the trace
+                self._trace("mint", sid)
                 if (self.max_rx_backlog is not None
                         and len(self._rx) >= self.max_rx_backlog):
                     # connection survives — only this frame is refused
                     self._shed_frame(
                         sid, b, c,
-                        lambda p, _co=conn: _co.sendall(
-                            len(p).to_bytes(4, "big") + p))
+                        lambda p, _sid=sid: self._send_tcp(_sid, p))
                     continue
                 self._rx.append(ExtFrame(a=sid, b=b, c=c))
         for sid in dead:
             self._tcp_conns.pop(sid, None)
+            self._tcp_tx.pop(sid, None)
             self._sessions.pop(sid, None)
+        self._pump_tx()
 
     def _drain_ext_out(self):
         """Transmit socket-session EXT_OUT messages (raw-packet/tun
@@ -457,8 +520,7 @@ class RealtimeGateway:
                 return False          # not ours — leave for the bridge
             if sess is None:
                 return True           # orphan: free, nothing to send
-            if self.tracer is not None:
-                self.tracer.settle(sid)
+            self._trace("settle", sid)
             payload = self.parser.encapsulate(sid, b, c)
             if self.crypto is not None:
                 payload = self.crypto.sign_frame(payload)
@@ -468,13 +530,7 @@ class RealtimeGateway:
                 except OSError:
                     pass
             else:
-                entry = self._tcp_conns.get(sid)
-                if entry is not None:
-                    try:
-                        entry[0].sendall(
-                            len(payload).to_bytes(4, "big") + payload)
-                    except OSError:
-                        pass
+                self._send_tcp(sid, payload)
             return True
 
         self.state = drain_ext_out(self.state, self.gw, handler)

@@ -52,7 +52,8 @@ class RequestTracer:
     offers every parked EXT_OUT, not only traced ones."""
 
     def __init__(self, registry=None, *, keep_samples: bool = False,
-                 max_samples: int = 65536, clock=time.monotonic):
+                 max_samples: int = 65536, clock=time.monotonic,
+                 prefix: str = "oversim", labels: dict | None = None):
         self.registry = registry or metrics_mod.get_registry()
         self.clock = clock
         self.keep_samples = keep_samples
@@ -62,26 +63,34 @@ class RequestTracer:
         self._open: dict = {}             # sid -> (t_mono, window)
         self._lock = threading.Lock()
         r = self.registry
+        # the default prefix/labels reproduce the historical flat
+        # oversim_* series exactly; per-tenant tracers use
+        # prefix="oversim_tenant", labels={"tenant": "<t>"} so every
+        # tenant gets its own labelled series on one shared registry
         self.minted = r.counter(
-            "oversim_requests_minted_total",
-            "EXT_IN frames assigned a trace id at ingest")
+            f"{prefix}_requests_minted_total",
+            "EXT_IN frames assigned a trace id at ingest",
+            labels=labels)
         self.settled = r.counter(
-            "oversim_requests_settled_total",
-            "EXT_OUT responses matched back to a minted trace id")
+            f"{prefix}_requests_settled_total",
+            "EXT_OUT responses matched back to a minted trace id",
+            labels=labels)
         self.unmatched = r.counter(
-            "oversim_requests_unmatched_total",
-            "EXT_OUT drains with no (or an already-settled) trace id")
+            f"{prefix}_requests_unmatched_total",
+            "EXT_OUT drains with no (or an already-settled) trace id",
+            labels=labels)
         self.nacked = r.counter(
-            "oversim_requests_nacked_total",
-            "minted requests explicitly refused by admission control")
+            f"{prefix}_requests_nacked_total",
+            "minted requests explicitly refused by admission control",
+            labels=labels)
         self.latency_s = r.histogram(
-            "oversim_request_latency_seconds",
+            f"{prefix}_request_latency_seconds",
             "request-to-response wall latency",
-            buckets=metrics_mod.LATENCY_BUCKETS_S)
+            buckets=metrics_mod.LATENCY_BUCKETS_S, labels=labels)
         self.latency_windows = r.histogram(
-            "oversim_request_window_latency",
+            f"{prefix}_request_window_latency",
             "request-to-response latency in serving windows",
-            buckets=metrics_mod.WINDOW_BUCKETS)
+            buckets=metrics_mod.WINDOW_BUCKETS, labels=labels)
 
     def mint(self, sid, *, window: int | None = None) -> None:
         with self._lock:

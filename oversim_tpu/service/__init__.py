@@ -3,8 +3,11 @@
 Composes the pieces the batch tiers already have — ``run_until_device``
 windows (bench.py), exact checkpoint/restore (checkpoint.py), real-socket
 ingestion (gateway.py), telemetry exporters — into a long-running service
-(ROADMAP item 5).  See service/loop.py for the pipeline and
-service/ingest.py for the request sources.
+(ROADMAP item 5).  See service/loop.py for the pipeline,
+service/ingest.py for the request sources, and the daemon tier
+(service/mux.py + service/tenant.py + service/daemon.py) for
+overlay-as-a-service: socket-scale client muxing with per-replica
+multi-tenant sessions over ONE compiled campaign program.
 """
 
 from oversim_tpu.service.loop import (  # noqa: F401
@@ -17,4 +20,20 @@ from oversim_tpu.service.loop import (  # noqa: F401
 from oversim_tpu.service.ingest import (  # noqa: F401
     GatewayIngest,
     InProcessIngest,
+)
+from oversim_tpu.service.mux import (  # noqa: F401
+    MuxConn,
+    MuxFrame,
+    SocketMux,
+)
+from oversim_tpu.service.tenant import (  # noqa: F401
+    TenantIngest,
+    TenantSpec,
+    TenantTable,
+    drain_ext_out_stacked,
+    inject_ext_batch_stacked,
+)
+from oversim_tpu.service.daemon import (  # noqa: F401
+    LocalCall,
+    OverlayDaemon,
 )

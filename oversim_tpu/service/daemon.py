@@ -1,0 +1,191 @@
+"""Overlay-as-a-service daemon: mux → tenants → one compiled window.
+
+The front door of the service plane.  An :class:`OverlayDaemon` is an
+ingest-protocol source (service/ingest.py) gluing three layers:
+
+  * :class:`~oversim_tpu.service.mux.SocketMux` — thousands of UDP/TCP
+    clients on one listener set, selectors event loop, per-connection
+    buffers (no thread per connection);
+  * :class:`~oversim_tpu.service.tenant.TenantIngest` — tenant id ↔
+    campaign replica row, per-tenant admission + tracing, ONE vmapped
+    batched pool write per window;
+  * the resident :class:`~oversim_tpu.service.loop.ServiceLoop` — the
+    daemon plugs in as ``ingest=``, so the device keeps the exact
+    one-dispatch-one-fetch window cadence regardless of client count.
+
+Per window boundary: ``before_window`` pumps the mux, validates each
+frame's tenant word, mints sids (sessions map sid → originating
+connection), sheds over-bound tenants with explicit ``EXT_NACK``
+frames, and injects everything admitted as one stacked batch.
+``after_window`` drains the stacked EXT_OUT responses and routes each
+back to its originating connection by sid — a client that disconnected
+mid-flight settles normally (counted ``orphaned``; its response is
+freed, never leaked), so ``minted == settled + nacked + outstanding``
+holds at drain no matter what clients do.
+
+A thread-safe local submit queue (:meth:`submit_local`) lets non-socket
+front-ends — the XML-RPC bridge in oversim_tpu/xmlrpcif.py — mint
+frames through the same admission/injection path and block on the same
+sid routing.
+
+Host-side only; never imports jax or obs (tracers arrive duck-typed
+inside the TenantTable).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from oversim_tpu import gateway as gateway_mod
+
+
+class LocalCall:
+    """One in-process request riding the daemon's window cadence: the
+    submitting thread blocks on ``done`` until the serving loop drains
+    the response (``status`` in {"ok", "nack", "pending"})."""
+
+    __slots__ = ("tenant", "b", "c", "sid", "status", "resp_b",
+                 "resp_c", "done")
+
+    def __init__(self, tenant, b, c):
+        self.tenant = tenant
+        self.b = b
+        self.c = c
+        self.sid = None
+        self.status = "pending"
+        self.resp_b = None
+        self.resp_c = None
+        self.done = threading.Event()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done.wait(timeout)
+
+
+class OverlayDaemon:
+    """Socket-scale serving front-end with per-replica tenancy.
+
+    ``ingest`` is a TenantIngest (its ``on_response`` hook is claimed
+    by the daemon); ``mux`` a SocketMux (None for local-only serving,
+    e.g. an XML-RPC-only daemon or the unit tests' direct driving)."""
+
+    def __init__(self, ingest, mux=None, parser=None):
+        self.ingest = ingest
+        self.mux = mux
+        self.parser = parser or gateway_mod.GenericPacketParser()
+        ingest.on_response = self._on_response
+        self.sessions: dict = {}      # sid -> MuxConn | ("udp", addr) | LocalCall
+        self.orphaned = 0             # responses to vanished clients
+        self.bad_tenant = 0           # frames naming an unknown tenant
+        self._local_q: collections.deque = collections.deque()
+        self._draining = False
+
+    # ------------------------------------------------ local front-end --
+    def submit_local(self, tenant: int, b: int = 0,
+                     c: int = 0) -> LocalCall:
+        """Thread-safe submit from a non-socket front-end; the call is
+        admitted at the NEXT window boundary (deque.append is atomic —
+        the XML-RPC handler threads never touch ingest state)."""
+        call = LocalCall(tenant, b, c)
+        self._local_q.append(call)
+        return call
+
+    # ------------------------------------------------ loop protocol ----
+    def before_window(self, state, target_ns: int):
+        if self.mux is not None:
+            self.mux.pump()
+            for frame in self.mux.take_frames():
+                tenant = frame.a
+                if not self.ingest.table.valid(tenant):
+                    # sid 0 is never minted: the NACK is addressable to
+                    # the client without opening a session
+                    self.bad_tenant += 1
+                    self.mux.send(frame.client,
+                                  self.parser.nack(0, frame.b, frame.c))
+                    continue
+                sid = self.ingest.submit(tenant, frame.b, frame.c)
+                if sid in self.ingest.nacked:
+                    self.mux.send(frame.client,
+                                  self.parser.nack(sid, frame.b, frame.c))
+                else:
+                    self.sessions[sid] = frame.client
+        while self._local_q:
+            call = self._local_q.popleft()
+            if not self.ingest.table.valid(call.tenant):
+                self.bad_tenant += 1
+                call.status = "nack"
+                call.done.set()
+                continue
+            sid = self.ingest.submit(call.tenant, call.b, call.c)
+            call.sid = sid
+            if sid in self.ingest.nacked:
+                call.status = "nack"
+                call.done.set()
+            else:
+                self.sessions[sid] = call
+        return self.ingest.before_window(state, target_ns)
+
+    def after_window(self, state):
+        state = self.ingest.after_window(state)
+        if self.mux is not None:
+            self.mux.flush_all()
+        return state
+
+    # ------------------------------------------------ sid routing ------
+    def _on_response(self, sid, tenant, b, c):
+        client = self.sessions.pop(sid, None)
+        if client is None:
+            self.orphaned += 1
+            return
+        if isinstance(client, LocalCall):
+            client.status = "ok"
+            client.resp_b = b
+            client.resp_c = c
+            client.done.set()
+            return
+        payload = self.parser.encapsulate(sid, b, c)
+        if self.mux is None or not self.mux.send(client, payload):
+            # the client disconnected mid-flight: its sid still
+            # settled above — counted, freed, never leaked
+            self.orphaned += 1
+
+    # ------------------------------------------------ drain ------------
+    def drain(self, loop, max_windows: int = 16) -> dict:
+        """Run extra (empty-submission) windows until every in-flight
+        request settles, then close whatever is left as NACKed — the
+        shutdown guarantee that ``minted == settled + nacked +
+        outstanding`` ends with zero outstanding.  Returns the final
+        accounting dict."""
+        self._draining = True
+        ran = 0
+        while self.ingest.outstanding() > 0 and ran < max_windows:
+            loop.run(n_windows=1)
+            ran += 1
+        for sid, tenant, b, c in self.ingest.nack_outstanding():
+            client = self.sessions.pop(sid, None)
+            if client is None:
+                continue
+            if isinstance(client, LocalCall):
+                client.status = "nack"
+                client.done.set()
+            elif self.mux is not None:
+                self.mux.send(client, self.parser.nack(sid, b, c))
+        if self.mux is not None:
+            self.mux.flush_all()
+        acct = self.accounting()
+        acct["drain_windows"] = ran
+        return acct
+
+    def accounting(self) -> dict:
+        acct = self.ingest.accounting()
+        acct["orphaned"] = self.orphaned
+        acct["bad_tenant"] = self.bad_tenant
+        acct["leaked_sessions"] = len(self.sessions) - sum(
+            1 for s in self.sessions if s in self.ingest._open)
+        if self.mux is not None:
+            acct["mux"] = self.mux.stats()
+        return acct
+
+    def close(self):
+        if self.mux is not None:
+            self.mux.close()

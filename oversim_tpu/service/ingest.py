@@ -133,10 +133,14 @@ class GatewayIngest:
 
     def __init__(self, gateway):
         self.gateway = gateway
+        self.windows = 0              # after_window drains completed
 
     def before_window(self, state, target_ns: int):
         gw = self.gateway
         gw.state = state
+        # pin the serving-window index on the gateway so the sids it
+        # mints/settles this boundary trace latency in window units
+        gw._window = self.windows
         gw._poll_udp()
         gw._poll_tcp()
         gw.flush_rx()
@@ -145,7 +149,9 @@ class GatewayIngest:
     def after_window(self, state):
         gw = self.gateway
         gw.state = state
+        gw._window = self.windows
         gw._drain_ext_out()
         for fn in gw.ext_drains:
             fn()
+        self.windows += 1
         return gw.state

@@ -222,6 +222,7 @@ class ServiceLoop:
         self.start_sim_t = float(start_sim_t)  # analysis: allow(host-float)
         self._launched = windows_done  # next window index to dispatch
         self._pending: _Pending | None = None
+        self._last_sim_t = None       # clock of the last drained window
         self._stop = False
         self._t0 = None
 
@@ -351,10 +352,17 @@ class ServiceLoop:
             # and whole-chunk dispatch can overshoot the grid by many
             # windows, and a grid target below t_now would run ZERO
             # ticks — leaving just-injected requests undelivered.  The
-            # ingest tier already syncs per window, so the extra t_now
-            # read costs nothing; the fixed grid (and with it the
-            # resume bit-identity pin) is the no-ingest tiers' contract.
-            cur = _min_sim_t(self.fetch(self.state.t_now))
+            # clock comes from the PREVIOUS window's drained snapshot
+            # (nothing between the drain and this boundary advances
+            # t_now), so serving windows keep exactly ONE fetch-hook
+            # sync per window — the daemon's fake-timer pin; only the
+            # very first window (no drain yet) pays a fresh read.  The
+            # fixed grid (and with it the resume bit-identity pin) is
+            # the no-ingest tiers' contract.
+            if self._pending is None and self._last_sim_t is not None:
+                cur = self._last_sim_t
+            else:
+                cur = _min_sim_t(self.fetch(self.state.t_now))
             target = max(target, cur + p.window_sim_s)
         if p.realtime:
             # simulated time must not run ahead of wall clock
@@ -406,6 +414,10 @@ class ServiceLoop:
         t_f0 = self.now()
         leaves = self.fetch(rec.snap)
         t_f1 = self.now()
+        if "t_now" in leaves:
+            # remember the drained clock: the next ingest boundary's
+            # current-time read reuses it instead of a second fetch
+            self._last_sim_t = _min_sim_t(leaves["t_now"])
         if self.trace is not None:
             self.trace.span("window_dispatch", rec.t_d0,
                             rec.t_d1 - rec.t_d0,

@@ -1,0 +1,317 @@
+"""Socket-scale client mux: one listener set, thousands of clients.
+
+The RealtimeGateway (oversim_tpu/gateway.py) bridges ONE simulation
+node to real sockets with a hand-rolled poll over a dict of
+connections — fine for a handful of peers, quadratic pain at serving
+scale.  The daemon front-end (service/daemon.py) instead multiplexes
+every client through this selectors-based event loop: one UDP socket
+plus one TCP listener, no thread per connection, per-connection read
+AND write buffers so a slow or hostile client can never desync, stall
+or interleave anyone else's frames.
+
+Wire contract (the gateway's native external frame, gateway._HDR):
+
+    client -> daemon   u32 EXT_IN  | u32 tenant | u32 b | u32 c
+    daemon -> client   u32 EXT_OUT | u32 sid    | u32 b | u32 c
+                       u32 EXT_NACK| u32 sid    | u32 b | u32 c
+
+UDP frames are bare datagrams; TCP frames carry the gateway's 4-byte
+big-endian length prefix (SimpleTCP stream framing, same desync bound
+``gateway._MAX_TCP_FRAME``).  The ``a`` word is the CLIENT's tenant id
+on the way in and the daemon-minted session id on the way out — the
+daemon owns sid minting, the mux only moves frames.
+
+Partial-write discipline: every outbound TCP byte goes through the
+per-connection ``tx`` buffer.  ``send()`` appends prefix+payload
+atomically and opportunistically drains with non-blocking ``send``;
+whatever the kernel refuses stays buffered and is drained on the
+selector's EVENT_WRITE — a full socket buffer can delay a frame but
+never truncate or interleave it.  (``sendall`` on a non-blocking
+socket is exactly the bug this module exists to avoid: it can raise
+after a PARTIAL write and corrupt the stream framing.)
+
+Pure stdlib, host-side only — no jax, no obs imports.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+
+from oversim_tpu import gateway as gateway_mod
+
+_HDR = gateway_mod._HDR
+_MAX_TCP_FRAME = gateway_mod._MAX_TCP_FRAME
+
+# a client that stops reading accumulates tx bytes; past this bound the
+# connection is dropped (counted) rather than growing without limit
+_MAX_TX_BUFFER = 4 << 20
+
+
+class MuxConn:
+    """One TCP client connection: socket + rx/tx byte buffers."""
+
+    __slots__ = ("sock", "addr", "rx", "tx", "closed", "rx_frames",
+                 "tx_frames")
+
+    def __init__(self, sock, addr):
+        self.sock = sock
+        self.addr = addr
+        self.rx = bytearray()
+        self.tx = bytearray()
+        self.closed = False
+        self.rx_frames = 0
+        self.tx_frames = 0
+
+    def __repr__(self):
+        return (f"MuxConn({self.addr}, closed={self.closed}, "
+                f"rx={self.rx_frames}, tx={self.tx_frames})")
+
+
+class MuxFrame:
+    """One parsed inbound frame: ``client`` is the reply handle (a
+    :class:`MuxConn` for TCP, ``("udp", addr)`` for datagrams)."""
+
+    __slots__ = ("client", "kind", "a", "b", "c")
+
+    def __init__(self, client, kind, a, b, c):
+        self.client = client
+        self.kind = kind
+        self.a = a
+        self.b = b
+        self.c = c
+
+
+class SocketMux:
+    """Selectors event loop over one UDP socket + one TCP listener.
+
+    ``pump()`` at every serving-window boundary: accepts, reads,
+    parses, and drains pending writes; parsed frames accumulate until
+    ``take_frames()``.  ``send(client, payload)`` routes a raw frame
+    back (the daemon builds payloads with its GenericPacketParser) —
+    UDP as one datagram, TCP length-prefixed through the per-connection
+    write buffer."""
+
+    def __init__(self, host: str = "127.0.0.1", udp_port: int = 0,
+                 tcp_port: int = 0, backlog: int = 1024,
+                 max_tx_buffer: int = _MAX_TX_BUFFER):
+        self.sel = selectors.DefaultSelector()
+        self.max_tx_buffer = max_tx_buffer
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind((host, udp_port))
+        self.udp.setblocking(False)
+        self.udp_port = self.udp.getsockname()[1]
+        self.sel.register(self.udp, selectors.EVENT_READ, "udp")
+        self.tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.tcp.bind((host, tcp_port))
+        self.tcp.listen(backlog)
+        self.tcp.setblocking(False)
+        self.tcp_port = self.tcp.getsockname()[1]
+        self.sel.register(self.tcp, selectors.EVENT_READ, "accept")
+        self.conns: set = set()         # live MuxConn objects
+        self._frames: list = []
+        self.accepted = 0
+        self.disconnected = 0
+        self.rx_frames = 0
+        self.rx_dropped = 0             # malformed/undersized frames
+        self.rx_socket_errors = 0
+        self.tx_frames = 0
+        self.tx_partial_writes = 0      # kernel took only part of tx
+        self.tx_overflow_drops = 0      # conns dropped at max_tx_buffer
+
+    # ------------------------------------------------ event loop -------
+    def pump(self, timeout: float = 0.0, max_rounds: int = 8):
+        """Process every ready socket; returns parsed-frame count so
+        far.  Bounded rounds: a client flooding faster than we parse
+        must not starve the serving loop."""
+        for _ in range(max_rounds):
+            events = self.sel.select(timeout)
+            timeout = 0.0
+            if not events:
+                break
+            for key, mask in events:
+                if key.data == "accept":
+                    self._accept()
+                elif key.data == "udp":
+                    self._read_udp()
+                else:
+                    conn = key.data
+                    if mask & selectors.EVENT_READ:
+                        self._read_tcp(conn)
+                    if mask & selectors.EVENT_WRITE and not conn.closed:
+                        self._flush(conn)
+        return len(self._frames)
+
+    def take_frames(self) -> list:
+        frames, self._frames = self._frames, []
+        return frames
+
+    # ------------------------------------------------ inbound ----------
+    def _accept(self):
+        while True:
+            try:
+                sock, addr = self.tcp.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            conn = MuxConn(sock, addr)
+            self.conns.add(conn)
+            self.accepted += 1
+            self.sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _read_udp(self):
+        while True:
+            try:
+                data, addr = self.udp.recvfrom(65536)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                # ICMP port-unreachable from an earlier sendto to a
+                # dead peer — not our problem, keep draining
+                self.rx_socket_errors += 1
+                return
+            self._parse(("udp", addr), data)
+
+    def _read_tcp(self, conn: MuxConn):
+        try:
+            chunk = conn.sock.recv(65536)
+        except BlockingIOError:
+            chunk = None
+        except OSError:
+            self.rx_socket_errors += 1
+            self._drop(conn)
+            return
+        if chunk == b"":
+            self._drop(conn)
+            return
+        if chunk:
+            conn.rx.extend(chunk)
+        buf = conn.rx
+        while len(buf) >= 4:
+            ln = int.from_bytes(buf[:4], "big")
+            if ln > _MAX_TCP_FRAME:
+                # garbage where the prefix should be: the stream is
+                # desynced forever (gateway._poll_tcp's bound)
+                self.rx_dropped += 1
+                self._drop(conn)
+                return
+            if len(buf) < 4 + ln:
+                return
+            frame = bytes(buf[4:4 + ln])
+            del buf[:4 + ln]
+            self._parse(conn, frame)
+
+    def _parse(self, client, data: bytes):
+        """One wire frame -> MuxFrame; malformed frames are counted and
+        dropped WITHOUT touching the connection — one hostile client's
+        short frame must never perturb another client's stream."""
+        if len(data) < _HDR.size:
+            self.rx_dropped += 1
+            return
+        kind, a, b, c = _HDR.unpack_from(data)
+        if kind != gateway_mod.EXT_IN:
+            self.rx_dropped += 1
+            return
+        if isinstance(client, MuxConn):
+            client.rx_frames += 1
+        self.rx_frames += 1
+        self._frames.append(MuxFrame(client, kind, a, b, c))
+
+    # ------------------------------------------------ outbound ---------
+    def send(self, client, payload: bytes) -> bool:
+        """Queue one frame to ``client``; False if the client is gone.
+        TCP frames are length-prefixed and buffered (never sendall);
+        UDP frames go out as single datagrams immediately."""
+        if isinstance(client, tuple):       # ("udp", addr)
+            try:
+                self.udp.sendto(payload, client[1])
+            except OSError:
+                self.rx_socket_errors += 1
+                return False
+            self.tx_frames += 1
+            return True
+        conn = client
+        if conn.closed:
+            return False
+        conn.tx += len(payload).to_bytes(4, "big") + payload
+        conn.tx_frames += 1
+        self.tx_frames += 1
+        self._flush(conn)
+        return not conn.closed
+
+    def _flush(self, conn: MuxConn):
+        """Drain as much of conn.tx as the kernel accepts; keep the
+        rest registered for EVENT_WRITE."""
+        while conn.tx:
+            try:
+                n = conn.sock.send(conn.tx)
+            except BlockingIOError:
+                break
+            except OSError:
+                self._drop(conn)
+                return
+            if n < len(conn.tx):
+                self.tx_partial_writes += 1
+            del conn.tx[:n]
+        if len(conn.tx) > self.max_tx_buffer:
+            # the client stopped reading: bound the buffer by dropping
+            # the connection, never by silently truncating a frame
+            self.tx_overflow_drops += 1
+            self._drop(conn)
+            return
+        want = selectors.EVENT_READ
+        if conn.tx:
+            want |= selectors.EVENT_WRITE
+        try:
+            self.sel.modify(conn.sock, want, conn)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def flush_all(self):
+        """Opportunistically drain every pending write buffer (called
+        after a window's responses are queued)."""
+        for conn in list(self.conns):
+            if conn.tx and not conn.closed:
+                self._flush(conn)
+
+    # ------------------------------------------------ lifecycle --------
+    def _drop(self, conn: MuxConn):
+        if conn.closed:
+            return
+        conn.closed = True
+        self.disconnected += 1
+        self.conns.discard(conn)
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def close(self):
+        for conn in list(self.conns):
+            self._drop(conn)
+        for sock in (self.udp, self.tcp):
+            try:
+                self.sel.unregister(sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self.sel.close()
+
+    def stats(self) -> dict:
+        return {"accepted": self.accepted,
+                "disconnected": self.disconnected,
+                "connections": len(self.conns),
+                "rx_frames": self.rx_frames,
+                "rx_dropped": self.rx_dropped,
+                "rx_socket_errors": self.rx_socket_errors,
+                "tx_frames": self.tx_frames,
+                "tx_partial_writes": self.tx_partial_writes,
+                "tx_overflow_drops": self.tx_overflow_drops}

@@ -1,0 +1,342 @@
+"""Per-replica multi-tenancy: one compiled program, S isolated tenants.
+
+The campaign runner (oversim_tpu/campaign/) already stacks S replicas
+of one scenario into a single vmapped program — pure data parallelism,
+zero cross-replica collectives.  This module turns that stack into S
+independently *served* tenants: tenant id == replica row, so every
+tenant gets its own overlay, its own message pool, its own admission
+bound and its own request trace, while the device still sees exactly
+one dispatch and one batched pool write per serving window.
+
+  * :class:`TenantTable` — tenant id ↔ replica row mapping plus
+    per-tenant admission bounds, counters and (duck-typed) tracers;
+  * :func:`inject_ext_batch_stacked` — the stacked analogue of
+    ``gateway.inject_ext_batch``: per-row frame lists padded to one
+    ``[S, n_max]`` batch, written by ONE ``jax.vmap(pool.alloc)``;
+  * :func:`drain_ext_out_stacked` — the stacked analogue of
+    ``gateway.drain_ext_out``: ONE ``device_get`` of the stacked pool
+    columns, a host scan per row, ONE vmapped ``pool.free``;
+  * :class:`TenantIngest` — the service-loop ingest source
+    (``before_window``/``after_window`` protocol, service/ingest.py)
+    routing submissions to their tenant row and responses back by sid.
+
+Tracers are duck-typed parameters (obs.RequestTracer-shaped: ``mint`` /
+``settle`` / ``nack`` with a ``window=`` kwarg) so this module never
+imports the observability plane — the daemon wires per-tenant tracers
+whose metrics carry ``oversim_tenant_*`` families with a
+``tenant="<id>"`` label.
+
+Isolation contract: each tenant's ``max_pending`` bound sheds THAT
+tenant's overload with explicit NACKs while every other tenant's
+requests keep flowing — the per-tenant identity
+``minted == settled + nacked + outstanding`` holds at every boundary
+(pinned by tests/test_daemon.py and the slo_soak gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oversim_tpu import gateway as gateway_mod
+from oversim_tpu.engine import pool as pool_mod
+
+I32 = jnp.int32
+I64 = jnp.int64
+NO_NODE = jnp.int32(-1)
+_HDR = gateway_mod._HDR
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    """One tenant row: admission bound, counters, optional tracer."""
+
+    tid: int
+    max_pending: int | None = None
+    tracer: object = None
+    minted: int = 0
+    settled: int = 0
+    nacked: int = 0
+    shed: int = 0                 # nacked at submit by admission ctl
+    injected: int = 0
+
+    @property
+    def outstanding(self) -> int:
+        return self.minted - self.settled - self.nacked
+
+    def snapshot(self) -> dict:
+        return {"tenant": self.tid, "minted": self.minted,
+                "settled": self.settled, "nacked": self.nacked,
+                "shed": self.shed, "injected": self.injected,
+                "outstanding": self.outstanding}
+
+
+class TenantTable:
+    """Tenant id ↔ campaign replica row mapping.
+
+    ``tenants`` is the row count S of the stacked state; tenant ids are
+    the row indices ``0..S-1`` (a campaign-stacked run becomes S
+    independent tenants from one compiled program).  ``max_pending``
+    is the per-tenant admission bound (int for all, or a list per
+    tenant); ``tracers`` an optional per-tenant tracer list."""
+
+    def __init__(self, tenants: int, max_pending=None, tracers=None):
+        if tenants < 1:
+            raise ValueError("need at least one tenant")
+        if tracers is not None and len(tracers) != tenants:
+            raise ValueError("tracers must have one entry per tenant")
+        bounds = (max_pending if isinstance(max_pending, (list, tuple))
+                  else [max_pending] * tenants)
+        if len(bounds) != tenants:
+            raise ValueError("max_pending list must match tenant count")
+        self.specs = [TenantSpec(tid=t, max_pending=bounds[t],
+                                 tracer=tracers[t] if tracers else None)
+                      for t in range(tenants)]
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def valid(self, tid) -> bool:
+        return isinstance(tid, int) and 0 <= tid < len(self.specs)
+
+    def spec(self, tid) -> TenantSpec:
+        return self.specs[tid]
+
+    def snapshot(self) -> list:
+        return [s.snapshot() for s in self.specs]
+
+
+def inject_ext_batch_stacked(state, rows, gw_slot: int, t_deliver=None):
+    """Write per-row frame lists into the stacked pool as ONE batched
+    vmapped alloc.
+
+    ``rows`` is a length-S list of ``gateway.ExtFrame`` lists (row r =
+    tenant r's frames this window).  Rows are padded to the longest
+    row; padding slots carry ``want=False`` and cost nothing.  Returns
+    ``(state', overflow)`` with ``overflow`` the lazy ``[S]`` device
+    vector of frames that did not fit per row (``None`` when every row
+    is empty)."""
+    S = len(rows)
+    n = max((len(r) for r in rows), default=0)
+    if n == 0:
+        return state, None
+    kl = state.pool.kl
+    rmax = state.pool.rmax
+    want = np.zeros((S, n), bool)
+    a = np.zeros((S, n), np.int32)
+    b = np.zeros((S, n), np.int32)
+    c = np.zeros((S, n), np.int32)
+    kind = np.full((S, n), gateway_mod.EXT_IN, np.int32)
+    src = np.full((S, n), gw_slot, np.int32)
+    dst = np.full((S, n), gw_slot, np.int32)
+    key = np.zeros((S, n, kl), np.uint32)
+    for r, frames in enumerate(rows):
+        for i, f in enumerate(frames):
+            want[r, i] = True
+            a[r, i] = f.a
+            b[r, i] = f.b
+            c[r, i] = f.c
+            kind[r, i] = f.kind
+            if f.dst is not None:
+                dst[r, i] = f.dst
+            if f.src is not None:
+                src[r, i] = f.src
+            if f.key is not None:
+                key[r, i] = np.asarray(f.key, np.uint32)
+    t_next = state.t_now[:, None] + 1                       # [S, 1]
+    when = (jnp.broadcast_to(t_next, (S, n)) if t_deliver is None
+            else jnp.maximum(jnp.asarray(t_deliver, I64),
+                             jnp.broadcast_to(t_next, (S, n))))
+    out = dict(
+        t_deliver=when.astype(I64),
+        src=jnp.asarray(src), dst=jnp.asarray(dst),
+        kind=jnp.asarray(kind), key=jnp.asarray(key),
+        nonce=jnp.zeros((S, n), I32), hops=jnp.zeros((S, n), I32),
+        a=jnp.asarray(a), b=jnp.asarray(b), c=jnp.asarray(c),
+        d=jnp.zeros((S, n), I32),
+        nodes=jnp.full((S, n, rmax), NO_NODE, I32),
+        size_b=jnp.full((S, n), _HDR.size, I32),
+        stamp=jnp.broadcast_to(state.t_now[:, None], (S, n)).astype(I64),
+    )
+    new_pool, overflow = jax.vmap(
+        lambda p, o, w: pool_mod.alloc(p, o, w))(
+            state.pool, out, jnp.asarray(want))
+    return dataclasses.replace(state, pool=new_pool), overflow
+
+
+def drain_ext_out_stacked(state, gw_slot: int, handler):
+    """Scan every replica row for EXT_OUT messages addressed to
+    ``gw_slot`` and offer each to ``handler(row, sid, b, c) ->
+    consumed``; free exactly the consumed slots with ONE vmapped free.
+
+    The stacked analogue of ``gateway.drain_ext_out``: one
+    ``device_get`` of the pool columns is the window's host read (the
+    ingest tier's documented sync), then a pure host scan."""
+    cols = jax.vmap(lambda p: (p.valid, p.kind, p.dst, p.a, p.b, p.c))(
+        state.pool)
+    valid, kind, dst, a, b, c = jax.device_get(cols)      # [S, P] each
+    sel = valid & (kind == gateway_mod.EXT_OUT) & (dst == gw_slot)
+    if not sel.any():
+        return state
+    consumed = np.zeros(valid.shape, bool)
+    for r, i in zip(*np.nonzero(sel)):
+        if handler(int(r), int(a[r, i]), int(b[r, i]), int(c[r, i])):
+            consumed[r, i] = True
+    if not consumed.any():
+        return state
+    new_pool = jax.vmap(pool_mod.free)(state.pool, jnp.asarray(consumed))
+    return dataclasses.replace(state, pool=new_pool)
+
+
+class TenantIngest:
+    """Multi-tenant ingest source over the stacked campaign state.
+
+    The service-loop protocol (service/ingest.py): ``submit(tenant, b,
+    c)`` mints a sid and queues the frame for its tenant's replica row;
+    ``before_window`` writes every queued row as ONE vmapped batched
+    alloc; ``after_window`` drains EXT_OUT responses with ONE stacked
+    host read, settles their traces, and calls ``on_response(sid,
+    tenant, b, c)`` (the daemon's sid-routing hook).
+
+    Shed semantics: a submit past the tenant's ``max_pending`` is
+    NACKed immediately (``nacked[sid]``, tenant + global tracer nack,
+    per-tenant ``shed`` counter) and never queued — one hot tenant
+    sheds without starving the rest.  ``nack_outstanding()`` closes
+    every still-open sid at drain so
+    ``minted == settled + nacked + outstanding`` ends balanced."""
+
+    def __init__(self, table: TenantTable, gw_slot: int = 0,
+                 tracer=None, on_response=None):
+        self.table = table
+        self.gw = gw_slot
+        self.tracer = tracer          # duck-typed GLOBAL tracer
+        self.on_response = on_response
+        self.windows = 0
+        self.responses: dict = {}     # sid -> (b, c)
+        self.nacked: dict = {}        # sid -> (b, c)
+        self.rx_shed = 0
+        self.num_batches = 0
+        self.num_injected = 0
+        self._pending: list = [[] for _ in range(len(table))]
+        self._open: dict = {}         # sid -> (tenant, b, c)
+        self._overflow: list = []     # lazy [S] device vectors
+        self._next_sid = 1
+
+    # ------------------------------------------------ submission -------
+    def submit(self, tenant: int, b: int = 0, c: int = 0) -> int:
+        if not self.table.valid(tenant):
+            raise ValueError(f"unknown tenant {tenant!r}")
+        spec = self.table.spec(tenant)
+        sid = self._next_sid
+        self._next_sid += 1
+        spec.minted += 1
+        if self.tracer is not None:
+            self.tracer.mint(sid, window=self.windows)
+        if spec.tracer is not None:
+            spec.tracer.mint(sid, window=self.windows)
+        if (spec.max_pending is not None
+                and len(self._pending[tenant]) >= spec.max_pending):
+            self._nack(sid, tenant, b, c, shed=True)
+            return sid
+        self._open[sid] = (tenant, b, c)
+        self._pending[tenant].append(gateway_mod.ExtFrame(
+            a=sid, b=b, c=c))
+        return sid
+
+    def _nack(self, sid, tenant, b, c, *, shed: bool = False):
+        spec = self.table.spec(tenant)
+        spec.nacked += 1
+        if shed:
+            spec.shed += 1
+            self.rx_shed += 1
+        self.nacked[sid] = (b, c)
+        if self.tracer is not None and hasattr(self.tracer, "nack"):
+            self.tracer.nack(sid, window=self.windows)
+        if spec.tracer is not None and hasattr(spec.tracer, "nack"):
+            spec.tracer.nack(sid, window=self.windows)
+
+    def outstanding(self) -> int:
+        return len(self._open)
+
+    def pending(self, tenant: int | None = None) -> int:
+        if tenant is None:
+            return sum(len(q) for q in self._pending)
+        return len(self._pending[tenant])
+
+    def nack_outstanding(self) -> list:
+        """Close EVERY still-open sid as NACKed (drain/shutdown: a
+        request whose response never drained — pool overflow, client
+        gone — must not leak).  Returns ``[(sid, tenant, b, c), ...]``
+        so the daemon can transmit the NACK frames."""
+        closed = []
+        for sid, (tenant, b, c) in list(self._open.items()):
+            del self._open[sid]
+            self._nack(sid, tenant, b, c)
+            closed.append((sid, tenant, b, c))
+        self._pending = [[] for _ in range(len(self.table))]
+        return closed
+
+    def overflow(self) -> int:
+        """Frames lost to pool overflow so far (forces a host sync)."""
+        total = sum(int(np.asarray(h).sum()) for h in self._overflow)
+        self._overflow = []
+        return total
+
+    def accounting(self) -> dict:
+        """The serving identity, globally and per tenant."""
+        per = self.table.snapshot()
+        return {"minted": sum(p["minted"] for p in per),
+                "settled": sum(p["settled"] for p in per),
+                "nacked": sum(p["nacked"] for p in per),
+                "shed": self.rx_shed,
+                "outstanding": self.outstanding(),
+                "windows": self.windows,
+                "per_tenant": per}
+
+    # ------------------------------------------------ loop protocol ----
+    def before_window(self, state, target_ns: int):
+        if not any(self._pending):
+            return state
+        rows, self._pending = self._pending, [
+            [] for _ in range(len(self.table))]
+        for t, frames in enumerate(rows):
+            self.table.spec(t).injected += len(frames)
+            self.num_injected += len(frames)
+        state, overflow = inject_ext_batch_stacked(state, rows, self.gw)
+        if overflow is not None:
+            self._overflow.append(overflow)
+        self.num_batches += 1
+        return state
+
+    def after_window(self, state):
+        def handler(row, sid, b, c):
+            rec = self._open.pop(sid, None)
+            if rec is None:
+                # not ours (already NACKed / duplicate): free it so the
+                # hold slot doesn't pin the pool full forever
+                if self.tracer is not None:
+                    self.tracer.settle(sid, window=self.windows)
+                return True
+            tenant = rec[0]
+            if tenant != row:
+                # a response surfacing in a foreign replica row would
+                # mean cross-tenant leakage — refuse to route it
+                self._open[sid] = rec
+                return False
+            spec = self.table.spec(tenant)
+            spec.settled += 1
+            self.responses[sid] = (b, c)
+            if self.tracer is not None:
+                self.tracer.settle(sid, window=self.windows)
+            if spec.tracer is not None:
+                spec.tracer.settle(sid, window=self.windows)
+            if self.on_response is not None:
+                self.on_response(sid, tenant, b, c)
+            return True
+
+        state = drain_ext_out_stacked(state, self.gw, handler)
+        self.windows += 1
+        return state

@@ -317,3 +317,75 @@ def serve(iface: XmlRpcInterface, host: str = "127.0.0.1",
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server, server.server_address[1]
+
+
+class XmlRpcFrontend:
+    """XML-RPC front door onto a running OverlayDaemon.
+
+    Unlike :class:`XmlRpcInterface` (which owns and steps its own solo
+    state), this bridge mints every call through the daemon's
+    thread-safe local queue (``OverlayDaemon.submit_local``) — the same
+    per-tenant admission, batched injection, and sid routing the socket
+    clients ride — and blocks the handler thread on the
+    :class:`~oversim_tpu.service.daemon.LocalCall` event until the
+    serving loop drains the response.  XML-RPC ``put(tenant, b, c)``
+    therefore answers with the echo-transformed ``c`` exactly as an
+    ``EXT_OUT`` frame would, and an over-bound tenant gets the same
+    deterministic refusal as an ``EXT_NACK``.
+    """
+
+    def __init__(self, daemon, timeout_s: float = 30.0):
+        self.daemon = daemon
+        self.timeout_s = timeout_s
+        self.timeouts = 0
+
+    def _call(self, tenant: int, b: int, c: int) -> dict:
+        call = self.daemon.submit_local(int(tenant), int(b), int(c))
+        if not call.wait(self.timeout_s):
+            self.timeouts += 1
+            return {"status": "timeout", "sid": call.sid}
+        out = {"status": call.status, "sid": call.sid}
+        if call.status == "ok":
+            out["b"] = int(call.resp_b)
+            out["c"] = int(call.resp_c)
+        return out
+
+    def put(self, tenant: int, key: int, value: int) -> dict:
+        """Mint one request on ``tenant``'s replica row (``b`` = key,
+        ``c`` = value) and wait for its settled response."""
+        return self._call(tenant, key, value)
+
+    def get(self, tenant: int, key: int) -> dict:
+        """Same window path as put; apps distinguish on payload."""
+        return self._call(tenant, key, 0)
+
+    def call(self, tenant: int, b: int = 0, c: int = 0) -> dict:
+        """Raw EXT_IN with explicit payload words."""
+        return self._call(tenant, b, c)
+
+    def tenants(self) -> list:
+        """Per-tenant accounting snapshot (the serving identity)."""
+        return self.daemon.ingest.table.snapshot()
+
+    def accounting(self) -> dict:
+        acct = self.daemon.accounting()
+        acct["rpc_timeouts"] = self.timeouts
+        return acct
+
+
+def serve_frontend(frontend: XmlRpcFrontend, host: str = "127.0.0.1",
+                   port: int = 0):
+    """Start the daemon-bridge XML-RPC server on a daemon thread;
+    returns (server, port).  Threaded handlers only ever touch the
+    submit queue and per-call events — never ingest state."""
+    from socketserver import ThreadingMixIn
+
+    class _Server(ThreadingMixIn, SimpleXMLRPCServer):
+        daemon_threads = True
+
+    server = _Server((host, port), allow_none=True, logRequests=False)
+    for name in ("put", "get", "call", "tenants", "accounting"):
+        server.register_function(getattr(frontend, name), name)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, server.server_address[1]

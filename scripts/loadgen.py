@@ -38,6 +38,9 @@ Usage:
 import argparse
 import json
 import math
+import selectors
+import socket
+import struct
 import sys
 import time
 from pathlib import Path
@@ -45,6 +48,181 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 sys.path.insert(0, str(ROOT / "scripts"))
+
+# the gateway wire protocol (oversim_tpu/gateway.py _HDR + kinds),
+# restated with stdlib struct so socket clients never import jax
+_HDR = struct.Struct("!IIII")
+EXT_IN, EXT_OUT, EXT_NACK = 150, 151, 152
+
+
+class SocketClients:
+    """C persistent TCP connections driving a running overlay daemon.
+
+    The soak gate's client fleet (shared with ``--tenants`` socket
+    measurements): client i belongs to tenant ``i % tenants`` and every
+    ``submit`` sends one length-prefixed
+    ``EXT_IN | tenant | b=client | c=serial`` frame on its connection.
+    ``pump`` reads responses — ``EXT_OUT`` answers carry
+    ``c = serial + transform`` (the echo app), ``EXT_NACK`` echoes the
+    serial — and records wall latency per tenant.  Pure stdlib."""
+
+    def __init__(self, host: str, port: int, clients: int,
+                 tenants: int, transform: int = 1):
+        self.sel = selectors.DefaultSelector()
+        self.tenants = tenants
+        self.transform = transform
+        self.socks = []
+        self.rx = []
+        for i in range(clients):
+            s = socket.create_connection((host, port), timeout=10.0)
+            s.settimeout(None)          # blocking sends, select-gated reads
+            self.socks.append(s)
+            self.rx.append(bytearray())
+            self.sel.register(s, selectors.EVENT_READ, i)
+        self.serial = 0
+        self.open: dict = {}            # serial -> (client, tenant, t0)
+        self.lat = {t: [] for t in range(tenants)}
+        self.answered = {t: 0 for t in range(tenants)}
+        self.nacked = {t: 0 for t in range(tenants)}
+        self.submitted = {t: 0 for t in range(tenants)}
+        self.wrong = 0                  # payload mismatches
+
+    def submit(self, client: int | None = None,
+               tenant: int | None = None) -> int:
+        i = (client if client is not None
+             else self.serial % len(self.socks))
+        t = tenant if tenant is not None else i % self.tenants
+        serial = self.serial
+        self.serial += 1
+        payload = _HDR.pack(EXT_IN, t, i, serial)
+        self.socks[i].sendall(len(payload).to_bytes(4, "big") + payload)
+        self.open[serial] = (i, t, time.perf_counter())
+        self.submitted[t] += 1
+        return serial
+
+    def _settle(self, kind: int, b: int, c: int):
+        serial = c - self.transform if kind == EXT_OUT else c
+        rec = self.open.pop(serial, None)
+        if rec is None:
+            self.wrong += 1
+            return
+        client, tenant, t0 = rec
+        if kind == EXT_NACK:
+            self.nacked[tenant] += 1
+            return
+        if b != client:
+            self.wrong += 1
+            return
+        self.answered[tenant] += 1
+        self.lat[tenant].append(time.perf_counter() - t0)
+
+    def pump(self, timeout: float = 0.0) -> int:
+        """One select round: read every ready connection, settle every
+        complete frame.  Returns how many requests are still open."""
+        for key, _ in self.sel.select(timeout):
+            i = key.data
+            chunk = self.socks[i].recv(65536)
+            if not chunk:
+                self.sel.unregister(self.socks[i])
+                continue
+            buf = self.rx[i]
+            buf.extend(chunk)
+            while len(buf) >= 4:
+                ln = int.from_bytes(buf[:4], "big")
+                if len(buf) < 4 + ln:
+                    break
+                frame = bytes(buf[4:4 + ln])
+                del buf[:4 + ln]
+                if len(frame) >= _HDR.size:
+                    kind, _sid, b, c = _HDR.unpack_from(frame)
+                    self._settle(kind, b, c)
+        return len(self.open)
+
+    def drain(self, timeout_s: float = 30.0) -> int:
+        """Pump until every open request settles or the deadline hits;
+        returns the number left open (0 = clean drain)."""
+        deadline = time.perf_counter() + timeout_s
+        while self.open and time.perf_counter() < deadline:
+            self.pump(timeout=0.1)
+        return len(self.open)
+
+    def totals(self) -> dict:
+        sub = sum(self.submitted.values())
+        ans = sum(self.answered.values())
+        nak = sum(self.nacked.values())
+        return {"submitted": sub, "answered": ans, "nacked": nak,
+                "outstanding": len(self.open), "wrong": self.wrong,
+                "lost": sub - ans - nak - len(self.open)}
+
+    def per_tenant(self, qs=(0.5, 0.99)) -> list:
+        from oversim_tpu.obs.requests import percentile
+        out = []
+        for t in range(self.tenants):
+            lat = sorted(self.lat[t])
+            row = {"tenant": t, "submitted": self.submitted[t],
+                   "answered": self.answered[t],
+                   "nacked": self.nacked[t]}
+            for q in qs:
+                p = percentile(lat, q)
+                row[f"p{round(q * 100)}_ms"] = (
+                    round(p * 1e3, 3) if p is not None else None)
+            out.append(row)
+        return out
+
+    def close(self):
+        for s in self.socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.sel.close()
+
+
+def tenant_table(rows: list) -> str:
+    """Render per-tenant rows (``per_tenant`` / snapshot dicts merged
+    with percentiles) as the human p50/p99 table."""
+    if not rows:
+        return "per-tenant: (none)"
+    cols = [c for c in ("tenant", "submitted", "minted", "answered",
+                        "settled", "nacked", "shed", "p50_ms", "p99_ms",
+                        "p50_w", "p99_w") if c in rows[0]]
+    head = "".join(f"{c:>11}" for c in cols)
+    lines = [head]
+    for r in rows:
+        lines.append("".join(
+            f"{(r[c] if r[c] is not None else '-'):>11}" for c in cols))
+    return "\n".join(lines)
+
+
+class _TenantRouter:
+    """Adapter giving TenantIngest the InProcessIngest ``submit(b, c)``
+    surface SyntheticLoad/RampLoad drive: client id b maps onto tenant
+    ``b % tenants`` (its campaign replica row)."""
+
+    def __init__(self, ingest, tenants: int):
+        self.ingest = ingest
+        self.tenants = tenants
+
+    def submit(self, b: int = 0, c: int = 0) -> int:
+        return self.ingest.submit(b % self.tenants, b, c)
+
+    @property
+    def responses(self):
+        return self.ingest.responses
+
+    @property
+    def nacked(self):
+        return self.ingest.nacked
+
+    @property
+    def rx_shed(self):
+        return self.ingest.rx_shed
+
+    def before_window(self, state, target_ns):
+        return self.ingest.before_window(state, target_ns)
+
+    def after_window(self, state):
+        return self.ingest.after_window(state)
 
 
 def main():
@@ -81,6 +259,10 @@ def main():
     ap.add_argument("--drain-windows", type=int, default=4,
                     help="ramp mode: empty tail windows so in-flight "
                     "requests settle")
+    ap.add_argument("--tenants", type=int, default=0, metavar="T",
+                    help="multi-tenant mode: serve T campaign-stacked "
+                    "tenants (client id b → tenant b % T), report a "
+                    "per-tenant p50/p99 table")
     args = ap.parse_args()
 
     import service_run
@@ -92,13 +274,33 @@ def main():
 
     sim = service_run._build_echo_sim(args)
     tracer = RequestTracer(keep_samples=True)
-    ingest = InProcessIngest(gw_slot=0, tracer=tracer,
-                             max_pending=args.max_pending)
+    tenant_tracers = None
+    summarize = None
+    if args.tenants:
+        from oversim_tpu.campaign import Campaign, CampaignParams
+        from oversim_tpu.service import (TenantIngest, TenantTable,
+                                         campaign_summarize_leaves)
+        tenant_tracers = [
+            RequestTracer(prefix="oversim_tenant",
+                          labels={"tenant": str(t)}, keep_samples=True)
+            for t in range(args.tenants)]
+        table = TenantTable(args.tenants, max_pending=args.max_pending,
+                            tracers=tenant_tracers)
+        ingest = TenantIngest(table, gw_slot=0, tracer=tracer)
+        source = _TenantRouter(ingest, args.tenants)
+        runner = Campaign(sim, CampaignParams(replicas=args.tenants,
+                                              base_seed=args.seed))
+        summarize = campaign_summarize_leaves
+    else:
+        ingest = InProcessIngest(gw_slot=0, tracer=tracer,
+                                 max_pending=args.max_pending)
+        source = ingest
+        runner = sim
     if args.ramp:
-        load = RampLoad(ingest, clients=args.clients,
+        load = RampLoad(source, clients=args.clients,
                         windows=args.windows, per_client=args.per_client)
     else:
-        load = SyntheticLoad(ingest, clients=args.clients,
+        load = SyntheticLoad(source, clients=args.clients,
                              per_window=args.rate,
                              max_requests=args.max_requests)
     obs = None
@@ -154,15 +356,19 @@ def main():
             obs.ready()
 
     t0 = time.perf_counter()
-    state = sim.init(seed=args.seed)
     # warm until every node has joined so the echo app answers from the
     # first served window (churn init_interval * n = 10 sim-seconds)
-    state = sim.run_until(state, 10.0 + args.engine_window,
-                          chunk=args.chunk)
+    if args.tenants:
+        state = runner.run_until_device(
+            runner.init(), 10.0 + args.engine_window, chunk=args.chunk)
+    else:
+        state = runner.run_until(runner.init(seed=args.seed),
+                                 10.0 + args.engine_window,
+                                 chunk=args.chunk)
     loop = ServiceLoop(
-        sim, state, ServiceParams(window_sim_s=args.window_sim_s,
-                                  chunk=args.chunk),
-        ingest=load,
+        runner, state, ServiceParams(window_sim_s=args.window_sim_s,
+                                     chunk=args.chunk),
+        ingest=load, summarize=summarize,
         events=obs.loop_event if obs is not None else None,
         on_window=on_window)
     n_windows = args.windows + (args.drain_windows if args.ramp else 0)
@@ -197,6 +403,19 @@ def main():
     print(table, flush=True)
     pct = tracer.percentiles()
 
+    per_tenant = None
+    if args.tenants:
+        per_tenant = []
+        for snap, tr in zip(ingest.table.snapshot(), tenant_tracers):
+            p = tr.percentiles((0.5, 0.99))
+            row = dict(snap)
+            for q in ("p50", "p99"):
+                w = p["wall_s"][q]
+                row[f"{q}_ms"] = round(w * 1e3, 3) if w is not None else None
+                row[f"{q}_w"] = p["windows"][q]
+            per_tenant.append(row)
+        print(tenant_table(per_tenant), flush=True)
+
     counts = tracer.latency_s.bucket_counts()
     uppers = list(tracer.latency_s.buckets) + [math.inf]
     report = {
@@ -204,6 +423,7 @@ def main():
         "clients": args.clients, "rate": args.rate,
         "windows": args.windows,
         "ramp": args.ramp, "max_pending": args.max_pending,
+        "tenants": args.tenants or None, "per_tenant": per_tenant,
         "submitted": load.submitted, "answered": answered,
         "nacked": nacked, "shed": ingest.rx_shed, "lost": lost,
         "wrong_payloads": wrong,

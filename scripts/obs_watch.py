@@ -16,6 +16,7 @@ Usage:
 
 import argparse
 import json
+import re
 import sys
 import time
 import urllib.error
@@ -79,6 +80,64 @@ def scrape(base: str, timeout: float = 5.0) -> dict:
     return out
 
 
+# per-tenant request families (daemon tier, service/tenant.py): one
+# labelled series per tenant on the shared registry
+_TENANT_CTR = re.compile(
+    r'^oversim_tenant_requests_(minted|settled|nacked)_total'
+    r'\{tenant="(\d+)"\}$')
+_TENANT_BUCKET = re.compile(
+    r'^oversim_tenant_request_window_latency_bucket'
+    r'\{le="([^"]+)",tenant="(\d+)"\}$|'
+    r'^oversim_tenant_request_window_latency_bucket'
+    r'\{tenant="(\d+)",le="([^"]+)"\}$')
+
+
+def _bucket_p99(buckets: list) -> float | None:
+    """p99 estimate from cumulative ``(le, count)`` pairs."""
+    if not buckets:
+        return None
+    buckets = sorted(buckets, key=lambda b: b[0])
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = 0.99 * total
+    for le, cum in buckets:
+        if cum >= rank:
+            return le
+    return buckets[-1][0]
+
+
+def tenant_panel(metrics: dict) -> list:
+    """Render per-tenant request counters + window-latency p99 lines
+    from one parsed /metrics scrape ([] when no tenant series)."""
+    tenants: dict = {}
+    for key, value in metrics.items():
+        m = _TENANT_CTR.match(key)
+        if m:
+            tenants.setdefault(int(m.group(2)), {})[m.group(1)] = value
+            continue
+        m = _TENANT_BUCKET.match(key)
+        if m:
+            le = m.group(1) if m.group(1) is not None else m.group(4)
+            tid = int(m.group(2) if m.group(2) is not None else m.group(3))
+            if le != "+Inf":
+                tenants.setdefault(tid, {}).setdefault(
+                    "buckets", []).append((float(le), value))
+    if not tenants:
+        return []
+    lines = ["per-tenant:",
+             f"  {'tenant':>6} {'minted':>9} {'settled':>9} "
+             f"{'nacked':>9} {'p99_w':>7}"]
+    for tid in sorted(tenants):
+        t = tenants[tid]
+        p99 = _bucket_p99(t.get("buckets", []))
+        lines.append(
+            f"  {tid:>6} {t.get('minted', 0):>9.0f} "
+            f"{t.get('settled', 0):>9.0f} {t.get('nacked', 0):>9.0f} "
+            f"{p99 if p99 is not None else '-':>7}")
+    return lines
+
+
 def render(cur: dict, prev: dict | None) -> str:
     lines = []
     if cur["error"]:
@@ -106,6 +165,7 @@ def render(cur: dict, prev: dict | None) -> str:
                      f"{f.get('ticks_target')}, retries "
                      f"{f.get('retries')}")
     m = cur.get("metrics") or {}
+    lines.extend(tenant_panel(m))
     shown = [fam for fam in _LEVELS if fam in m]
     if shown:
         lines.append("autoscale/admission:")

@@ -126,6 +126,156 @@ def _build_echo_sim(args):
     return sim_mod.Simulation(logic, cp, engine_params=ep)
 
 
+def _run_daemon(args):
+    """Overlay-as-a-service: the echo scenario campaign-stacked to
+    ``--tenants`` replica rows, served to real UDP/TCP clients through
+    the socket mux with per-tenant admission, tracing and metrics.
+
+    Announces bound ports as a ``{"phase": "daemon", ...}`` JSON line
+    (the slo_soak gate parses it), serves ``--windows`` boundaries (or
+    until SIGTERM / ``--max-wall-s``), then drains every in-flight sid
+    and writes the final accounting identity into the artifact."""
+    _setup_jax(args.platform)
+    from bench import ArtifactWriter
+    from oversim_tpu import aot
+    from oversim_tpu import elastic
+    from oversim_tpu import telemetry as telemetry_mod
+    from oversim_tpu import xmlrpcif
+    from oversim_tpu.analysis import contracts as contracts_mod
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.obs import RequestTracer
+    from oversim_tpu.service import (OverlayDaemon, ServiceLoop,
+                                     ServiceParams, SocketMux,
+                                     TenantIngest, TenantTable,
+                                     campaign_summarize_leaves)
+
+    T = args.tenants
+    if T < 1:
+        raise SystemExit("--daemon needs --tenants >= 1")
+    config = {"app": "echo", "daemon": True, "n": args.n,
+              "seed": args.seed, "tenants": T,
+              "engine_window": args.engine_window,
+              "tenant_max_pending": args.tenant_max_pending,
+              "telemetry": {"sampleTicks": args.telemetry,
+                            "window": args.telemetry_window}}
+    sim = _build_echo_sim(args)
+    config["inbox_impl"] = sim.ep.inbox_impl
+    camp = Campaign(sim, CampaignParams(replicas=T, base_seed=args.seed))
+    artifact = ArtifactWriter(args.out)
+
+    # default-ON backend acquisition + AOT warm-up (bench.py pattern):
+    # chip flakiness degrades to CPU with a manifest annotation instead
+    # of dying, and the daemon_window executable is deserialized or
+    # exported before the first client connects
+    backend = elastic.acquire_backend()
+    aot_rep = aot.warmup(
+        ("daemon_window",),
+        ctx=contracts_mod.EntryContext(
+            n=args.n, window=args.engine_window,
+            replicas=T, chunk=args.chunk),
+        enabled=aot.enabled_by_env(
+            {"OVERSIM_AOT": os.environ.get("OVERSIM_AOT", "1")}))
+
+    tracer = RequestTracer(keep_samples=True)
+    tenant_tracers = [
+        RequestTracer(prefix="oversim_tenant", labels={"tenant": str(t)})
+        for t in range(T)]
+    table = TenantTable(T, max_pending=args.tenant_max_pending,
+                        tracers=tenant_tracers)
+    ingest = TenantIngest(table, gw_slot=0, tracer=tracer)
+    mux = SocketMux(udp_port=args.udp_port, tcp_port=args.tcp_port)
+    daemon = OverlayDaemon(ingest, mux=mux)
+    xmlrpc_port = None
+    if args.xmlrpc_port is not None:
+        frontend = xmlrpcif.XmlRpcFrontend(daemon)
+        _, xmlrpc_port = xmlrpcif.serve_frontend(
+            frontend, port=args.xmlrpc_port)
+
+    obs = None
+    if args.metrics_port is not None or args.flight:
+        from oversim_tpu.obs import RunObserver
+        obs = RunObserver(role="daemon", port=args.metrics_port,
+                          flight_path=args.flight, tracer=tracer)
+        obs.set_static(n=args.n, overlay="myoverlay",
+                       inbox_impl=sim.ep.inbox_impl, replicas=T,
+                       tenants=T)
+        obs_rec = {"phase": "obs", "metrics_port": obs.start(),
+                   "flight": args.flight}
+        print(json.dumps(obs_rec), flush=True)
+        artifact.add(obs_rec)
+
+    t0 = time.perf_counter()
+    # warm until every node has joined so the echo app answers from the
+    # first served window (same warm-up as the --ingest-rate path)
+    cs = camp.run_until_device(camp.init(), 10.0 + args.engine_window,
+                               chunk=args.chunk)
+    params = ServiceParams(
+        window_sim_s=args.window_sim_s, chunk=args.chunk,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_path=args.checkpoint, realtime=args.realtime,
+        max_wall_s=args.max_wall_s)
+    manifest = telemetry_mod.run_manifest(
+        config=config,
+        artifacts={"artifact": args.out,
+                   "checkpoint": args.checkpoint,
+                   "metrics_port": obs.port if obs is not None else None,
+                   "flight": args.flight},
+        extra={"aot": aot_rep, "backend": backend})
+    artifact.set_manifest(manifest)
+
+    def on_window(window, summary, wall):
+        if obs is not None:
+            obs.on_window(window, summary, wall)
+        rec = {"window": window, "wall_s": round(wall, 3),
+               "outstanding": ingest.outstanding(),
+               "shed": ingest.rx_shed}
+        print(json.dumps(rec), flush=True)
+        artifact.add(rec)
+
+    loop = ServiceLoop(camp, cs, params, config=config,
+                       on_window=on_window, ingest=daemon,
+                       summarize=campaign_summarize_leaves,
+                       events=obs.loop_event if obs is not None else None)
+
+    daemon_rec = {"phase": "daemon", "udp_port": mux.udp_port,
+                  "tcp_port": mux.tcp_port, "xmlrpc_port": xmlrpc_port,
+                  "tenants": T, "init_wall_s":
+                  round(time.perf_counter() - t0, 2),
+                  "aot": aot_rep.get("enabled", False),
+                  "platform": backend.get("platform")}
+    print(json.dumps(daemon_rec), flush=True)
+    artifact.add(daemon_rec)
+
+    got_term = []
+
+    def _on_sigterm(signum, frame):
+        got_term.append(signum)
+        if obs is not None:
+            obs.draining()
+        loop.stop()
+
+    import signal
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+    loop.run(n_windows=args.windows or None)
+    acct = daemon.drain(loop)
+    final = {"phase": "final", "windows_done": loop.windows_done,
+             "sigterm": bool(got_term),
+             "accounting": acct,
+             "requests": tracer.percentiles(),
+             "wall_s": round(time.perf_counter() - t0, 2)}
+    artifact.add(final)
+    artifact.finish()
+    sys.stderr.write(tracer.table() + "\n")
+    print(json.dumps(final), flush=True)
+    daemon.close()
+    if obs is not None:
+        if got_term and args.term_grace > 0:
+            time.sleep(args.term_grace)
+        obs.close(dump_tail=bool(got_term))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ini", default=None, help="build from ini "
@@ -192,7 +342,32 @@ def main():
                     "draining state S seconds after a SIGTERMed loop "
                     "stops (deterministic scrape window for smoke "
                     "gates)")
+    ap.add_argument("--daemon", action="store_true",
+                    help="overlay-as-a-service: serve real UDP/TCP "
+                    "clients through the socket mux with per-replica "
+                    "multi-tenant sessions (service/daemon.py)")
+    ap.add_argument("--tenants", type=int, default=2, metavar="T",
+                    help="daemon tenant count == campaign replica rows")
+    ap.add_argument("--tenant-max-pending", type=int, default=64,
+                    metavar="B", help="per-tenant admission bound: "
+                    "submits past B pending are shed with EXT_NACK")
+    ap.add_argument("--udp-port", type=int, default=0,
+                    help="daemon UDP port (0 = ephemeral, announced in "
+                    "the daemon phase line)")
+    ap.add_argument("--tcp-port", type=int, default=0,
+                    help="daemon TCP listener port (0 = ephemeral)")
+    ap.add_argument("--xmlrpc-port", type=int, default=None,
+                    metavar="P", help="also serve the XML-RPC bridge "
+                    "front-end on P (0 = ephemeral; omit = off)")
+    ap.add_argument("--realtime", action="store_true",
+                    help="pace serving windows to wall clock")
+    ap.add_argument("--max-wall-s", type=float, default=0.0,
+                    help="wall-clock budget for the serving run (0 = "
+                    "unbounded)")
     args = ap.parse_args()
+
+    if args.daemon:
+        return _run_daemon(args)
 
     _setup_jax(args.platform)
     from bench import ArtifactWriter

@@ -1,0 +1,477 @@
+"""SLO soak gate for the overlay-as-a-service daemon.
+
+The end-to-end serving SLO of the daemon tier (service/daemon.py),
+runnable standalone (no pytest) and from scripts/run_suite.sh:
+
+  1. PIN phase (in-process, fake timer): a campaign-stacked echo
+     daemon serves W windows of local calls while the loop's ``fetch``
+     hook is counted — the serving loop must perform exactly ONE host
+     sync per window after startup (the drained-leaves clock is reused
+     for the next window target; a second fetch per window is the
+     regression this pins).
+  2. SOAK phase (subprocess): ``service_run.py --daemon`` serves
+     ``--clients`` (default 100) persistent TCP connections across
+     ``--tenants`` (default 2) tenants; every soak round submits one
+     request per client and must drain inside its deadline — sustained
+     throughput, not a one-shot burst.
+  3. SHED-ISOLATION phase: tenant 0 is overloaded far past its
+     ``--tenant-max-pending`` admission bound while tenant 1 keeps a
+     light load.  Tenant 0 must shed with EXT_NACK frames; tenant 1
+     must see ZERO nacks and keep its settled p99 under the window
+     budget — read from the daemon's per-tenant /metrics series
+     (``oversim_tenant_request_window_latency_bucket{tenant="1",...}``),
+     not from the client's wall clock.
+  4. DRAIN + accounting: clients drain to zero open requests with zero
+     lost sessions, the daemon is SIGTERMed, and its final artifact
+     record must satisfy ``minted == settled + nacked`` with zero
+     outstanding and zero leaked sessions, agreeing exactly with the
+     client-side totals.
+
+Every check lands in a failure list; the gate prints all of them and
+exits 1 if any tripped (one run diagnoses every broken SLO, not just
+the first).
+
+Usage:
+  python scripts/slo_soak.py [--clients 100] [--tenants 2]
+      [--rounds 5] [--p99-budget-windows 4] [--out report.json]
+"""
+
+import argparse
+import json
+import os
+import re
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "scripts"))
+
+_HDR = struct.Struct("!IIII")
+EXT_IN, EXT_OUT = 150, 151
+
+_TENANT_BUCKET = re.compile(
+    r'^oversim_tenant_request_window_latency_bucket'
+    r'\{tenant="(\d+)",le="([^"]+)"\}$|'
+    r'^oversim_tenant_request_window_latency_bucket'
+    r'\{le="([^"]+)",tenant="(\d+)"\}$')
+
+
+# ------------------------------------------------------------ pin ----
+
+def run_pin_phase(args, fails: list) -> dict:
+    """One host sync per serving window, on a fake timer.
+
+    Real campaign + TenantIngest + OverlayDaemon (local calls, no
+    sockets), ``fetch`` wrapped with a counter and ``now`` a fake
+    clock: after the startup reads (loop init + the first window's
+    fresh clock read) every window costs exactly one fetch — the
+    drain.  W windows => W + 2 fetch calls, no more, no fewer."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax  # noqa: F401  (platform pinned before first use)
+    import service_run
+    from oversim_tpu.campaign import Campaign, CampaignParams
+    from oversim_tpu.service import (OverlayDaemon, ServiceLoop,
+                                     ServiceParams, TenantIngest,
+                                     TenantTable,
+                                     campaign_summarize_leaves)
+    from oversim_tpu.service.loop import _default_fetch
+
+    W, T = args.pin_windows, 2
+    sim = service_run._build_echo_sim(argparse.Namespace(
+        n=args.n, engine_window=args.engine_window,
+        telemetry=0, telemetry_window=256))
+    camp = Campaign(sim, CampaignParams(replicas=T, base_seed=args.seed))
+    cs = camp.run_until_device(camp.init(), 10.0 + args.engine_window,
+                               chunk=args.chunk)
+
+    table = TenantTable(T, max_pending=args.tenant_max_pending)
+    ingest = TenantIngest(table, gw_slot=0)
+    daemon = OverlayDaemon(ingest)
+    calls = [daemon.submit_local(t, b=7 + t, c=100 * t + i)
+             for t in range(T) for i in range(4)]
+
+    fetches = [0]
+
+    def counting_fetch(snap):
+        fetches[0] += 1
+        return _default_fetch(snap)
+
+    fake_t = [0.0]
+
+    def fake_now():
+        fake_t[0] += 0.001
+        return fake_t[0]
+
+    loop = ServiceLoop(camp, cs, ServiceParams(
+        window_sim_s=args.engine_window * 4, chunk=args.chunk),
+        ingest=daemon, summarize=campaign_summarize_leaves,
+        fetch=counting_fetch, now=fake_now)
+    loop.run(n_windows=W)
+
+    expect = W + 2
+    if fetches[0] != expect:
+        fails.append(f"pin: {fetches[0]} host syncs for {W} windows "
+                     f"(expected {expect}: init + first-window clock "
+                     f"read + one drain per window)")
+    undone = [c for c in calls if not c.done.is_set()]
+    if undone:
+        fails.append(f"pin: {len(undone)}/{len(calls)} local calls "
+                     "never settled")
+    bad = [c for c in calls if c.done.is_set()
+           and (c.status != "ok" or c.resp_b != c.b
+                or c.resp_c != c.c + 1)]
+    if bad:
+        fails.append(f"pin: {len(bad)} local calls settled with wrong "
+                     "status/payload")
+    acct = daemon.accounting()
+    if acct["outstanding"] != 0 or acct["leaked_sessions"] != 0:
+        fails.append(f"pin: unbalanced accounting {acct}")
+    return {"windows": W, "fetches": fetches[0],
+            "calls": len(calls), "accounting": acct}
+
+
+# ----------------------------------------------------------- soak ----
+
+class _Child:
+    """The daemon subprocess + a line-reader thread over its stdout."""
+
+    def __init__(self, cmd, env, log_path):
+        self.log = open(log_path, "w")
+        self.proc = subprocess.Popen(
+            cmd, cwd=str(ROOT), env=env, text=True,
+            stdout=subprocess.PIPE, stderr=self.log)
+        self.lines: list = []
+        self.phases: dict = {}
+        self._cv = threading.Condition()
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self):
+        for line in self.proc.stdout:
+            line = line.rstrip("\n")
+            self.log.write(line + "\n")
+            self.log.flush()
+            rec = None
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                pass
+            with self._cv:
+                self.lines.append(line)
+                if isinstance(rec, dict) and "phase" in rec:
+                    self.phases[rec["phase"]] = rec
+                self._cv.notify_all()
+
+    def wait_phase(self, name: str, timeout_s: float):
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while name not in self.phases:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return None
+                if self.proc.poll() is not None:
+                    # dead child: give the reader a beat to flush the
+                    # tail, then report whatever arrived
+                    self._cv.wait(timeout=1.0)
+                    return self.phases.get(name)
+                self._cv.wait(timeout=min(left, 1.0))
+            return self.phases[name]
+
+    def terminate(self, timeout_s: float = 90.0) -> int | None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+        try:
+            rc = self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            rc = self.proc.wait()
+        self._reader.join(timeout=5.0)
+        self.log.close()
+        return rc
+
+
+def _scrape_metrics(port: int) -> dict:
+    from oversim_tpu.obs.metrics import parse_exposition
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5.0) as resp:
+        return parse_exposition(resp.read().decode())
+
+
+def _tenant_p99_windows(metrics: dict, tenant: int) -> float | None:
+    """p99 window latency for one tenant from cumulative histogram
+    buckets (conservative: the first bucket edge covering rank 0.99)."""
+    buckets = []
+    for key, value in metrics.items():
+        m = _TENANT_BUCKET.match(key)
+        if not m:
+            continue
+        tid = m.group(1) if m.group(1) is not None else m.group(4)
+        le = m.group(2) if m.group(2) is not None else m.group(3)
+        if int(tid) == tenant and le != "+Inf":
+            buckets.append((float(le), value))
+    buckets.sort()
+    total = buckets[-1][1] if buckets else 0
+    if total <= 0:
+        return None
+    rank = 0.99 * total
+    for le, cum in buckets:
+        if cum >= rank:
+            return le
+    return buckets[-1][0]
+
+
+def _udp_probe(port: int, tenants: int, count: int,
+               fails: list) -> int:
+    """A handful of UDP datagrams through the same mux: bare EXT_IN
+    frames in, EXT_OUT answers (c+1, the echo transform) back on the
+    same socket.  Loopback with tiny counts — answers are asserted."""
+    answered = 0
+    socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+             for _ in range(count)]
+    try:
+        for i, s in enumerate(socks):
+            s.settimeout(20.0)
+            s.sendto(_HDR.pack(EXT_IN, i % tenants, 5000 + i, i),
+                     ("127.0.0.1", port))
+        for i, s in enumerate(socks):
+            try:
+                data, _ = s.recvfrom(4096)
+            except socket.timeout:
+                continue
+            if len(data) >= _HDR.size:
+                kind, _sid, b, c = _HDR.unpack_from(data)
+                if kind == EXT_OUT and b == 5000 + i and c == i + 1:
+                    answered += 1
+    finally:
+        for s in socks:
+            s.close()
+    if answered != count:
+        fails.append(f"udp: {answered}/{count} datagrams answered")
+    return answered
+
+
+def run_soak_phase(args, fails: list, report: dict):
+    from loadgen import SocketClients
+
+    out = Path(args.workdir) / "daemon_artifact.json"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "scripts/service_run.py", "--daemon",
+           "--tenants", str(args.tenants), "--n", str(args.n),
+           "--engine-window", str(args.engine_window),
+           "--window-sim-s", str(args.window_sim_s),
+           "--chunk", str(args.chunk), "--seed", str(args.seed),
+           "--windows", "100000", "--realtime",
+           "--max-wall-s", str(args.max_wall_s),
+           "--tenant-max-pending", str(args.tenant_max_pending),
+           "--metrics-port", "0", "--platform", "cpu",
+           "--out", str(out)]
+    child = _Child(cmd, env, Path(args.workdir) / "daemon.log")
+    clients = None
+    try:
+        daemon_rec = child.wait_phase("daemon", args.startup_timeout_s)
+        if daemon_rec is None:
+            fails.append("soak: daemon never announced its ports "
+                         f"(rc={child.proc.poll()}, see daemon.log)")
+            return
+        obs_rec = child.phases.get("obs") or {}
+        report["daemon"] = daemon_rec
+        tcp_port = daemon_rec["tcp_port"]
+        metrics_port = obs_rec.get("metrics_port")
+
+        # ---- sustained soak: one request per client per round ----
+        clients = SocketClients("127.0.0.1", tcp_port,
+                                clients=args.clients,
+                                tenants=args.tenants)
+        t0 = time.perf_counter()
+        slow_rounds = 0
+        for r in range(args.rounds):
+            for i in range(args.clients):
+                clients.submit(client=i)
+            deadline = time.perf_counter() + args.round_deadline_s
+            while clients.open and time.perf_counter() < deadline:
+                clients.pump(timeout=0.1)
+            if clients.open:
+                slow_rounds += 1
+                left = clients.drain(timeout_s=args.round_deadline_s)
+                if left:
+                    fails.append(f"soak: round {r} left {left} requests "
+                                 "open past twice the deadline")
+                    break
+        soak_wall = time.perf_counter() - t0
+        soak_n = args.rounds * args.clients
+        report["soak"] = {
+            "clients": args.clients, "rounds": args.rounds,
+            "requests": soak_n, "wall_s": round(soak_wall, 3),
+            "req_per_s": round(soak_n / soak_wall, 1),
+            "slow_rounds": slow_rounds}
+        if slow_rounds > args.rounds // 2:
+            fails.append(f"soak: throughput did not hold — "
+                         f"{slow_rounds}/{args.rounds} rounds blew the "
+                         f"{args.round_deadline_s}s deadline")
+        soak_nacked = dict(clients.nacked)
+        if any(soak_nacked.values()):
+            fails.append(f"soak: admission shed a clean in-budget load "
+                         f"(nacked={soak_nacked}, max_pending="
+                         f"{args.tenant_max_pending})")
+
+        report["udp_answered"] = _udp_probe(
+            daemon_rec["udp_port"], args.tenants, 4, fails)
+
+        # ---- shed isolation: overload tenant 0, spare tenant 1 ----
+        burst = args.tenant_max_pending * 4
+        t0_clients = [i for i in range(args.clients)
+                      if i % args.tenants == 0]
+        for k in range(burst):
+            clients.submit(client=t0_clients[k % len(t0_clients)],
+                           tenant=0)
+        for i in range(args.clients):
+            if i % args.tenants == 1:
+                clients.submit(client=i)
+        left = clients.drain(timeout_s=args.drain_timeout_s)
+        if left:
+            fails.append(f"shed: {left} requests still open after the "
+                         f"{args.drain_timeout_s}s drain")
+        # isolation is judged on the BURST's delta: nacks tenant t
+        # accrued during tenant 0's overload, not over the whole run
+        delta = {t: clients.nacked[t] - soak_nacked[t]
+                 for t in range(args.tenants)}
+        if delta[0] == 0:
+            fails.append(f"shed: tenant 0 got no EXT_NACK from a "
+                         f"{burst}-deep burst over max_pending="
+                         f"{args.tenant_max_pending}")
+        spared = [t for t in range(1, args.tenants) if delta[t] > 0]
+        if spared:
+            fails.append(f"shed: tenants {spared} were nacked during "
+                         f"tenant 0's overload (isolation breach, "
+                         f"delta={delta})")
+        report["shed"] = {"burst": burst, "nacked_delta": delta}
+
+        # ---- SLO: per-tenant p99 from the daemon's own /metrics ----
+        if metrics_port is None:
+            fails.append("slo: daemon exposed no metrics port")
+        else:
+            metrics = _scrape_metrics(metrics_port)
+            p99 = {t: _tenant_p99_windows(metrics, t)
+                   for t in range(args.tenants)}
+            report["p99_windows"] = p99
+            if p99.get(1) is None:
+                fails.append("slo: tenant 1 has no settled window-"
+                             "latency samples in /metrics")
+            elif p99[1] > args.p99_budget_windows:
+                fails.append(f"slo: tenant 1 settled p99 {p99[1]} "
+                             f"windows > budget "
+                             f"{args.p99_budget_windows} (overload on "
+                             "tenant 0 leaked into tenant 1's latency)")
+
+        totals = clients.totals()
+        report["client_totals"] = totals
+        report["per_tenant"] = clients.per_tenant()
+        if totals["lost"] != 0 or totals["wrong"] != 0:
+            fails.append(f"soak: lost={totals['lost']} "
+                         f"wrong={totals['wrong']} (zero-lost-session "
+                         "guarantee broken)")
+
+        # ---- drain the daemon and reconcile its final accounting ----
+        rc = child.terminate()
+        final = child.phases.get("final")
+        if final is None:
+            fails.append(f"final: no final record from the daemon "
+                         f"(rc={rc})")
+            return
+        report["final"] = final
+        acct = final["accounting"]
+        if acct["minted"] != acct["settled"] + acct["nacked"]:
+            fails.append(f"final: minted != settled + nacked: {acct}")
+        if acct["outstanding"] != 0 or acct["leaked_sessions"] != 0:
+            fails.append(f"final: outstanding={acct['outstanding']} "
+                         f"leaked_sessions={acct['leaked_sessions']} "
+                         "after drain")
+        expect_minted = (totals["submitted"]
+                         + report.get("udp_answered", 0))
+        if acct["minted"] != expect_minted:
+            fails.append(f"final: daemon minted {acct['minted']} != "
+                         f"{expect_minted} client submissions")
+        if acct["nacked"] != totals["nacked"]:
+            fails.append(f"final: daemon nacked {acct['nacked']} != "
+                         f"{totals['nacked']} EXT_NACK frames received")
+    finally:
+        if clients is not None:
+            clients.close()
+        child.terminate()
+
+
+# ----------------------------------------------------------- main ----
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=100)
+    ap.add_argument("--tenants", type=int, default=2)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--pin-windows", type=int, default=4)
+    ap.add_argument("--n", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--engine-window", type=float, default=0.05)
+    ap.add_argument("--window-sim-s", type=float, default=0.25,
+                    help="serving window; realtime-paced in the child")
+    ap.add_argument("--tenant-max-pending", type=int, default=64,
+                    help="admission bound; must clear the per-round "
+                    "per-tenant soak load (clients/tenants) so the "
+                    "clean soak never sheds")
+    ap.add_argument("--p99-budget-windows", type=float, default=4.0)
+    ap.add_argument("--round-deadline-s", type=float, default=30.0)
+    ap.add_argument("--drain-timeout-s", type=float, default=60.0)
+    ap.add_argument("--startup-timeout-s", type=float, default=420.0)
+    ap.add_argument("--max-wall-s", type=float, default=600.0,
+                    help="child-side wall fuse (belt and braces)")
+    ap.add_argument("--skip-pin", action="store_true")
+    ap.add_argument("--out", default=None, help="report JSON path")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args()
+
+    if args.workdir is None:
+        import tempfile
+        args.workdir = tempfile.mkdtemp(prefix="slo_soak_")
+    os.makedirs(args.workdir, exist_ok=True)
+
+    fails: list = []
+    report: dict = {"config": {k: v for k, v in vars(args).items()}}
+    t0 = time.perf_counter()
+    if not args.skip_pin:
+        print("slo_soak: pin phase (one host sync per window) ...",
+              flush=True)
+        report["pin"] = run_pin_phase(args, fails)
+    print(f"slo_soak: soak phase ({args.clients} clients x "
+          f"{args.rounds} rounds, {args.tenants} tenants) ...",
+          flush=True)
+    run_soak_phase(args, fails, report)
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
+    report["fails"] = fails
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+    print(json.dumps({k: report.get(k) for k in
+                      ("pin", "soak", "shed", "p99_windows",
+                       "client_totals", "wall_s")},
+                     default=str), flush=True)
+    if fails:
+        for f in fails:
+            print(f"slo_soak FAIL: {f}", flush=True)
+        return 1
+    print(f"slo_soak PASS ({report['wall_s']}s, artifacts in "
+          f"{args.workdir})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,13 +271,13 @@ def test_registry_shape():
     names = list(contracts_mod.REGISTRY)
     assert names == ["solo_tick", "solo_chunk", "run_until_device",
                      "campaign_tick", "telemetry_tick", "service_window",
-                     "fused_tick", "fused_chunk", "sparse_tick",
-                     "sparse_chunk", "sharded_tick",
+                     "daemon_window", "fused_tick", "fused_chunk",
+                     "sparse_tick", "sparse_chunk", "sharded_tick",
                      "sharded_campaign_tick", "resharded_resume"]
     tel = contracts_mod.REGISTRY["telemetry_tick"]
     assert tel.delta is not None and tel.delta.base == "solo_tick"
     for donated in ("solo_chunk", "run_until_device", "service_window",
-                    "fused_chunk"):
+                    "daemon_window", "fused_chunk"):
         assert contracts_mod.REGISTRY[donated].contract.require_donation
     camp = contracts_mod.REGISTRY["campaign_tick"].contract
     assert camp.collectives_enforced

@@ -455,3 +455,105 @@ def test_tcp_admission_shed_keeps_connection():
     finally:
         client.close()
         gw.close()
+
+
+# ------------------------------- TX buffering + window accounting --
+
+def test_tcp_partial_write_survives_tiny_sndbuf():
+    """Outbound frames survive kernel backpressure intact: with a tiny
+    server-side SO_SNDBUF and an unread client, ``send`` goes partial
+    mid-frame; the per-connection TX buffer must keep the
+    length-prefixed stream byte-exact (the old ``sendall`` on a
+    non-blocking socket could desync it) and count the partials."""
+    gw = RealtimeGateway(None, None, tcp_port=0)
+    client = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    client.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    client.settimeout(5.0)
+    frames = 200
+    body = b"x" * 1000
+    try:
+        client.connect(("127.0.0.1", gw.tcp_port))
+        assert _poll_until(gw, lambda: len(gw._tcp_conns) == 1)
+        sid = next(iter(gw._tcp_conns))
+        conn = gw._tcp_conns[sid][0]
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+        for i in range(frames):
+            gw._send_tcp(sid, _HDR.pack(EXT_OUT, sid, i, 1000 + i)
+                         + body)
+        # slow reader: drain one frame at a time, pumping between reads
+        def read_exact(n):
+            buf = b""
+            while len(buf) < n:
+                gw._pump_tx()
+                chunk = client.recv(n - len(buf))
+                assert chunk, "stream closed mid-frame"
+                buf += chunk
+            return buf
+
+        for i in range(frames):
+            ln = int.from_bytes(read_exact(4), "big")
+            assert ln == _HDR.size + len(body)
+            data = read_exact(ln)
+            kind, _s, b, c = _HDR.unpack_from(data)
+            assert (kind, b, c) == (EXT_OUT, i, 1000 + i), (
+                f"frame {i} corrupted/reordered")
+            assert data[_HDR.size:] == body
+        assert gw.tx_partial_writes > 0, (
+            "the test never exercised a partial write — shrink the "
+            "buffers or grow the frames")
+        assert not gw._tcp_tx.get(sid), "residue left in the TX buffer"
+    finally:
+        client.close()
+        gw.close()
+
+
+def test_gateway_ingest_window_accounting():
+    """GatewayIngest pins the serving-window index on the gateway per
+    boundary: mints/settles trace latency in WINDOW units and the
+    adapter's ``windows`` counter advances once per after_window."""
+    from oversim_tpu.service import GatewayIngest
+
+    class Trace:
+        def __init__(self):
+            self.events = []
+
+        def mint(self, sid, *, window=None):
+            self.events.append(("mint", sid, window))
+
+        def settle(self, sid, *, window=None):
+            self.events.append(("settle", sid, window))
+
+    tr = Trace()
+    gw = RealtimeGateway(None, _pool_state(), tracer=tr)
+    ing = GatewayIngest(gw)
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        client.sendto(_HDR.pack(EXT_IN, 0, 5, 500),
+                      ("127.0.0.1", gw.udp_port))
+        st = _pool_state()
+        deadline = time.monotonic() + 3.0
+        while not tr.events and time.monotonic() < deadline:
+            st = ing.before_window(st, target_ns=0)
+            time.sleep(0.01)
+        assert tr.events and tr.events[0][0] == "mint"
+        sid = tr.events[0][1]
+        assert tr.events[0] == ("mint", sid, 0), (
+            "window-0 mint must carry window index 0")
+        # craft the engine's response and drain it in the SAME window
+        from oversim_tpu.gateway import inject_ext_batch
+        st, _ = inject_ext_batch(
+            st, [ExtFrame(a=sid, b=5, c=501, kind=EXT_OUT)], 0)
+        st = ing.after_window(st)
+        assert ("settle", sid, 0) in tr.events
+        assert ing.windows == 1
+        # next boundary mints with the advanced window index
+        client.sendto(_HDR.pack(EXT_IN, 0, 6, 600),
+                      ("127.0.0.1", gw.udp_port))
+        deadline = time.monotonic() + 3.0
+        while len(tr.events) < 3 and time.monotonic() < deadline:
+            st = ing.before_window(st, target_ns=0)
+            time.sleep(0.01)
+        assert tr.events[2] == ("mint", sid + 1, 1)
+    finally:
+        client.close()
+        gw.close()

@@ -513,3 +513,41 @@ def test_in_process_ingest_max_pending_sheds_with_nack():
     for i in range(64):
         free.submit(b=i, c=i)
     assert free.rx_shed == 0 and free.nacked == {}
+
+
+def test_ingest_one_fetch_per_window_after_first():
+    """Serving windows reuse the drained snapshot's clock: after window
+    0's fresh current-time read, every window costs exactly ONE
+    fetch-hook sync (the drain).  A second per-window read is the
+    regression this pins — scripts/slo_soak.py's pin phase asserts the
+    same count on the real campaign-stacked daemon."""
+    events = []
+
+    class Ingest:
+        def before_window(self, state, target_ns):
+            return state
+
+        def after_window(self, state):
+            return state
+
+    st = FakeSvcState(t_now=0, tick=-1, stats={}, counters={},
+                      alive=np.ones((2,), bool))
+    clock_reads, drains = [], []
+
+    def fetch(snap):
+        # drain fetches pass the copied leaf dict; the boundary's
+        # current-time read passes the raw t_now scalar
+        (drains if isinstance(snap, dict) else clock_reads).append(snap)
+        return snap
+
+    loop = ServiceLoop(FakeRunner(events), st,
+                       ServiceParams(window_sim_s=1.0, chunk=4),
+                       start_sim_t=0.0, ingest=Ingest(), fetch=fetch,
+                       copy=lambda tree: dict(tree),
+                       summarize=lambda lv: {}, now=FakeClock())
+    loop.run(n_windows=4)
+    assert len(drains) == 4
+    assert len(clock_reads) == 1, (
+        "only the very first serving window pays a fresh clock read")
+    loop.run(n_windows=2)   # a continuation reuses the cached clock too
+    assert len(drains) == 6 and len(clock_reads) == 1
